@@ -1,0 +1,149 @@
+"""gRPC channel management + transient-error retry engine.
+
+Reference: py/modal/_utils/grpc_utils.py — `retry_transient_errors`
+(grpc_utils.py:407), `RETRYABLE_GRPC_STATUS_CODES` (grpc_utils.py:158),
+channel creation with metadata injection (grpc_utils.py:325).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import platform
+import time
+import urllib.parse
+import uuid
+from typing import Any, Optional
+
+import grpc
+import grpc.aio
+
+from ..config import logger
+from ..exception import AuthError, ConnectionError as ModalConnectionError
+
+RETRYABLE_GRPC_STATUS_CODES = [
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.CANCELLED,
+    grpc.StatusCode.INTERNAL,
+    grpc.StatusCode.UNKNOWN,
+]
+
+
+def create_channel(server_url: str, metadata: Optional[dict[str, str]] = None) -> grpc.aio.Channel:
+    """Create a grpc.aio channel from a modal-style URL (grpc:// | grpcs:// |
+    unix://)."""
+    o = urllib.parse.urlparse(server_url)
+    options = [
+        ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+        ("grpc.max_send_message_length", 128 * 1024 * 1024),
+        ("grpc.keepalive_time_ms", 30_000),
+        ("grpc.keepalive_timeout_ms", 10_000),
+    ]
+    interceptors = [_MetadataInterceptorUnary(metadata or {}), _MetadataInterceptorStream(metadata or {})]
+    if o.scheme in ("grpc", "http", ""):
+        target = o.netloc or server_url
+        return grpc.aio.insecure_channel(target, options=options, interceptors=interceptors)
+    elif o.scheme == "unix":
+        return grpc.aio.insecure_channel(server_url, options=options, interceptors=interceptors)
+    elif o.scheme in ("grpcs", "https"):
+        creds = grpc.ssl_channel_credentials()
+        return grpc.aio.secure_channel(o.netloc, creds, options=options, interceptors=interceptors)
+    else:
+        raise ModalConnectionError(f"unknown scheme in server url {server_url}")
+
+
+class _MetadataInterceptorUnary(grpc.aio.UnaryUnaryClientInterceptor):
+    def __init__(self, metadata: dict[str, str]):
+        self._metadata = list(metadata.items())
+
+    async def intercept_unary_unary(self, continuation, client_call_details, request):
+        details = _with_metadata(client_call_details, self._metadata)
+        return await continuation(details, request)
+
+
+class _MetadataInterceptorStream(grpc.aio.UnaryStreamClientInterceptor):
+    def __init__(self, metadata: dict[str, str]):
+        self._metadata = list(metadata.items())
+
+    async def intercept_unary_stream(self, continuation, client_call_details, request):
+        details = _with_metadata(client_call_details, self._metadata)
+        return await continuation(details, request)
+
+
+def _with_metadata(details: grpc.aio.ClientCallDetails, extra: list[tuple[str, str]]) -> grpc.aio.ClientCallDetails:
+    md = list(details.metadata or []) + extra
+    return grpc.aio.ClientCallDetails(
+        method=details.method,
+        timeout=details.timeout,
+        metadata=md,
+        credentials=details.credentials,
+        wait_for_ready=details.wait_for_ready,
+    )
+
+
+async def retry_transient_errors(
+    fn: Any,
+    *args: Any,
+    base_delay: float = 0.1,
+    max_delay: float = 1.0,
+    delay_factor: float = 2.0,
+    max_retries: Optional[int] = 3,
+    additional_status_codes: Optional[list] = None,
+    attempt_timeout: Optional[float] = None,
+    total_timeout: Optional[float] = None,
+    metadata: Optional[list[tuple[str, str]]] = None,
+) -> Any:
+    """Call a unary-unary multicallable with retries on transient gRPC errors.
+
+    Mirrors reference `retry_transient_errors` (grpc_utils.py:407): idempotency
+    key metadata, exponential backoff, optional per-attempt and total deadlines.
+    """
+    delay = base_delay
+    n_retries = 0
+    status_codes = RETRYABLE_GRPC_STATUS_CODES + (additional_status_codes or [])
+    idempotency_key = str(uuid.uuid4())
+    t0 = time.monotonic()
+
+    while True:
+        md = [
+            ("x-idempotency-key", idempotency_key),
+            ("x-retry-attempt", str(n_retries)),
+        ] + (metadata or [])
+        timeout = attempt_timeout
+        if total_timeout is not None:
+            elapsed = time.monotonic() - t0
+            remaining = total_timeout - elapsed
+            if remaining <= 0:
+                raise asyncio.TimeoutError(f"total timeout {total_timeout}s exceeded")
+            timeout = min(timeout, remaining) if timeout is not None else remaining
+        try:
+            return await fn(*args, metadata=md, timeout=timeout)
+        except grpc.aio.AioRpcError as exc:
+            code = exc.code()
+            if code == grpc.StatusCode.UNAUTHENTICATED:
+                raise AuthError(exc.details()) from None
+            if code not in status_codes:
+                raise
+            if max_retries is not None and n_retries >= max_retries:
+                raise
+            if total_timeout is not None and (time.monotonic() - t0 + delay) > total_timeout:
+                raise
+            n_retries += 1
+            logger.debug(f"retrying {getattr(fn, '_method', fn)} after {code} (attempt {n_retries})")
+            await asyncio.sleep(delay)
+            delay = min(delay * delay_factor, max_delay)
+
+
+def get_proto_oneof(message: Any, oneof_group: str) -> Optional[Any]:
+    which = message.WhichOneof(oneof_group)
+    if which is None:
+        return None
+    return getattr(message, which)
+
+
+def find_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

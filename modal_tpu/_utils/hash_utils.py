@@ -1,0 +1,84 @@
+"""Hashing helpers for blob/mount/volume content addressing.
+
+Reference: py/modal/_utils/hash_utils.py (sha256 base64/hex digests, chunked
+file hashing for mounts and volume v2 blocks).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+from typing import BinaryIO, Union
+
+HASH_CHUNK_SIZE = 65536
+# Volume v2 block size: 8 MiB content-addressed blocks (reference volume v2
+# uses fixed-size blocks for dedup + parallel transfer).
+BLOCK_SIZE = 8 * 1024 * 1024
+
+
+def _update(hashers, data: Union[bytes, BinaryIO]) -> int:
+    total = 0
+    if isinstance(data, bytes):
+        for h in hashers:
+            h.update(data)
+        return len(data)
+    assert data.seekable()
+    pos = data.tell()
+    while True:
+        chunk = data.read(HASH_CHUNK_SIZE)
+        if not chunk:
+            break
+        total += len(chunk)
+        for h in hashers:
+            h.update(chunk)
+    data.seek(pos)
+    return total
+
+
+def get_sha256_hex(data: Union[bytes, BinaryIO]) -> str:
+    h = hashlib.sha256()
+    _update([h], data)
+    return h.hexdigest()
+
+
+def get_sha256_base64(data: Union[bytes, BinaryIO]) -> str:
+    h = hashlib.sha256()
+    _update([h], data)
+    return base64.b64encode(h.digest()).decode("ascii")
+
+
+def get_md5_base64(data: Union[bytes, BinaryIO]) -> str:
+    h = hashlib.md5()
+    _update([h], data)
+    return base64.b64encode(h.digest()).decode("ascii")
+
+
+@dataclasses.dataclass
+class UploadHashes:
+    sha256_hex: str
+    sha256_base64: str
+    content_length: int
+
+
+def get_upload_hashes(data: Union[bytes, BinaryIO]) -> UploadHashes:
+    sha = hashlib.sha256()
+    length = _update([sha], data)
+    digest = sha.digest()
+    return UploadHashes(
+        sha256_hex=digest.hex(),
+        sha256_base64=base64.b64encode(digest).decode("ascii"),
+        content_length=length,
+    )
+
+
+def iter_file_blocks(data: BinaryIO, block_size: int = BLOCK_SIZE):
+    """Yield (index, offset, block_bytes) for volume v2 content addressing."""
+    idx = 0
+    while True:
+        offset = data.tell()
+        block = data.read(block_size)
+        if not block:
+            return
+        yield idx, offset, block
+        idx += 1

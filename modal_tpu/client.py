@@ -1,0 +1,155 @@
+"""The client: owns the channel + stub to the control plane.
+
+Reference: py/modal/client.py `_Client` (client.py:77) — `from_env`
+(client.py:207), `from_credentials` (client.py:256), per-URL stub cache
+(client.py:135), fork-safety PID reset (client.py:347). The TPU build keeps
+the same shape; the stub is the hand-written `ModalTPUStub` spine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Any, ClassVar, Optional
+
+import grpc
+
+from ._utils.async_utils import synchronize_api
+from ._utils.grpc_utils import create_channel, retry_transient_errors
+from .config import config, logger
+from .exception import AuthError, ClientClosed
+from .proto import api_pb2
+from .proto.rpc import ModalTPUStub
+
+HEARTBEAT_INTERVAL: float = config.get("heartbeat_interval")
+CLIENT_VERSION = "0.1.0"
+
+
+class _Client:
+    _client_from_env: ClassVar[Optional["_Client"]] = None
+    _client_from_env_lock: ClassVar[Optional[asyncio.Lock]] = None
+    _cancellation_context: Any
+
+    def __init__(
+        self,
+        server_url: str,
+        client_type: int = api_pb2.CLIENT_TYPE_CLIENT,
+        credentials: Optional[tuple[str, str]] = None,
+    ):
+        self.server_url = server_url
+        self.client_type = client_type
+        self._credentials = credentials
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._stub: Optional[ModalTPUStub] = None
+        self._stub_cache: dict[str, ModalTPUStub] = {}
+        self._channel_cache: dict[str, grpc.aio.Channel] = {}
+        self._closed = False
+        self._owner_pid = os.getpid()
+        self.image_builder_version: Optional[str] = None
+        self.input_plane_url: Optional[str] = None
+
+    def _metadata(self) -> dict[str, str]:
+        md = {
+            "x-modal-tpu-client-version": CLIENT_VERSION,
+            "x-modal-tpu-client-type": str(self.client_type),
+        }
+        if self._credentials:
+            token_id, token_secret = self._credentials
+            md["x-modal-tpu-token-id"] = token_id
+            md["x-modal-tpu-token-secret"] = token_secret
+        if config.get("task_id"):
+            md["x-modal-tpu-task-id"] = config.get("task_id")
+        return md
+
+    async def _open(self) -> None:
+        self._channel = create_channel(self.server_url, metadata=self._metadata())
+        self._stub = ModalTPUStub(self._channel)
+
+    async def _close(self) -> None:
+        self._closed = True
+        for channel in [self._channel, *self._channel_cache.values()]:
+            if channel is not None:
+                await channel.close()
+        self._channel = None
+        self._stub = None
+        self._channel_cache.clear()
+        self._stub_cache.clear()
+
+    @property
+    def stub(self) -> ModalTPUStub:
+        if self._stub is None:
+            raise ClientClosed("client is not connected")
+        return self._stub
+
+    async def get_stub(self, server_url: str) -> ModalTPUStub:
+        """Stub for an alternate server URL (input plane / worker data plane),
+        cached per URL (reference client.py:135)."""
+        if server_url not in self._stub_cache:
+            channel = create_channel(server_url, metadata=self._metadata())
+            self._channel_cache[server_url] = channel
+            self._stub_cache[server_url] = ModalTPUStub(channel)
+        return self._stub_cache[server_url]
+
+    async def hello(self) -> None:
+        resp = await retry_transient_errors(
+            self.stub.ClientHello,
+            api_pb2.ClientHelloRequest(client_version=CLIENT_VERSION, client_type=self.client_type),
+        )
+        if resp.warning:
+            logger.warning(resp.warning)
+        self.image_builder_version = resp.image_builder_version or None
+        self.input_plane_url = resp.input_plane_url or None
+
+    async def __aenter__(self) -> "_Client":
+        await self._open()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self._close()
+
+    @classmethod
+    async def from_env(cls) -> "_Client":
+        """Singleton client from config/env; re-created on fork (reference
+        client.py:207,347)."""
+        if cls._client_from_env is not None and cls._client_from_env._owner_pid != os.getpid():
+            cls._client_from_env = None
+            cls._client_from_env_lock = None
+        if cls._client_from_env_lock is None:
+            cls._client_from_env_lock = asyncio.Lock()
+        async with cls._client_from_env_lock:
+            if cls._client_from_env is None or cls._client_from_env._closed:
+                server_url = config["server_url"]
+                token_id = config.get("token_id")
+                token_secret = config.get("token_secret")
+                credentials = (token_id, token_secret) if token_id else None
+                client_type = (
+                    api_pb2.CLIENT_TYPE_CONTAINER if config.get("task_id") else api_pb2.CLIENT_TYPE_CLIENT
+                )
+                client = cls(server_url, client_type, credentials)
+                await client._open()
+                cls._client_from_env = client
+            return cls._client_from_env
+
+    @classmethod
+    async def from_credentials(cls, token_id: str, token_secret: str) -> "_Client":
+        client = cls(config["server_url"], api_pb2.CLIENT_TYPE_CLIENT, (token_id, token_secret))
+        await client._open()
+        return client
+
+    @classmethod
+    async def anonymous(cls, server_url: str) -> "_Client":
+        client = cls(server_url, api_pb2.CLIENT_TYPE_CLIENT, None)
+        await client._open()
+        return client
+
+    @classmethod
+    def set_env_client(cls, client: Optional["_Client"]) -> None:
+        cls._client_from_env = client
+
+    @classmethod
+    async def verify(cls, server_url: str, credentials: tuple[str, str]) -> None:
+        async with cls(server_url, api_pb2.CLIENT_TYPE_CLIENT, credentials) as client:
+            await client.hello()
+
+
+Client = synchronize_api(_Client)

@@ -1,0 +1,239 @@
+"""RPC spine: the service definition of the modal_tpu wire contract.
+
+The reference generates its client/server stubs with a custom protoc plugin
+(reference: py/protoc_plugin/plugin.py). We instead keep a single declarative
+registry of every RPC — name, request/response message, arity — and derive
+both the grpc.aio client multicallables and the server generic handler from
+it. One source of truth, no codegen step for the service layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import TYPE_CHECKING, Any, Optional
+
+from . import api_pb2
+
+if TYPE_CHECKING:
+    import grpc
+
+SERVICE_NAME = "modal.tpu.api.ModalTPU"
+
+
+class Arity(enum.Enum):
+    UNARY_UNARY = "unary_unary"
+    UNARY_STREAM = "unary_stream"
+    STREAM_UNARY = "stream_unary"
+    STREAM_STREAM = "stream_stream"
+
+
+@dataclasses.dataclass(frozen=True)
+class RPCMethod:
+    name: str
+    request_type: Any
+    response_type: Any
+    arity: Arity
+
+    @property
+    def path(self) -> str:
+        return f"/{SERVICE_NAME}/{self.name}"
+
+
+# RPCs whose response message doesn't follow the `<Name>Response` convention,
+# or that stream.
+_OVERRIDES: dict[str, tuple[Optional[str], Optional[str], Arity]] = {
+    # name: (request_msg, response_msg, arity); None = derive by convention
+    "AppGetLogs": (None, "TaskLogsBatch", Arity.UNARY_STREAM),
+    "FunctionGetCurrentStats": (None, "FunctionStats", Arity.UNARY_UNARY),
+    "FunctionCallGetData": (None, "DataChunk", Arity.UNARY_STREAM),
+    "SandboxGetLogs": (None, "TaskLogsBatch", Arity.UNARY_STREAM),
+    "SandboxSnapshotFs": (None, "SandboxSnapshotFsRequestResponse", Arity.UNARY_UNARY),
+    "ContainerExecGetOutput": (None, "RuntimeOutputBatch", Arity.UNARY_STREAM),
+    "WorkerPoll": (None, "WorkerPollResponse", Arity.UNARY_STREAM),
+}
+
+_RPC_NAMES = [
+    # App lifecycle (ref: AppCreate..AppClientDisconnect, api.proto service defn)
+    "AppCreate",
+    "AppGetOrCreate",
+    "AppHeartbeat",
+    "AppPublish",
+    "AppClientDisconnect",
+    "AppStop",
+    "AppGetLayout",
+    "AppList",
+    "AppDeploy",
+    "AppGetByDeploymentName",
+    "AppDeploymentHistory",
+    "AppGetLogs",
+    # Blob store
+    "BlobCreate",
+    "BlobGet",
+    # Function definition + invocation
+    "FunctionCreate",
+    "FunctionGet",
+    "FunctionBindParams",
+    "FunctionUpdateSchedulingParams",
+    "FunctionGetCurrentStats",
+    "FunctionMap",
+    "FunctionPutInputs",
+    "FunctionRetryInputs",
+    "FunctionGetOutputs",
+    "FunctionCallGetData",
+    "FunctionCallPutData",
+    "FunctionCallList",
+    "FunctionCallCancel",
+    "FunctionCallGetInfo",
+    # Container data plane
+    "ContainerHello",
+    "ContainerHeartbeat",
+    "FunctionGetInputs",
+    "FunctionPutOutputs",
+    "ContainerCheckpoint",
+    "ContainerStop",
+    "ContainerLog",
+    "TaskResult",
+    "TaskClusterHello",
+    # Image builder
+    "ImageGetOrCreate",
+    "ImageJoinStreaming",
+    "ImageFromId",
+    # Mounts
+    "MountPutFile",
+    "MountGetOrCreate",
+    # Volumes
+    "VolumeGetOrCreate",
+    "VolumePutFiles2",
+    "VolumeBlockPut",
+    "VolumeBlockGet",
+    "VolumeGetFile2",
+    "VolumeListFiles",
+    "VolumeRemoveFile",
+    "VolumeCopyFiles",
+    "VolumeCommit",
+    "VolumeReload",
+    "VolumeRename",
+    "VolumeDelete",
+    "VolumeList",
+    # Secrets
+    "SecretGetOrCreate",
+    "SecretList",
+    "SecretDelete",
+    # Dicts
+    "DictGetOrCreate",
+    "DictUpdate",
+    "DictGet",
+    "DictPop",
+    "DictContains",
+    "DictLen",
+    "DictContents",
+    "DictClear",
+    "DictDelete",
+    "DictList",
+    # Queues
+    "QueueGetOrCreate",
+    "QueuePut",
+    "QueueGet",
+    "QueueNextItems",
+    "QueueLen",
+    "QueueClear",
+    "QueueDelete",
+    "QueueList",
+    # Sandboxes
+    "SandboxCreate",
+    "SandboxGetTaskId",
+    "SandboxWait",
+    "SandboxTerminate",
+    "SandboxList",
+    "SandboxGetFromName",
+    "SandboxStdinWrite",
+    "SandboxGetLogs",
+    "SandboxSnapshotFs",
+    "ContainerExec",
+    "ContainerExecGetOutput",
+    "ContainerExecWait",
+    "ContainerExecPutInput",
+    "ContainerFilesystemExec",
+    # Workers
+    "WorkerRegister",
+    "WorkerPoll",
+    "WorkerHeartbeat",
+    # Misc
+    "ClientHello",
+    "TokenFlowCreate",
+    "TokenFlowWait",
+    "EnvironmentList",
+    "EnvironmentCreate",
+    "EnvironmentDelete",
+    "EnvironmentUpdate",
+]
+
+
+def _build_registry() -> dict[str, RPCMethod]:
+    registry = {}
+    for name in _RPC_NAMES:
+        req_name, resp_name, arity = _OVERRIDES.get(name, (None, None, Arity.UNARY_UNARY))
+        req_name = req_name or f"{name}Request"
+        resp_name = resp_name or f"{name}Response"
+        req = getattr(api_pb2, req_name, None)
+        resp = getattr(api_pb2, resp_name, None)
+        if req is None or resp is None:
+            raise RuntimeError(f"proto message missing for RPC {name}: {req_name if req is None else resp_name}")
+        registry[name] = RPCMethod(name, req, resp, arity)
+    return registry
+
+
+RPCS: dict[str, RPCMethod] = _build_registry()
+
+
+class ModalTPUStub:
+    """Client-side stub: one multicallable per RPC, built on a grpc.aio channel."""
+
+    def __init__(self, channel: "grpc.aio.Channel"):
+        self._channel = channel
+        for method in RPCS.values():
+            if method.arity == Arity.UNARY_UNARY:
+                factory = channel.unary_unary
+            elif method.arity == Arity.UNARY_STREAM:
+                factory = channel.unary_stream
+            elif method.arity == Arity.STREAM_UNARY:
+                factory = channel.stream_unary
+            else:
+                factory = channel.stream_stream
+            setattr(
+                self,
+                method.name,
+                factory(
+                    method.path,
+                    request_serializer=method.request_type.SerializeToString,
+                    response_deserializer=method.response_type.FromString,
+                ),
+            )
+
+
+def build_generic_handler(servicer: Any) -> "grpc.GenericRpcHandler":
+    """Build a grpc generic handler routing every registered RPC to a
+    same-named async method on `servicer`. Unimplemented methods return
+    UNIMPLEMENTED (so partial servicers — e.g. a worker-only control plane —
+    are fine)."""
+    import grpc
+
+    handlers = {}
+    for method in RPCS.values():
+        impl = getattr(servicer, method.name, None)
+        if impl is None:
+            continue
+        kwargs = dict(
+            request_deserializer=method.request_type.FromString,
+            response_serializer=method.response_type.SerializeToString,
+        )
+        if method.arity == Arity.UNARY_UNARY:
+            handlers[method.name] = grpc.unary_unary_rpc_method_handler(impl, **kwargs)
+        elif method.arity == Arity.UNARY_STREAM:
+            handlers[method.name] = grpc.unary_stream_rpc_method_handler(impl, **kwargs)
+        elif method.arity == Arity.STREAM_UNARY:
+            handlers[method.name] = grpc.stream_unary_rpc_method_handler(impl, **kwargs)
+        else:
+            handlers[method.name] = grpc.stream_stream_rpc_method_handler(impl, **kwargs)
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)

@@ -1,0 +1,169 @@
+"""Serialization: cloudpickle with `_Object`-aware persistent IDs.
+
+Reference: py/modal/_serialization.py — `Pickler`/`Unpickler` with persistent
+ids for object handles (_serialization.py:37-73), `serialize_data_format`
+(_serialization.py:365), exception/traceback pickling (_serialization.py:630).
+
+Persistent IDs let user payloads close over live handles (Functions, Volumes,
+Dicts...): the pickle stream stores ``(type_prefix, object_id, metadata)`` and
+the container-side unpickler re-binds a hydrated handle against its own
+client. jax arrays are handled natively by cloudpickle via numpy conversion —
+we register a reducer that moves device arrays host-side first so payloads
+never capture live device buffers.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import traceback as tb_module
+from typing import Any, Optional
+
+import cloudpickle
+
+from .config import logger
+from .exception import DeserializationError, ExecutionError
+from .proto import api_pb2
+
+PICKLE_PROTOCOL = 4
+
+
+class Pickler(cloudpickle.Pickler):
+    def __init__(self, buf: io.BytesIO):
+        super().__init__(buf, protocol=PICKLE_PROTOCOL)
+
+    def persistent_id(self, obj: Any) -> Optional[tuple]:
+        from .object import _Object
+
+        if isinstance(obj, _Object):
+            if obj._object_id is None:
+                raise ExecutionError(f"Can't serialize object {obj} which hasn't been hydrated/created.")
+            metadata = obj._get_metadata() or b""
+            return (obj._object_id, "_o", metadata)
+        return None
+
+    def reducer_override(self, obj: Any) -> Any:
+        # Move jax arrays host-side before pickling, then fall through to
+        # cloudpickle's own reducers (which handle closures etc.).
+        import sys
+
+        if "jax" in sys.modules:
+            import jax
+            import numpy as np
+
+            if isinstance(obj, jax.Array):
+                return (_rebuild_numpy, (np.asarray(obj),))
+        return super().reducer_override(obj)
+
+
+def _rebuild_numpy(arr):
+    return arr
+
+
+class Unpickler(pickle.Unpickler):
+    def __init__(self, client, buf: io.BytesIO):
+        super().__init__(buf)
+        self.client = client
+
+    def persistent_load(self, pid: tuple) -> Any:
+        from .object import _Object
+
+        object_id, flag, metadata = pid
+        if flag == "_o":
+            return _Object._new_hydrated_from_pickle(object_id, self.client, metadata)
+        raise DeserializationError(f"unknown persistent id flag {flag!r}")
+
+
+def serialize(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    Pickler(buf).dump(obj)
+    return buf.getvalue()
+
+
+def deserialize(s: bytes, client: Any = None) -> Any:
+    try:
+        return Unpickler(client, io.BytesIO(s)).load()
+    except DeserializationError:
+        raise
+    except Exception as exc:
+        raise DeserializationError(
+            f"Deserialization failed ({type(exc).__name__}: {exc}) — this usually means module versions differ "
+            "between the client and the container image."
+        ) from exc
+
+
+def serialize_data_format(obj: Any, data_format: int) -> bytes:
+    if data_format == api_pb2.DATA_FORMAT_PICKLE:
+        return serialize(obj)
+    elif data_format == api_pb2.DATA_FORMAT_MSGPACK:
+        import msgpack
+
+        return msgpack.packb(obj, use_bin_type=True)
+    elif data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
+        assert isinstance(obj, api_pb2.GeneratorDone)
+        return obj.SerializeToString()
+    else:
+        raise ExecutionError(f"can't serialize data format {data_format}")
+
+
+def deserialize_data_format(s: bytes, data_format: int, client: Any = None) -> Any:
+    if data_format in (api_pb2.DATA_FORMAT_PICKLE, api_pb2.DATA_FORMAT_UNSPECIFIED):
+        return deserialize(s, client)
+    elif data_format == api_pb2.DATA_FORMAT_MSGPACK:
+        import msgpack
+
+        return msgpack.unpackb(s, raw=False)
+    elif data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
+        return api_pb2.GeneratorDone.FromString(s)
+    else:
+        raise ExecutionError(f"can't deserialize data format {data_format}")
+
+
+# ---------------------------------------------------------------------------
+# Exceptions over the wire
+# ---------------------------------------------------------------------------
+
+
+def serialize_exception(exc: BaseException) -> tuple[bytes, str, str]:
+    """Returns (pickled_exception, repr, traceback_string). Falls back to a
+    generic ExecutionError when the exception itself doesn't pickle."""
+    tb_str = "".join(tb_module.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        # Strip traceback/frames (often unpicklable) but keep the exception.
+        # Strip on a shallow copy: with_traceback mutates in place and the
+        # caller may still re-raise/log the original.
+        import copy as _copy
+
+        try:
+            exc_copy = _copy.copy(exc)
+        except Exception:
+            exc_copy = exc
+        data = serialize(exc_copy.with_traceback(None))
+    except Exception as ser_exc:
+        logger.debug(f"exception {exc!r} failed to serialize: {ser_exc}")
+        data = serialize(ExecutionError(repr(exc)))
+    return data, repr(exc), tb_str
+
+
+def deserialize_exception(data: bytes, exc_repr: str, tb_str: str, client: Any = None) -> BaseException:
+    try:
+        exc = deserialize(data, client)
+        if not isinstance(exc, BaseException):
+            exc = ExecutionError(exc_repr)
+    except Exception:
+        exc = ExecutionError(f"{exc_repr} (original exception could not be deserialized)")
+    if tb_str:
+        exc.__cause__ = RemoteTraceback(tb_str)
+    return exc
+
+
+class RemoteTraceback(Exception):
+    """Carries the remote traceback text so it shows as the exception cause
+    (lightweight alternative to the reference's tblib rehydration,
+    _traceback.py)."""
+
+    def __init__(self, tb: str):
+        self.tb = tb
+
+    def __str__(self) -> str:
+        return "\n\nRemote traceback:\n" + self.tb

@@ -1,0 +1,70 @@
+"""Test harness.
+
+Mirrors the reference's strategy (SURVEY.md §4): a real in-process control
+plane served over real gRPC on localhost — so the full transport stack
+(HTTP/2, retries, metadata) is exercised — plus CPU-jax standing in for TPU
+via a forced 8-device host platform.
+
+pytest-asyncio isn't available in this environment, so a minimal coroutine
+runner hook is provided here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import sys
+
+# Force JAX onto a virtual 8-device CPU platform BEFORE jax initializes
+# (tests never touch the real TPU chip; the driver benches separately).
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MODAL_TPU_JAX_PLATFORM", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests on a fresh event loop (pytest-asyncio stand-in)."""
+    testfunc = pyfuncitem.obj
+    if inspect.iscoroutinefunction(testfunc):
+        sig = inspect.signature(testfunc)
+        kwargs = {name: pyfuncitem.funcargs[name] for name in sig.parameters if name in pyfuncitem.funcargs}
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(asyncio.wait_for(testfunc(**kwargs), timeout=120))
+        finally:
+            loop.close()
+        return True
+    return None
+
+
+@pytest.fixture
+def tmp_state_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("MODAL_TPU_STATE_DIR", str(tmp_path / "state"))
+    return tmp_path / "state"
+
+
+@pytest.fixture
+def supervisor(tmp_path, monkeypatch):
+    """An in-process control plane + 1 worker (real gRPC on localhost),
+    running on the synchronizer loop thread so both sync and async tests can
+    talk to it. Async fixtures aren't possible without pytest-asyncio, so the
+    supervisor is driven through the blocking bridge."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.server.supervisor import LocalSupervisor
+
+    monkeypatch.setenv("MODAL_TPU_STATE_DIR", str(tmp_path / "state"))
+    sup = LocalSupervisor(num_workers=1, state_dir=str(tmp_path / "state"))
+    synchronizer.run(sup.start())
+    monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{sup.port}")
+    _Client.set_env_client(None)  # force fresh client pointed at this server
+    try:
+        yield sup
+    finally:
+        _Client.set_env_client(None)
+        synchronizer.run(sup.stop())

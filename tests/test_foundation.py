@@ -1,0 +1,294 @@
+"""Unit tests for the foundation: config, serialization, async utils, RPC spine."""
+
+import asyncio
+import io
+
+import pytest
+
+from modal_tpu.config import Config, config
+from modal_tpu.exception import DeserializationError, ExecutionError
+from modal_tpu.proto import api_pb2
+from modal_tpu.proto.rpc import RPCS, Arity, ModalTPUStub, build_generic_handler
+from modal_tpu.serialization import (
+    deserialize,
+    deserialize_exception,
+    serialize,
+    serialize_exception,
+)
+from modal_tpu._utils import async_utils
+from modal_tpu._utils.async_utils import (
+    ConcurrencySemaphore,
+    TaskContext,
+    async_map,
+    async_map_ordered,
+    queue_batch_iterator,
+    synchronize_api,
+)
+from modal_tpu._utils.grpc_utils import create_channel, find_free_port, retry_transient_errors
+from modal_tpu._utils.hash_utils import get_sha256_hex, get_upload_hashes
+
+
+def test_config_env_override(monkeypatch):
+    monkeypatch.setenv("MODAL_TPU_HEARTBEAT_INTERVAL", "3.5")
+    assert Config().get("heartbeat_interval") == 3.5
+    assert isinstance(config["server_url"], str)
+
+
+def test_config_defaults():
+    assert config.get("image_builder_version") == "2026.07"
+    assert "state_dir" in config
+    assert config.get("loglevel") in ("WARNING", "DEBUG", "INFO", "ERROR")
+
+
+def test_serialize_roundtrip():
+    for obj in [42, "hello", {"a": [1, 2, {"b": None}]}, (1, 2), b"bytes"]:
+        assert deserialize(serialize(obj)) == obj
+
+
+def test_serialize_closure():
+    x = 10
+
+    def f(y):
+        return x + y
+
+    f2 = deserialize(serialize(f))
+    assert f2(5) == 15
+
+
+def test_serialize_jax_array():
+    import jax.numpy as jnp
+    import numpy as np
+
+    arr = jnp.arange(8.0)
+    restored = deserialize(serialize(arr))
+    np.testing.assert_allclose(np.asarray(arr), np.asarray(restored))
+
+
+def test_serialize_exception_roundtrip():
+    try:
+        raise ValueError("boom")
+    except ValueError as exc:
+        data, exc_repr, tb_str = serialize_exception(exc)
+    restored = deserialize_exception(data, exc_repr, tb_str)
+    assert isinstance(restored, ValueError)
+    assert "boom" in str(restored)
+    assert "test_foundation" in restored.__cause__.tb
+
+
+def test_deserialize_garbage():
+    with pytest.raises(DeserializationError):
+        deserialize(b"not a pickle")
+
+
+def test_hash_utils():
+    h = get_upload_hashes(b"hello world")
+    assert h.sha256_hex == get_sha256_hex(b"hello world")
+    assert h.content_length == 11
+    assert get_sha256_hex(io.BytesIO(b"hello world")) == h.sha256_hex
+
+
+def test_rpc_registry_complete():
+    # every registered RPC has matching serializable messages
+    assert len(RPCS) > 90
+    assert RPCS["FunctionMap"].arity == Arity.UNARY_UNARY
+    assert RPCS["AppGetLogs"].arity == Arity.UNARY_STREAM
+    assert RPCS["WorkerPoll"].arity == Arity.UNARY_STREAM
+    for m in RPCS.values():
+        assert m.request_type().SerializeToString() == b""
+
+
+async def test_task_context_infinite_loop():
+    counter = 0
+
+    async def tick():
+        nonlocal counter
+        counter += 1
+
+    async with TaskContext() as tc:
+        tc.infinite_loop(tick, sleep=0.01)
+        await asyncio.sleep(0.1)
+    assert counter >= 2
+
+
+async def test_async_map_ordered():
+    async def gen():
+        for i in range(20):
+            yield i
+
+    async def slow_sq(x):
+        await asyncio.sleep(0.001 * (20 - x))  # reverse completion order
+        return x * x
+
+    results = [r async for r in async_map_ordered(gen(), slow_sq, concurrency=8)]
+    assert results == [i * i for i in range(20)]
+
+
+async def test_async_map_unordered():
+    async def gen():
+        for i in range(10):
+            yield i
+
+    results = [r async for r in async_map(gen(), lambda x: _ret(x + 1), concurrency=4)]
+    assert sorted(results) == list(range(1, 11))
+
+
+async def _ret(x):
+    return x
+
+
+async def test_queue_batch_iterator():
+    q: asyncio.Queue = asyncio.Queue()
+    for i in range(7):
+        await q.put(i)
+    await q.put(None)
+    batches = [b async for b in queue_batch_iterator(q, max_batch_size=3)]
+    assert [x for b in batches for x in b] == list(range(7))
+    assert all(len(b) <= 3 for b in batches)
+
+
+async def test_concurrency_semaphore():
+    sem = ConcurrencySemaphore(2)
+    await sem.acquire()
+    await sem.acquire()
+    assert sem.active == 2
+    waiter = asyncio.ensure_future(sem.acquire())
+    await asyncio.sleep(0.01)
+    assert not waiter.done()
+    sem.release()
+    await asyncio.sleep(0.01)
+    assert waiter.done()
+    sem.close()
+
+
+def test_synchronize_api_dual_surface():
+    class _Thing:
+        def __init__(self, v):
+            self.v = v
+
+        async def get(self):
+            await asyncio.sleep(0.001)
+            return self.v
+
+        async def agen(self, n):
+            for i in range(n):
+                yield i
+
+    Thing = synchronize_api(_Thing)
+    t = Thing(7)
+    assert t.get() == 7  # blocking surface
+    assert list(t.agen(3)) == [0, 1, 2]  # blocking generator
+
+    async def use_aio():
+        assert await t.get.aio() == 7
+        assert [i async for i in t.agen.aio(2)] == [0, 1]
+
+    asyncio.run(use_aio())
+
+
+async def test_grpc_spine_roundtrip():
+    import grpc
+
+    class Servicer:
+        async def ClientHello(self, request, context):
+            return api_pb2.ClientHelloResponse(server_version="t1")
+
+        async def AppGetLogs(self, request, context):
+            for i in range(2):
+                yield api_pb2.TaskLogsBatch(entry_id=str(i))
+
+    port = find_free_port()
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((build_generic_handler(Servicer()),))
+    server.add_insecure_port(f"127.0.0.1:{port}")
+    await server.start()
+    channel = create_channel(f"grpc://127.0.0.1:{port}")
+    stub = ModalTPUStub(channel)
+    resp = await retry_transient_errors(stub.ClientHello, api_pb2.ClientHelloRequest())
+    assert resp.server_version == "t1"
+    ids = [b.entry_id async for b in stub.AppGetLogs(api_pb2.AppGetLogsRequest())]
+    assert ids == ["0", "1"]
+    # unimplemented method -> UNIMPLEMENTED, not retried into hang
+    with pytest.raises(grpc.aio.AioRpcError) as exc_info:
+        await stub.FunctionCreate(api_pb2.FunctionCreateRequest(), timeout=2)
+    assert exc_info.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    await channel.close()
+    await server.stop(0)
+
+
+async def test_retry_transient_errors_retries():
+    import grpc
+
+    calls = 0
+
+    class FlakyServicer:
+        async def ClientHello(self, request, context):
+            nonlocal calls
+            calls += 1
+            if calls < 3:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "flake")
+            return api_pb2.ClientHelloResponse(server_version="ok")
+
+    port = find_free_port()
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((build_generic_handler(FlakyServicer()),))
+    server.add_insecure_port(f"127.0.0.1:{port}")
+    await server.start()
+    channel = create_channel(f"grpc://127.0.0.1:{port}")
+    stub = ModalTPUStub(channel)
+    resp = await retry_transient_errors(stub.ClientHello, api_pb2.ClientHelloRequest(), base_delay=0.01)
+    assert resp.server_version == "ok" and calls == 3
+    await channel.close()
+    await server.stop(0)
+
+
+def test_object_model_basics():
+    from modal_tpu.object import _Object
+
+    class _Fake(_Object, type_prefix="fk"):
+        pass
+
+    with pytest.raises(Exception):
+        _Fake()  # no direct constructor
+
+    async def loader(obj, resolver, context, existing_id):
+        obj._hydrate("fk-123", None, None)
+
+    obj = _Fake._from_loader(loader, "Fake()")
+    assert not obj.is_hydrated
+    with pytest.raises(ExecutionError):
+        _ = obj.object_id
+
+    async def run():
+        from modal_tpu.object import LoadContext, Resolver
+
+        resolver = Resolver()
+        ctx = LoadContext(client="dummy")
+        await resolver.load(obj, ctx)
+
+    asyncio.run(run())
+    assert obj.is_hydrated and obj.object_id == "fk-123"
+    # wrong prefix rejected
+    obj2 = _Fake._from_loader(loader, "Fake()")
+    with pytest.raises(ExecutionError):
+        obj2._hydrate("xx-1", None, None)
+
+
+async def test_resolver_dedup():
+    from modal_tpu.object import LoadContext, Resolver, _Object
+
+    loads = 0
+
+    class _Fake2(_Object, type_prefix="fl"):
+        pass
+
+    async def loader(obj, resolver, context, existing_id):
+        nonlocal loads
+        loads += 1
+        await asyncio.sleep(0.01)
+        obj._hydrate("fl-1", None, None)
+
+    obj = _Fake2._from_loader(loader, "Fake2()")
+    resolver = Resolver()
+    ctx = LoadContext(client="dummy")
+    await asyncio.gather(*[resolver.load(obj, ctx) for _ in range(5)])
+    assert loads == 1
