@@ -1,0 +1,97 @@
+"""modal_tpu: a TPU-native serverless framework.
+
+Public API surface mirrors the reference SDK (modal-labs/modal-client
+py/modal/__init__.py): App, Function, Cls, Image, Volume, Secret, Dict,
+Queue, Sandbox + decorators (method/enter/exit/batched/concurrent/clustered)
+— re-designed TPU-first (`tpu=` + mesh hints instead of `gpu=`;
+ICI-topology-aware gang scheduling; jax.distributed bootstrap in the
+entrypoint).
+"""
+
+from .app import App, _App
+from .client import Client, _Client
+from .cls import Cls, Obj, _Cls
+from .config import config
+from .exception import (
+    AlreadyExistsError,
+    AuthError,
+    ClusterError,
+    DeserializationError,
+    Error,
+    ExecutionError,
+    FunctionTimeoutError,
+    InputCancellation,
+    InvalidError,
+    NotFoundError,
+    RemoteError,
+    SandboxTerminatedError,
+    SandboxTimeoutError,
+    SerializationError,
+    TimeoutError,
+    VersionError,
+)
+from .functions import Function, FunctionCall, _Function, _FunctionCall
+from .image import Image, _Image
+from .partial_function import batched, clustered, concurrent, enter, exit, method
+from .retries import Retries
+from .runtime.clustered import ClusterInfo, get_cluster_info, get_fabric_peers
+from .runtime.execution_context import current_function_call_id, current_input_id, is_local
+from .schedule import Cron, Period, SchedulerPlacement
+from .secret import Secret, _Secret
+from .tpu_config import TPUSliceSpec, parse_tpu_config
+from .volume import Volume, _Volume
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "App",
+    "Client",
+    "Cls",
+    "ClusterInfo",
+    "Cron",
+    "Dict",
+    "Error",
+    "Function",
+    "FunctionCall",
+    "Image",
+    "Period",
+    "Queue",
+    "Retries",
+    "SchedulerPlacement",
+    "Secret",
+    "TPUSliceSpec",
+    "Volume",
+    "batched",
+    "clustered",
+    "concurrent",
+    "config",
+    "current_function_call_id",
+    "current_input_id",
+    "enter",
+    "exit",
+    "get_cluster_info",
+    "get_fabric_peers",
+    "is_local",
+    "method",
+    "parse_tpu_config",
+]
+
+
+def __getattr__(name: str):
+    # Lazy imports for heavier/optional components.
+    if name == "Dict":
+        from .dict import Dict
+
+        return Dict
+    if name == "Queue":
+        from .queue import Queue
+
+        return Queue
+    if name == "Sandbox":
+        try:
+            from .sandbox import Sandbox
+
+            return Sandbox
+        except ImportError as exc:
+            raise AttributeError(f"Sandbox is not available yet: {exc}") from None
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

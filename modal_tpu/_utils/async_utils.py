@@ -200,9 +200,18 @@ def synchronize_api(obj: Any) -> Any:
       (async classmethods get a blocking classmethod + `.aio`).
     - For a **function**: returns a `_BlockingCallable`.
     """
+    _WRAPPED_DUNDERS = (
+        "__aenter__",
+        "__aexit__",
+        "__getitem__",
+        "__setitem__",
+        "__delitem__",
+        "__contains__",
+        "__len__",
+    )
     if inspect.isclass(obj):
         for name, member in list(vars(obj).items()):
-            if name.startswith("__") and name not in ("__aenter__", "__aexit__"):
+            if name.startswith("__") and name not in _WRAPPED_DUNDERS:
                 continue
             if isinstance(member, classmethod):
                 inner = member.__func__

@@ -1,0 +1,160 @@
+"""Blob store client: large payloads move over HTTP, not gRPC.
+
+Reference: py/modal/_utils/blob_utils.py — 2 MiB inline limit
+(MAX_OBJECT_SIZE_BYTES, blob_utils.py:36), multipart over 1 GiB
+(blob_utils.py:54), memory-budgeted uploads (`_ByteBudget`, blob_utils.py:66),
+`blob_upload`/`blob_download` (blob_utils.py:364).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+from contextlib import asynccontextmanager
+from typing import AsyncIterator, BinaryIO, Optional, Union
+
+from ..exception import ExecutionError
+from ..proto import api_pb2
+from .grpc_utils import retry_transient_errors
+from .hash_utils import get_upload_hashes
+
+# Inline payload limit: above this, args/results go through the blob store
+# (reference blob_utils.py:36).
+MAX_OBJECT_SIZE_BYTES = 2 * 1024 * 1024
+# Max size for a file carried directly on a gRPC message (reference
+# blob_utils.py:43).
+LARGE_FILE_LIMIT = 4 * 1024 * 1024
+# Multipart threshold + parallelism (reference blob_utils.py:54,46).
+MULTIPART_THRESHOLD = 1024 * 1024 * 1024
+MULTIPART_CONCURRENCY = 20
+
+_http_session: Optional["object"] = None
+_http_session_loop = None
+
+
+def _get_http_session():
+    """Lazily create one aiohttp session per event loop (closed at
+    interpreter exit to avoid connector leaks)."""
+    global _http_session, _http_session_loop
+    import aiohttp
+
+    loop = asyncio.get_running_loop()
+    if _http_session is None or _http_session_loop is not loop or _http_session.closed:
+        _http_session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=3600, connect=30),
+        )
+        _http_session_loop = loop
+    return _http_session
+
+
+def _close_session_at_exit() -> None:
+    global _http_session
+    if _http_session is not None and not _http_session.closed and _http_session_loop is not None:
+        if _http_session_loop.is_running():
+            asyncio.run_coroutine_threadsafe(_http_session.close(), _http_session_loop).result(5)
+        _http_session = None
+
+
+import atexit  # noqa: E402
+
+atexit.register(_close_session_at_exit)
+
+
+def _transient_http_errors() -> tuple:
+    import aiohttp
+
+    # aiohttp transient errors (ServerDisconnectedError etc.) are NOT OSError
+    # subclasses — they must be caught explicitly or a dropped keep-alive
+    # connection fails the call without retry.
+    return (OSError, asyncio.TimeoutError, aiohttp.ClientError)
+
+
+async def _put_url(url: str, data: bytes) -> None:
+    session = _get_http_session()
+    for attempt in range(4):
+        try:
+            async with session.put(url, data=data) as resp:
+                if resp.status in (200, 204):
+                    return
+                body = await resp.text()
+                raise ExecutionError(f"blob PUT failed: HTTP {resp.status} {body[:200]}")
+        except _transient_http_errors() as exc:
+            if attempt == 3:
+                raise ExecutionError(f"blob PUT failed after retries: {exc}") from exc
+            await asyncio.sleep(0.2 * 2**attempt)
+
+
+async def _get_url(url: str) -> bytes:
+    session = _get_http_session()
+    for attempt in range(4):
+        try:
+            async with session.get(url) as resp:
+                if resp.status == 200:
+                    return await resp.read()
+                body = await resp.text()
+                raise ExecutionError(f"blob GET failed: HTTP {resp.status} {body[:200]}")
+        except _transient_http_errors() as exc:
+            if attempt == 3:
+                raise ExecutionError(f"blob GET failed after retries: {exc}") from exc
+            await asyncio.sleep(0.2 * 2**attempt)
+    raise ExecutionError("unreachable")
+
+
+async def blob_upload(payload: Union[bytes, BinaryIO], stub) -> str:
+    """Upload a payload, returning its blob_id (reference blob_utils.py:364)."""
+    if isinstance(payload, bytes):
+        buf: BinaryIO = io.BytesIO(payload)
+    else:
+        buf = payload
+    hashes = get_upload_hashes(buf)
+    req = api_pb2.BlobCreateRequest(
+        content_sha256_base64=hashes.sha256_base64, content_length=hashes.content_length
+    )
+    resp = await retry_transient_errors(stub.BlobCreate, req)
+    which = resp.WhichOneof("upload_type_oneof")
+    if which == "multipart":
+        await _multipart_upload(buf, resp.multipart)
+    else:
+        buf.seek(0)
+        await _put_url(resp.upload_url, buf.read())
+    return resp.blob_id
+
+
+async def _multipart_upload(buf: BinaryIO, mp: api_pb2.MultiPartUpload) -> None:
+    """Parallel part PUTs with bounded concurrency (reference
+    perform_multipart_upload, blob_utils.py:166)."""
+    sem = asyncio.Semaphore(MULTIPART_CONCURRENCY)
+
+    async def _part(i: int, url: str) -> None:
+        # Read inside the semaphore so resident memory is bounded by
+        # MULTIPART_CONCURRENCY × part_length, not the whole blob.
+        async with sem:
+            offset = i * mp.part_length
+            buf.seek(offset)
+            data = buf.read(mp.part_length)
+            await _put_url(url, data)
+
+    await asyncio.gather(*[_part(i, url) for i, url in enumerate(mp.upload_urls)])
+    if mp.completion_url:
+        await _put_url(mp.completion_url, b"")
+
+
+async def blob_download(blob_id: str, stub) -> bytes:
+    resp = await retry_transient_errors(stub.BlobGet, api_pb2.BlobGetRequest(blob_id=blob_id))
+    return await _get_url(resp.download_url)
+
+
+async def format_blob_data(data: bytes, stub) -> dict:
+    """Returns kwargs for a FunctionInput/GenericResult oneof: inline if small,
+    blob id otherwise."""
+    if len(data) > MAX_OBJECT_SIZE_BYTES:
+        return {"data_blob_id": await blob_upload(data, stub)}
+    return {"data": data}
+
+
+async def resolve_blob_data(msg, stub) -> bytes:
+    """Inverse of format_blob_data for any message with data/data_blob_id."""
+    which = msg.WhichOneof("data_oneof") if hasattr(msg, "WhichOneof") else None
+    if which == "data_blob_id" or (which is None and getattr(msg, "data_blob_id", "")):
+        return await blob_download(msg.data_blob_id, stub)
+    return msg.data

@@ -1,0 +1,344 @@
+"""App: the blueprint of functions/classes and the decorator surface.
+
+Reference: py/modal/app.py — `_App` (app.py:136), `@app.function` (app.py:778
+with its full parameter surface), `@app.cls` (app.py:1035),
+`@app.local_entrypoint` (app.py:703), `app.include` (app.py:1475), and
+py/modal/runner.py for run/deploy (runner.py:364,585).
+
+TPU-first: `tpu="v5p-8"` replaces `gpu=`; `@app.function(tpu=..., mesh=...)`
+carries logical mesh hints into the runtime, and `@modal_tpu.clustered(size=N)`
+gang-schedules pod-slice hosts.
+"""
+
+from __future__ import annotations
+
+import inspect
+import typing
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Union
+
+from ._utils.async_utils import synchronize_api
+from ._utils.function_utils import FunctionInfo, check_valid_function, is_generator_fn
+from .client import _Client
+from .config import config, logger
+from .exception import ExecutionError, InvalidError
+from .functions import _Function, _FunctionSpec
+from .image import _Image
+from .partial_function import (
+    _PartialFunction,
+    _PartialFunctionFlags,
+    _PartialFunctionParams,
+)
+from .proto import api_pb2
+from .retries import Retries
+from .schedule import Schedule, SchedulerPlacement
+from .secret import _Secret
+from .tpu_config import parse_tpu_config
+from .volume import _Volume
+
+if typing.TYPE_CHECKING:
+    from .cls import _Cls
+
+_default_image: Optional[_Image] = None
+
+
+def _get_default_image() -> _Image:
+    global _default_image
+    if _default_image is None:
+        _default_image = _Image.debian_slim()
+    return _default_image
+
+
+@dataclass
+class _LocalEntrypoint:
+    raw_f: Callable
+    app: "_App"
+    info: FunctionInfo
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.raw_f(*args, **kwargs)
+
+    @property
+    def name(self) -> str:
+        return self.info.function_name
+
+
+class _App:
+    _all_apps: typing.ClassVar[dict[Optional[str], list["_App"]]] = {}
+    _container_app: typing.ClassVar[Optional["_App"]] = None
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        image: Optional[_Image] = None,
+        secrets: Sequence[_Secret] = (),
+        volumes: dict[str, _Volume] = {},
+        include_source: bool = True,
+    ):
+        if name is not None and not isinstance(name, str):
+            raise InvalidError("app name must be a string")
+        self._name = name
+        self._description = name
+        self._image = image
+        self._secrets = list(secrets)
+        self._volumes = dict(volumes)
+        self._include_source = include_source
+
+        self._functions: dict[str, _Function] = {}
+        self._classes: dict[str, "_Cls"] = {}
+        self._local_entrypoints: dict[str, _LocalEntrypoint] = {}
+
+        self._app_id: Optional[str] = None
+        self._client: Optional[_Client] = None
+        self._running_app: Optional[Any] = None
+
+        self._all_apps.setdefault(name, []).append(self)
+
+    # -- properties ---------------------------------------------------------
+
+    @property
+    def name(self) -> Optional[str]:
+        return self._name
+
+    @property
+    def description(self) -> Optional[str]:
+        return self._description or self._name
+
+    @property
+    def app_id(self) -> Optional[str]:
+        return self._app_id
+
+    @property
+    def is_interactive(self) -> bool:
+        return False
+
+    @property
+    def image(self) -> Optional[_Image]:
+        return self._image
+
+    @image.setter
+    def image(self, image: _Image) -> None:
+        self._image = image
+
+    @property
+    def registered_functions(self) -> dict[str, _Function]:
+        return dict(self._functions)
+
+    @property
+    def registered_classes(self) -> dict[str, Any]:
+        return dict(self._classes)
+
+    @property
+    def registered_entrypoints(self) -> dict[str, _LocalEntrypoint]:
+        return dict(self._local_entrypoints)
+
+    def set_description(self, description: str) -> None:
+        self._description = description
+
+    # -- registration -------------------------------------------------------
+
+    def _add_function(self, function: _Function, tag: Optional[str] = None) -> None:
+        tag = tag or function.tag
+        if tag in self._functions:
+            logger.warning(f"overwriting existing function {tag!r} on app")
+        self._functions[tag] = function
+
+    def _add_class(self, tag: str, cls: "_Cls") -> None:
+        self._classes[tag] = cls
+
+    def _init_container(self, client: _Client, app_id: str) -> None:
+        """Mark this app as the one running inside the container."""
+        self._app_id = app_id
+        self._client = client
+        _App._container_app = self
+
+    # -- decorators ---------------------------------------------------------
+
+    def function(
+        self,
+        _warn_parentheses_missing: Any = None,
+        *,
+        image: Optional[_Image] = None,
+        schedule: Optional[Schedule] = None,
+        secrets: Sequence[_Secret] = (),
+        volumes: dict[str, _Volume] = {},
+        tpu: Optional[str] = None,
+        mesh: Optional[dict[str, int]] = None,
+        cpu: Optional[float] = None,
+        memory: Optional[int] = None,
+        ephemeral_disk: Optional[int] = None,
+        serialized: bool = False,
+        timeout: int = 300,
+        startup_timeout: int = 300,
+        retries: Optional[Union[int, Retries]] = None,
+        min_containers: int = 0,
+        max_containers: int = 0,
+        buffer_containers: int = 0,
+        scaledown_window: int = 60,
+        cloud: Optional[str] = None,
+        region: Optional[Union[str, Sequence[str]]] = None,
+        enable_memory_snapshot: bool = False,
+        restrict_output: bool = False,
+        is_generator: Optional[bool] = None,
+        name: Optional[str] = None,
+        i6pn: bool = False,
+        experimental_options: Optional[dict[str, str]] = None,
+    ) -> Callable[[Union[Callable, _PartialFunction]], _Function]:
+        """Register a function with this app (reference app.py:778).
+
+        `tpu="v5e-1"` pins a slice; `mesh={"data":2,"fsdp":4}` names the
+        logical axes the runtime should build the jax Mesh with.
+        """
+        if _warn_parentheses_missing is not None:
+            raise InvalidError("Did you forget parentheses? Use @app.function().")
+
+        def wrapper(f: Union[Callable, _PartialFunction]) -> _Function:
+            nonlocal is_generator
+            params = _PartialFunctionParams()
+            if isinstance(f, _PartialFunction):
+                f.wrapped = True
+                params = f.params
+                raw_f = f.raw_f
+                if f.flags & _PartialFunctionFlags.BATCHED and params.batch_max_size:
+                    pass
+            else:
+                raw_f = f
+            check_valid_function(raw_f)
+
+            info = FunctionInfo(raw_f, serialized=serialized, name_override=name)
+            placement = SchedulerPlacement(region=region) if region else None
+            spec = _FunctionSpec(
+                image=image or self._image or _get_default_image(),
+                secrets=[*self._secrets, *secrets],
+                volumes={**self._volumes, **volumes},
+                tpu=parse_tpu_config(params.tpu_slice or tpu, mesh),
+                cpu=cpu,
+                memory=memory,
+                ephemeral_disk=ephemeral_disk,
+                timeout=timeout,
+                startup_timeout=startup_timeout,
+                retries=retries,
+                min_containers=min_containers,
+                max_containers=max_containers,
+                buffer_containers=buffer_containers,
+                scaledown_window=scaledown_window,
+                max_concurrent_inputs=params.max_concurrent_inputs or 0,
+                target_concurrent_inputs=params.target_concurrent_inputs or 0,
+                batch_max_size=params.batch_max_size or 0,
+                batch_wait_ms=params.batch_wait_ms or 0,
+                cluster_size=params.cluster_size or 0,
+                broadcast_inputs=params.broadcast_inputs,
+                fabric_size=params.fabric_size or 0,
+                i6pn=i6pn,
+                schedule=schedule,
+                scheduler_placement=placement,
+                cloud=cloud,
+                enable_memory_snapshot=enable_memory_snapshot,
+                restrict_output=restrict_output,
+                experimental_options=dict(experimental_options or {}),
+            )
+            if is_generator is None:
+                is_generator = params.is_generator
+            function = _Function.from_local(info, self, spec, is_generator=is_generator)
+            self._add_function(function)
+            return function
+
+        return wrapper
+
+    def cls(
+        self,
+        _warn_parentheses_missing: Any = None,
+        **kwargs: Any,
+    ) -> Callable[[type], Any]:
+        """Register a class with lifecycle hooks + methods (reference
+        app.py:1035). Accepts the same kwargs as `function`."""
+        if _warn_parentheses_missing is not None:
+            raise InvalidError("Did you forget parentheses? Use @app.cls().")
+
+        def wrapper(user_cls: type):
+            from .cls import _Cls
+
+            cls_obj = _Cls.from_local(user_cls, self, **kwargs)
+            self._add_class(user_cls.__name__, cls_obj)
+            return cls_obj
+
+        return wrapper
+
+    def local_entrypoint(
+        self, _warn_parentheses_missing: Any = None, *, name: Optional[str] = None
+    ) -> Callable[[Callable], _LocalEntrypoint]:
+        """CLI entrypoint running locally inside an ephemeral app run
+        (reference app.py:703)."""
+        if _warn_parentheses_missing is not None:
+            raise InvalidError("Did you forget parentheses? Use @app.local_entrypoint().")
+
+        def wrapper(raw_f: Callable) -> _LocalEntrypoint:
+            info = FunctionInfo(raw_f, name_override=name)
+            entrypoint = _LocalEntrypoint(raw_f, self, info)
+            self._local_entrypoints[info.function_name] = entrypoint
+            return entrypoint
+
+        return wrapper
+
+    def include(self, other_app: "_App") -> "_App":
+        """Merge another app's registrations (reference app.py:1475)."""
+        for tag, fn in other_app._functions.items():
+            self._add_function(fn, tag)
+        for tag, cls in other_app._classes.items():
+            self._add_class(tag, cls)
+        return self
+
+    # -- run/deploy ---------------------------------------------------------
+
+    def run(
+        self,
+        *,
+        client: Optional[_Client] = None,
+        detach: bool = False,
+        environment_name: Optional[str] = None,
+    ):
+        """Context manager: run this app ephemerally (reference app.run).
+        Supports both `with app.run():` and `async with app.run():`."""
+        from .runner import _AppRun
+
+        return _AppRun(self, client=client, detach=detach, environment_name=environment_name)
+
+    async def deploy(
+        self,
+        *,
+        name: Optional[str] = None,
+        client: Optional[_Client] = None,
+        environment_name: Optional[str] = None,
+        tag: str = "",
+    ) -> "_App":
+        from .runner import _deploy_app
+
+        await _deploy_app(self, name=name, client=client, environment_name=environment_name, tag=tag)
+        return self
+
+    @staticmethod
+    async def lookup(name: str, *, client: Optional[_Client] = None, environment_name: Optional[str] = None) -> "_App":
+        """Get or create a deployed app by name."""
+        if client is None:
+            client = await _Client.from_env()
+        from ._utils.grpc_utils import retry_transient_errors
+
+        resp = await retry_transient_errors(
+            client.stub.AppGetOrCreate,
+            api_pb2.AppGetOrCreateRequest(
+                app_name=name,
+                environment_name=environment_name or config.get("environment"),
+                object_creation_type=api_pb2.OBJECT_CREATION_TYPE_CREATE_IF_MISSING,
+            ),
+        )
+        app = _App(name)
+        app._app_id = resp.app_id
+        app._client = client
+        return app
+
+    def __repr__(self) -> str:
+        return f"App({self._name or 'unnamed'})"
+
+
+App = synchronize_api(_App)

@@ -1,0 +1,275 @@
+"""Remote classes: `@app.cls()` with lifecycle hooks and bound methods.
+
+Reference: py/modal/cls.py — `_Cls` (cls.py:447), `_Obj` (cls.py:142),
+method binding through a single "service function" (`use_function_id` /
+`use_method_name` on the Function proto), parameter binding via
+FunctionBindParams, `with_options` (cls.py:722).
+
+A class compiles to ONE service function (is_class=True) carrying the
+serialized class; each `@method` is invoked by setting `method_name` on the
+input. Instances with constructor parameters bind via FunctionBindParams so
+parameterized warm pools keep separate containers (and separate TPU warm
+state — weights stay resident per parameterization).
+"""
+
+from __future__ import annotations
+
+import inspect
+import typing
+from typing import Any, Callable, Optional, Sequence
+
+from ._utils.async_utils import synchronize_api
+from ._utils.function_utils import FunctionInfo
+from ._utils.grpc_utils import retry_transient_errors
+from .client import _Client
+from .exception import ExecutionError, InvalidError, NotFoundError
+from .functions import _Function, _FunctionSpec, _Invocation
+from .object import LoadContext, Resolver, _Object, live_method
+from .partial_function import (
+    _PartialFunction,
+    _PartialFunctionFlags,
+    find_partial_methods_for_user_cls,
+)
+from .proto import api_pb2
+from .serialization import serialize
+
+if typing.TYPE_CHECKING:
+    from .app import _App
+
+
+class _Obj:
+    """An instance of a remote class: binds constructor params + methods
+    (reference _Obj, cls.py:142)."""
+
+    def __init__(self, cls: "_Cls", args: tuple, kwargs: dict):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+        self._bound_function: Optional[_Function] = None
+        self._method_cache: dict[str, _Function] = {}
+
+    async def _get_bound_function(self) -> _Function:
+        if self._bound_function is not None:
+            return self._bound_function
+        service = self._cls._service_function
+        assert service is not None
+        if not service.is_hydrated:
+            await service.hydrate()
+        if not self._args and not self._kwargs:
+            self._bound_function = service
+        else:
+            resp = await retry_transient_errors(
+                service.client.stub.FunctionBindParams,
+                api_pb2.FunctionBindParamsRequest(
+                    function_id=service.object_id,
+                    serialized_params=serialize((self._args, self._kwargs)),
+                ),
+            )
+            bound = _Function._new_hydrated(resp.bound_function_id, service.client, resp.handle_metadata)
+            self._bound_function = bound
+        return self._bound_function
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._cls._method_partials:
+            return _BoundMethod(self, name)
+        # non-method attribute: construct locally for local access
+        if self._cls._user_cls is not None and hasattr(self._cls._user_cls, name):
+            raise InvalidError(
+                f"{name} is not a @method; only methods can be accessed on remote class instances"
+            )
+        raise AttributeError(name)
+
+
+class _BoundMethod:
+    """Callable handle for `instance.method` supporting .remote/.local/.spawn/.map."""
+
+    def __init__(self, obj: _Obj, method_name: str):
+        self._obj = obj
+        self._method_name = method_name
+
+    async def _invoke(self, args: tuple, kwargs: dict, invocation_type: int) -> Any:
+        fn = await self._obj._get_bound_function()
+        invocation = await _Invocation.create(
+            fn, args, kwargs, client=fn.client, invocation_type=invocation_type, method_name=self._method_name
+        )
+        return invocation
+
+    async def remote(self, *args: Any, **kwargs: Any) -> Any:
+        invocation = await self._invoke(args, kwargs, api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC)
+        return await invocation.run_function()
+
+    async def remote_gen(self, *args: Any, **kwargs: Any):
+        invocation = await self._invoke(args, kwargs, api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC)
+        async for item in invocation.run_generator():
+            yield item
+
+    async def spawn(self, *args: Any, **kwargs: Any):
+        from .functions import _FunctionCall
+
+        invocation = await self._invoke(args, kwargs, api_pb2.FUNCTION_CALL_INVOCATION_TYPE_ASYNC)
+        fn = await self._obj._get_bound_function()
+        return _FunctionCall._new_hydrated(invocation.function_call_id, fn.client, None)
+
+    def local(self, *args: Any, **kwargs: Any) -> Any:
+        cls = self._obj._cls
+        if cls._user_cls is None:
+            raise ExecutionError("class has no local definition")
+        instance = cls._user_cls(*self._obj._args, **self._obj._kwargs)
+        raw_f = cls._method_partials[self._method_name].raw_f
+        return raw_f(instance, *args, **kwargs)
+
+    def map(self, *input_iterators, kwargs={}, order_outputs=True, return_exceptions=False):
+        from .parallel_map import _map_sync
+        from ._utils.async_utils import synchronizer
+
+        fn = synchronizer.run(self._obj._get_bound_function())
+        fn = fn.clone()
+        fn._use_method_name = self._method_name
+        return _map_sync(
+            fn, *input_iterators, kwargs=kwargs, order_outputs=order_outputs, return_exceptions=return_exceptions
+        )
+
+
+class _Cls(_Object, type_prefix="cs"):
+    _user_cls: Optional[type] = None
+    _service_function: Optional[_Function] = None
+    _method_partials: dict[str, _PartialFunction] = {}
+    _app: Optional["_App"] = None
+    _name: Optional[str] = None
+
+    def _initialize_from_empty(self) -> None:
+        self._user_cls = None
+        self._service_function = None
+        self._method_partials = {}
+
+    def _hydrate_metadata(self, metadata: Optional[api_pb2.ClassHandleMetadata]) -> None:
+        pass
+
+    @staticmethod
+    def from_local(user_cls: type, app: "_App", **function_kwargs: Any) -> "_Cls":
+        """Compile a user class into a service function + method table
+        (reference cls.py from_local/_Cls)."""
+        method_partials = find_partial_methods_for_user_cls(user_cls, _PartialFunctionFlags.FUNCTION)
+        for pf in method_partials.values():
+            pf.wrapped = True
+        # lifecycle partials get marked too so __del__ doesn't warn
+        for pf in find_partial_methods_for_user_cls(user_cls, _PartialFunctionFlags.all()).values():
+            pf.wrapped = True
+
+        # Batched/concurrent settings can come from method decorators: the
+        # service function adopts them (one service function per class).
+        from .partial_function import _PartialFunctionParams
+
+        merged = _PartialFunctionParams()
+        for pf in method_partials.values():
+            merged.update(pf.params)
+        if merged.batch_max_size is not None:
+            function_kwargs.setdefault("_batch_max_size", merged.batch_max_size)
+            function_kwargs.setdefault("_batch_wait_ms", merged.batch_wait_ms or 0)
+        if merged.max_concurrent_inputs is not None:
+            function_kwargs.setdefault("_max_concurrent_inputs", merged.max_concurrent_inputs)
+            function_kwargs.setdefault("_target_concurrent_inputs", merged.target_concurrent_inputs or 0)
+
+        info = FunctionInfo(None, serialized=True, user_cls=user_cls)
+        batch_max = function_kwargs.pop("_batch_max_size", 0)
+        batch_wait = function_kwargs.pop("_batch_wait_ms", 0)
+        max_conc = function_kwargs.pop("_max_concurrent_inputs", 0)
+        target_conc = function_kwargs.pop("_target_concurrent_inputs", 0)
+
+        # Build the service function through the app.function machinery to
+        # share parameter validation, then adjust class-specific fields.
+        service_function = app.function(
+            serialized=True, name=user_cls.__name__, **function_kwargs
+        )(_class_service_stub(user_cls))
+        spec = service_function.spec
+        spec.batch_max_size = batch_max
+        spec.batch_wait_ms = batch_wait
+        spec.max_concurrent_inputs = max_conc
+        spec.target_concurrent_inputs = target_conc
+
+        # Patch the loader inputs: mark as class + attach serialized class.
+        service_function._info = FunctionInfo(None, serialized=True, user_cls=user_cls)
+        class_ser = serialize(user_cls)
+
+        async def _load(self: "_Cls", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+            await resolver.load(service_function, context)
+            # class object id derives from the service function id
+            self._hydrate("cs-" + service_function.object_id.split("-", 1)[1], context.client, None)
+
+        cls_obj = _Cls._from_loader(_load, f"Cls({user_cls.__name__})", deps=lambda: [service_function])
+        cls_obj._user_cls = user_cls
+        cls_obj._service_function = service_function
+        cls_obj._method_partials = method_partials
+        cls_obj._app = app
+        cls_obj._name = user_cls.__name__
+
+        _mark_function_as_class(service_function, user_cls, class_ser, method_partials)
+        return cls_obj
+
+    @staticmethod
+    def from_name(app_name: str, name: str, *, environment_name: Optional[str] = None) -> "_Cls":
+        async def _load(self: "_Cls", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+            service = _Function.from_name(app_name, name)
+            await resolver.load(service, context)
+            self._service_function = service
+            meta = service._metadata
+            if meta is not None and meta.method_name:
+                pass
+            self._hydrate("cs-" + service.object_id.split("-", 1)[1], context.client, None)
+            # remote classes expose methods listed in metadata
+            self._method_partials = {}
+
+        obj = _Cls._from_loader(_load, f"Cls.from_name({app_name!r}, {name!r})", hydrate_lazily=True)
+        return obj
+
+    @staticmethod
+    async def lookup(app_name: str, name: str, *, client: Optional[_Client] = None) -> "_Cls":
+        obj = _Cls.from_name(app_name, name)
+        await obj.hydrate(client)
+        return obj
+
+    def __call__(self, *args: Any, **kwargs: Any) -> _Obj:
+        """Instantiate: returns an _Obj binding constructor params."""
+        return _Obj(self, args, kwargs)
+
+
+def _class_service_stub(user_cls: type) -> Callable:
+    """Placeholder callable the service function wraps; the container
+    runtime replaces it with real class dispatch."""
+
+    def _service(*args: Any, **kwargs: Any) -> Any:
+        raise ExecutionError(f"class service function for {user_cls.__name__} must run in a container")
+
+    _service.__name__ = user_cls.__name__
+    return _service
+
+
+def _mark_function_as_class(
+    fn: _Function, user_cls: type, class_serialized: bytes, method_partials: dict[str, _PartialFunction]
+) -> None:
+    """Wrap the function's loader so FunctionCreate carries class info."""
+    inner_load = fn._load
+
+    async def _load(self: _Function, resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+        # intercept the FunctionCreate call by monkey-wrapping the stub? No —
+        # re-issue with class fields via experimental_options is cleaner.
+        self._spec.experimental_options["is_class"] = "1"
+        self._spec.experimental_options["methods"] = ",".join(sorted(method_partials.keys()))
+        gen_methods = [
+            name
+            for name, pf in method_partials.items()
+            if pf.params.is_generator
+            or inspect.isgeneratorfunction(pf.raw_f)
+            or inspect.isasyncgenfunction(pf.raw_f)
+        ]
+        self._spec.experimental_options["generator_methods"] = ",".join(sorted(gen_methods))
+        self._class_serialized_bytes = class_serialized
+        await inner_load(self, resolver, context, existing_object_id)
+
+    fn._load = _load
+
+
+Cls = synchronize_api(_Cls)
+Obj = synchronize_api(_Obj)

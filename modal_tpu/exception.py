@@ -29,8 +29,12 @@ class VersionError(Error):
     """Client/server version skew."""
 
 
-class TimeoutError(Error):  # noqa: A001 — mirrors reference naming
-    """Base timeout."""
+import builtins as _builtins
+
+
+class TimeoutError(Error, _builtins.TimeoutError):  # noqa: A001 — mirrors reference naming
+    """Base timeout. Subclasses builtins.TimeoutError so both
+    `except modal_tpu.TimeoutError` and `except TimeoutError` catch it."""
 
 
 class FunctionTimeoutError(TimeoutError):

@@ -1,0 +1,614 @@
+"""Function: the remote-callable unit, definition and invocation sides.
+
+Reference: py/modal/_functions.py — `_Function.from_local` (builds
+FunctionCreate, _functions.py:594,657), `_FunctionSpec` (_functions.py:549),
+`_Invocation` (FunctionMap → FunctionGetOutputs polling, _functions.py:122,
+140,284), `_FunctionCall` (detached handles, _functions.py:2002), and
+py/modal/parallel_map.py for `.map()`.
+
+TPU-first: resources carry a `TPUConfig` (slice type + topology + mesh) where
+the reference carries `GPUConfig`; gang functions (`cluster_size > 1`) are
+placed atomically on a pod slice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+import typing
+from dataclasses import dataclass, field
+from typing import Any, AsyncGenerator, Callable, Optional, Sequence, Union
+
+from ._utils.async_utils import TaskContext, synchronize_api
+from ._utils.blob_utils import MAX_OBJECT_SIZE_BYTES, blob_upload, format_blob_data, resolve_blob_data
+from ._utils.function_utils import OUTPUTS_TIMEOUT, FunctionInfo, is_generator_fn
+from ._utils.grpc_utils import retry_transient_errors
+from .client import _Client
+from .config import config, logger
+from .exception import (
+    ExecutionError,
+    FunctionTimeoutError,
+    InvalidError,
+    NotFoundError,
+    OutputExpiredError,
+    RemoteError,
+)
+from .object import LoadContext, Resolver, _Object, live_method, live_method_gen
+from .partial_function import _PartialFunction, _PartialFunctionFlags
+from .proto import api_pb2
+from .retries import Retries, RetryManager
+from .schedule import Schedule, SchedulerPlacement
+from .serialization import deserialize, deserialize_data_format, deserialize_exception, serialize
+from .tpu_config import TPUSliceSpec, parse_tpu_config
+
+if typing.TYPE_CHECKING:
+    from .app import _App
+    from .image import _Image
+    from .secret import _Secret
+    from .volume import _Volume
+
+
+@dataclass
+class _FunctionSpec:
+    """Everything that defines a function's runtime environment (reference
+    `_FunctionSpec`, _functions.py:549)."""
+
+    image: Optional["_Image"] = None
+    secrets: Sequence["_Secret"] = field(default_factory=list)
+    volumes: dict[str, "_Volume"] = field(default_factory=dict)
+    tpu: Optional[TPUSliceSpec] = None
+    cpu: Optional[float] = None
+    memory: Optional[int] = None
+    ephemeral_disk: Optional[int] = None
+    timeout: int = 300
+    startup_timeout: int = 300
+    retries: Optional[Union[int, Retries]] = None
+    min_containers: int = 0
+    max_containers: int = 0
+    buffer_containers: int = 0
+    scaledown_window: int = 60
+    max_concurrent_inputs: int = 0
+    target_concurrent_inputs: int = 0
+    batch_max_size: int = 0
+    batch_wait_ms: int = 0
+    cluster_size: int = 0
+    broadcast_inputs: bool = True
+    fabric_size: int = 0
+    i6pn: bool = False
+    schedule: Optional[Schedule] = None
+    scheduler_placement: Optional[SchedulerPlacement] = None
+    cloud: Optional[str] = None
+    enable_memory_snapshot: bool = False
+    restrict_output: bool = False
+    experimental_options: dict[str, str] = field(default_factory=dict)
+
+    def resources_proto(self) -> api_pb2.Resources:
+        res = api_pb2.Resources(
+            milli_cpu=int((self.cpu or 0) * 1000),
+            memory_mb=self.memory or 0,
+            ephemeral_disk_mb=self.ephemeral_disk or 0,
+        )
+        if self.tpu is not None:
+            res.tpu_config.CopyFrom(self.tpu.to_proto())
+        return res
+
+    def retry_policy_proto(self) -> Optional[api_pb2.RetryPolicy]:
+        if self.retries is None:
+            return None
+        if isinstance(self.retries, int):
+            return Retries(max_retries=self.retries).to_proto()
+        return self.retries.to_proto()
+
+
+class _Function(_Object, type_prefix="fu"):
+    _info: Optional[FunctionInfo]
+    _app: Optional["_App"] = None
+    _spec: Optional[_FunctionSpec] = None
+    _metadata: Optional[api_pb2.FunctionHandleMetadata] = None
+    _is_generator: Optional[bool] = None
+    _cluster_size: Optional[int] = None
+    _use_method_name: str = ""
+    _obj: Any = None  # bound instance for class methods
+
+    def _initialize_from_empty(self) -> None:
+        self._info = None
+        self._metadata = None
+        self._is_generator = None
+
+    def _hydrate_metadata(self, metadata: Optional[api_pb2.FunctionHandleMetadata]) -> None:
+        if metadata is not None:
+            self._metadata = metadata
+            self._is_generator = metadata.is_generator
+
+    def _get_metadata(self) -> Optional[bytes]:
+        return self._metadata.SerializeToString() if self._metadata is not None else b""
+
+    @classmethod
+    def _deserialize_metadata(cls, metadata_bytes: bytes) -> Optional[api_pb2.FunctionHandleMetadata]:
+        return api_pb2.FunctionHandleMetadata.FromString(metadata_bytes) if metadata_bytes else None
+
+    # ------------------------------------------------------------------
+    # Definition side
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def from_local(
+        info: FunctionInfo,
+        app: "_App",
+        spec: _FunctionSpec,
+        is_generator: Optional[bool] = None,
+        is_class: bool = False,
+        class_serialized: Optional[bytes] = None,
+        webhook_type: int = api_pb2.WEB_ENDPOINT_TYPE_UNSPECIFIED,
+        tag: Optional[str] = None,
+    ) -> "_Function":
+        """Build the unhydrated Function whose loader issues FunctionCreate
+        (reference from_local, _functions.py:657-1173)."""
+        from .image import _Image
+
+        tag = tag or info.function_name
+        if is_generator is None:
+            is_generator = info.raw_f is not None and is_generator_fn(info.raw_f)
+
+        def _deps() -> list[_Object]:
+            deps: list[_Object] = []
+            if spec.image is not None:
+                deps.append(spec.image)
+            deps.extend(spec.secrets)
+            deps.extend(spec.volumes.values())
+            return deps
+
+        async def _load(self: "_Function", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+            f_def = api_pb2.Function(
+                module_name=info.module_name or "",
+                function_name=info.function_name,
+                function_type=(
+                    api_pb2.FUNCTION_TYPE_GENERATOR if is_generator else api_pb2.FUNCTION_TYPE_FUNCTION
+                ),
+                definition_type=info.definition_type,
+                timeout_secs=spec.timeout,
+                startup_timeout_secs=spec.startup_timeout,
+                concurrency_limit=spec.max_containers,
+                max_concurrent_inputs=spec.max_concurrent_inputs,
+                target_concurrent_inputs=spec.target_concurrent_inputs,
+                batch_max_size=spec.batch_max_size,
+                batch_linger_ms=spec.batch_wait_ms,
+                group_size=spec.cluster_size,
+                broadcast_inputs=spec.broadcast_inputs,
+                fabric_size=spec.fabric_size,
+                i6pn_enabled=spec.i6pn,
+                is_class=is_class,
+                webhook_type=webhook_type,
+                cloud_provider_str=spec.cloud or "",
+                enable_memory_snapshot=spec.enable_memory_snapshot,
+                restrict_output=spec.restrict_output,
+                app_name=app.name or "",
+                function_schema=info.get_schema(),
+            )
+            f_def.autoscaler_settings.CopyFrom(
+                api_pb2.AutoscalerSettings(
+                    min_containers=spec.min_containers,
+                    max_containers=spec.max_containers,
+                    buffer_containers=spec.buffer_containers,
+                    scaledown_window=spec.scaledown_window,
+                )
+            )
+            for k, v in spec.experimental_options.items():
+                f_def.experimental_options[k] = v
+            f_def.resources.CopyFrom(spec.resources_proto())
+            retry_proto = spec.retry_policy_proto()
+            if retry_proto is not None:
+                f_def.retry_policy.CopyFrom(retry_proto)
+            if spec.schedule is not None:
+                f_def.schedule.CopyFrom(spec.schedule.to_proto())
+            if spec.scheduler_placement is not None:
+                f_def.scheduler_placement.CopyFrom(spec.scheduler_placement.to_proto())
+            class_bytes = getattr(self, "_class_serialized_bytes", None) or class_serialized
+            if class_bytes:
+                f_def.is_class = True
+                f_def.class_serialized = class_bytes
+            if info.is_serialized:
+                if info.raw_f is not None:
+                    f_def.function_serialized = serialize(info.raw_f)
+            else:
+                # record the import path so a local worker can sys.path it
+                globals_path = info.get_globals_path()
+                if globals_path:
+                    f_def.experimental_options["globals_path"] = globals_path
+                if info.module_name == "__main__" and info.file_path:
+                    f_def.experimental_options["main_file_path"] = info.file_path
+            if spec.image is not None:
+                f_def.image_id = spec.image.object_id
+            f_def.secret_ids.extend([s.object_id for s in spec.secrets])
+            for path, vol in spec.volumes.items():
+                f_def.volume_mounts[path] = vol.object_id
+
+            req = api_pb2.FunctionCreateRequest(
+                app_id=context.app_id or "",
+                function=f_def,
+                existing_function_id=existing_object_id or "",
+                tag=tag,
+            )
+            resp = await retry_transient_errors(context.client.stub.FunctionCreate, req)
+            self._hydrate(resp.function_id, context.client, resp.handle_metadata)
+
+        obj = _Function._from_loader(_load, f"Function({tag})", deps=_deps)
+        obj._info = info
+        obj._app = app
+        obj._spec = spec
+        obj._is_generator = is_generator
+        obj._cluster_size = spec.cluster_size or None
+        obj._tag = tag
+        return obj
+
+    @staticmethod
+    def from_name(
+        app_name: str,
+        name: str,
+        *,
+        environment_name: Optional[str] = None,
+    ) -> "_Function":
+        """Reference a deployed function (reference from_name,
+        _functions.py:1293)."""
+
+        async def _load(self: "_Function", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+            req = api_pb2.FunctionGetRequest(
+                app_name=app_name,
+                object_tag=name,
+                environment_name=environment_name or context.environment_name,
+            )
+            try:
+                resp = await retry_transient_errors(context.client.stub.FunctionGet, req)
+            except Exception as exc:
+                import grpc
+
+                if isinstance(exc, grpc.aio.AioRpcError) and exc.code() == grpc.StatusCode.NOT_FOUND:
+                    raise NotFoundError(f"function {app_name}/{name} not found") from None
+                raise
+            self._hydrate(resp.function_id, context.client, resp.handle_metadata)
+
+        return _Function._from_loader(_load, f"Function.from_name({app_name!r}, {name!r})", hydrate_lazily=True)
+
+    @staticmethod
+    async def lookup(app_name: str, name: str, *, client: Optional[_Client] = None) -> "_Function":
+        obj = _Function.from_name(app_name, name)
+        await obj.hydrate(client)
+        return obj
+
+    # ------------------------------------------------------------------
+    # Properties
+    # ------------------------------------------------------------------
+
+    @property
+    def tag(self) -> str:
+        return getattr(self, "_tag", self._info.function_name if self._info else "<unknown>")
+
+    @property
+    def app(self) -> Optional["_App"]:
+        return self._app
+
+    @property
+    def info(self) -> Optional[FunctionInfo]:
+        return self._info
+
+    @property
+    def spec(self) -> Optional[_FunctionSpec]:
+        return self._spec
+
+    @property
+    def is_generator(self) -> bool:
+        return bool(self._is_generator)
+
+    @property
+    def cluster_size(self) -> int:
+        return self._cluster_size or 1
+
+    def get_raw_f(self) -> Callable:
+        assert self._info is not None and self._info.raw_f is not None
+        return self._info.raw_f
+
+    # ------------------------------------------------------------------
+    # Invocation side
+    # ------------------------------------------------------------------
+
+    @live_method
+    async def _call_function(self, args: tuple, kwargs: dict) -> Any:
+        invocation = await _Invocation.create(
+            self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
+        )
+        return await invocation.run_function()
+
+    @live_method_gen
+    async def _call_function_generator(self, args: tuple, kwargs: dict) -> AsyncGenerator[Any, None]:
+        invocation = await _Invocation.create(
+            self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
+        )
+        async for item in invocation.run_generator():
+            yield item
+
+    async def remote(self, *args: Any, **kwargs: Any) -> Any:
+        """Call the function remotely and wait for the result."""
+        if self.is_generator:
+            raise InvalidError("use remote_gen() for generator functions")
+        return await self._call_function(args, kwargs)
+
+    async def remote_gen(self, *args: Any, **kwargs: Any) -> AsyncGenerator[Any, None]:
+        if not self.is_generator:
+            raise InvalidError("remote_gen() is only for generator functions")
+        async for item in self._call_function_generator(args, kwargs):
+            yield item
+
+    def local(self, *args: Any, **kwargs: Any) -> Any:
+        """Run the underlying callable locally, bypassing the platform."""
+        if self._info is None or self._info.raw_f is None:
+            raise ExecutionError(f"{self._rep} has no local definition (looked up from server?)")
+        return self._info.raw_f(*args, **kwargs)
+
+    @live_method
+    async def spawn(self, *args: Any, **kwargs: Any) -> "_FunctionCall":
+        """Start the call without waiting; returns a detached handle
+        (reference .spawn, _functions.py)."""
+        invocation = await _Invocation.create(
+            self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_ASYNC
+        )
+        fc = _FunctionCall._new_hydrated(invocation.function_call_id, self.client, None)
+        fc._is_generator = self.is_generator
+        return fc
+
+    def map(
+        self,
+        *input_iterators: Any,
+        kwargs: dict = {},
+        order_outputs: bool = True,
+        return_exceptions: bool = False,
+    ):
+        """Streaming fan-out over inputs (reference parallel_map.py:361)."""
+        from .parallel_map import _map_async, _map_sync
+
+        return _map_sync(
+            self,
+            *input_iterators,
+            kwargs=kwargs,
+            order_outputs=order_outputs,
+            return_exceptions=return_exceptions,
+        )
+
+    def starmap(
+        self,
+        input_iterator: Any,
+        *,
+        kwargs: dict = {},
+        order_outputs: bool = True,
+        return_exceptions: bool = False,
+    ):
+        from .parallel_map import _starmap_sync
+
+        return _starmap_sync(
+            self, input_iterator, kwargs=kwargs, order_outputs=order_outputs, return_exceptions=return_exceptions
+        )
+
+    def for_each(self, *input_iterators: Any, kwargs: dict = {}, ignore_exceptions: bool = False) -> None:
+        from .parallel_map import _for_each_sync
+
+        return _for_each_sync(self, *input_iterators, kwargs=kwargs, ignore_exceptions=ignore_exceptions)
+
+    async def spawn_map(self, *input_iterators: Any, kwargs: dict = {}) -> "_FunctionCall":
+        from .parallel_map import _spawn_map_async
+
+        return await _spawn_map_async(self, *input_iterators, kwargs=kwargs)
+
+    @live_method
+    async def get_current_stats(self) -> api_pb2.FunctionStats:
+        return await retry_transient_errors(
+            self.client.stub.FunctionGetCurrentStats,
+            api_pb2.FunctionGetCurrentStatsRequest(function_id=self.object_id),
+            total_timeout=10.0,
+        )
+
+    @live_method
+    async def update_autoscaler(
+        self,
+        *,
+        min_containers: Optional[int] = None,
+        max_containers: Optional[int] = None,
+        buffer_containers: Optional[int] = None,
+        scaledown_window: Optional[int] = None,
+    ) -> None:
+        settings = api_pb2.AutoscalerSettings(
+            min_containers=min_containers or 0,
+            max_containers=max_containers or 0,
+            buffer_containers=buffer_containers or 0,
+            scaledown_window=scaledown_window or 0,
+        )
+        await retry_transient_errors(
+            self.client.stub.FunctionUpdateSchedulingParams,
+            api_pb2.FunctionUpdateSchedulingParamsRequest(function_id=self.object_id, settings=settings),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Invocation engine
+# ---------------------------------------------------------------------------
+
+
+async def _create_input(
+    args: tuple, kwargs: dict, stub, *, idx: int = 0, method_name: str = ""
+) -> api_pb2.FunctionPutInputsItem:
+    """Serialize (args, kwargs); offload to blob store over the inline limit
+    (reference _create_input, _functions.py)."""
+    data = serialize((args, kwargs))
+    input_pb = api_pb2.FunctionInput(data_format=api_pb2.DATA_FORMAT_PICKLE, method_name=method_name)
+    if len(data) > MAX_OBJECT_SIZE_BYTES:
+        input_pb.args_blob_id = await blob_upload(data, stub)
+    else:
+        input_pb.args = data
+    return api_pb2.FunctionPutInputsItem(idx=idx, input=input_pb)
+
+
+async def _process_result(result: api_pb2.GenericResult, data_format: int, stub, client) -> Any:
+    """Decode a GenericResult into a value or raise (reference
+    _process_result, _functions.py)."""
+    data = await resolve_blob_data(result, stub)
+
+    if result.status == api_pb2.GENERIC_STATUS_TIMEOUT:
+        raise FunctionTimeoutError(result.exception)
+    elif result.status == api_pb2.GENERIC_STATUS_TERMINATED:
+        raise RemoteError(f"function terminated: {result.exception or 'container stopped'}")
+    elif result.status == api_pb2.GENERIC_STATUS_INTERNAL_FAILURE:
+        raise ExecutionError(result.exception)
+    elif result.status != api_pb2.GENERIC_STATUS_SUCCESS:
+        if data:
+            exc = deserialize_exception(data, result.exception, result.traceback, client)
+            raise exc
+        raise RemoteError(result.exception or "remote function failed")
+
+    return deserialize_data_format(data, data_format or api_pb2.DATA_FORMAT_PICKLE, client)
+
+
+class _Invocation:
+    """One function call's client-side state machine (reference
+    _Invocation, _functions.py:122)."""
+
+    def __init__(self, stub, function_call_id: str, client: _Client, input_id: Optional[str] = None):
+        self.stub = stub
+        self.client = client
+        self.function_call_id = function_call_id
+        self.input_id = input_id
+
+    @staticmethod
+    async def create(
+        function: _Function,
+        args: tuple,
+        kwargs: dict,
+        *,
+        client: _Client,
+        invocation_type: int,
+        method_name: str = "",
+    ) -> "_Invocation":
+        stub = client.stub
+        item = await _create_input(args, kwargs, stub, method_name=method_name or function._use_method_name)
+        request = api_pb2.FunctionMapRequest(
+            function_id=function.object_id,
+            function_call_type=api_pb2.FUNCTION_CALL_TYPE_UNARY,
+            pipelined_inputs=[item],
+            invocation_type=invocation_type,
+        )
+        response = await retry_transient_errors(stub.FunctionMap, request)
+        input_id = response.pipelined_inputs[0].input_id if response.pipelined_inputs else None
+        return _Invocation(stub, response.function_call_id, client, input_id)
+
+    async def pop_function_call_outputs(
+        self, timeout: Optional[float], clear_on_success: bool, last_entry_id: str = ""
+    ) -> api_pb2.FunctionGetOutputsResponse:
+        t0 = time.monotonic()
+        while True:
+            remaining = None if timeout is None else timeout - (time.monotonic() - t0)
+            poll_window = OUTPUTS_TIMEOUT if remaining is None else max(0.0, min(remaining, OUTPUTS_TIMEOUT))
+            request = api_pb2.FunctionGetOutputsRequest(
+                function_call_id=self.function_call_id,
+                timeout=poll_window,
+                last_entry_id=last_entry_id,
+                max_values=1,
+                clear_on_success=clear_on_success,
+                requested_at=time.time(),
+            )
+            response = await retry_transient_errors(
+                self.stub.FunctionGetOutputs,
+                request,
+                attempt_timeout=poll_window + 5.0,
+                max_retries=None,
+            )
+            if response.outputs:
+                return response
+            if timeout is not None and (time.monotonic() - t0) >= timeout:
+                return response
+            last_entry_id = response.last_entry_id or last_entry_id
+
+    async def run_function(self) -> Any:
+        response = await self.pop_function_call_outputs(timeout=None, clear_on_success=True)
+        assert response.outputs
+        item = response.outputs[0]
+        return await _process_result(item.result, item.data_format, self.stub, self.client)
+
+    async def poll_function(self, timeout: Optional[float] = None) -> Any:
+        """One bounded poll (used by FunctionCall.get with timeout)."""
+        response = await self.pop_function_call_outputs(timeout=timeout, clear_on_success=False)
+        if not response.outputs:
+            from .exception import TimeoutError as _TimeoutError
+
+            raise _TimeoutError("function call result not ready")
+        item = response.outputs[0]
+        return await _process_result(item.result, item.data_format, self.stub, self.client)
+
+    async def run_generator(self) -> AsyncGenerator[Any, None]:
+        """Stream generator outputs via FunctionCallGetData (reference data
+        chunk streaming)."""
+        last_index = 0
+        done = False
+        while not done:
+            req = api_pb2.FunctionCallGetDataRequest(function_call_id=self.function_call_id, last_index=last_index)
+            async for chunk in self.stub.FunctionCallGetData(req):
+                last_index = chunk.index
+                if chunk.data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
+                    done = True
+                    break
+                data = chunk.data
+                if chunk.data_blob_id:
+                    from ._utils.blob_utils import blob_download
+
+                    data = await blob_download(chunk.data_blob_id, self.stub)
+                yield deserialize_data_format(data, chunk.data_format, self.client)
+            else:
+                await asyncio.sleep(0.01)
+
+
+class _FunctionCall(_Object, type_prefix="fc"):
+    """Detached handle to a running/completed call (reference
+    _FunctionCall, _functions.py:2002)."""
+
+    _is_generator: bool = False
+
+    def _invocation(self) -> _Invocation:
+        return _Invocation(self.client.stub, self.object_id, self.client)
+
+    @live_method
+    async def get(self, timeout: Optional[float] = None) -> Any:
+        if self._is_generator:
+            raise InvalidError("use get_gen() on generator calls")
+        return await self._invocation().poll_function(timeout=timeout) if timeout is not None else await self._invocation().run_function()
+
+    @live_method_gen
+    async def get_gen(self) -> AsyncGenerator[Any, None]:
+        async for item in self._invocation().run_generator():
+            yield item
+
+    @live_method
+    async def get_call_graph(self) -> list:
+        resp = await retry_transient_errors(
+            self.client.stub.FunctionCallGetInfo, api_pb2.FunctionCallGetInfoRequest(function_call_id=self.object_id)
+        )
+        return [resp.info]
+
+    @live_method
+    async def cancel(self, terminate_containers: bool = False) -> None:
+        await retry_transient_errors(
+            self.client.stub.FunctionCallCancel,
+            api_pb2.FunctionCallCancelRequest(
+                function_call_id=self.object_id, terminate_containers=terminate_containers
+            ),
+        )
+
+    @staticmethod
+    async def from_id(function_call_id: str, client: Optional[_Client] = None) -> "_FunctionCall":
+        if client is None:
+            client = await _Client.from_env()
+        return _FunctionCall._new_hydrated(function_call_id, client, None)
+
+    @staticmethod
+    async def gather(*function_calls: "_FunctionCall") -> list[Any]:
+        return await TaskContext.gather(*[fc.get() for fc in function_calls])
+
+
+Function = synchronize_api(_Function)
+FunctionCall = synchronize_api(_FunctionCall)

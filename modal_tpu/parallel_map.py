@@ -1,0 +1,269 @@
+"""Streaming fan-out: .map()/.starmap()/.for_each()/.spawn_map().
+
+Reference: py/modal/parallel_map.py — `_map_invocation` (parallel_map.py:361)
+with concurrent stages: input pump (`SyncInputPumper.pump_inputs`,
+parallel_map.py:173-215, batched FunctionPutInputs), output long-poll
+(`get_all_outputs`, parallel_map.py:446-522, last_entry_id cursor), blob
+fetch, ordered/unordered yield.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import typing
+from typing import Any, AsyncGenerator, AsyncIterable, Iterable, Optional, Union
+
+from ._utils.async_utils import TaskContext, aclosing, queue_batch_iterator, synchronizer, sync_or_async_iter
+from ._utils.blob_utils import resolve_blob_data
+from ._utils.function_utils import OUTPUTS_TIMEOUT
+from ._utils.grpc_utils import retry_transient_errors
+from .config import logger
+from .exception import InvalidError
+from .proto import api_pb2
+from .serialization import deserialize_data_format, deserialize_exception
+
+if typing.TYPE_CHECKING:
+    from .functions import _Function, _FunctionCall
+
+# Input pump batching (reference parallel_map.py:48-50: 8 retries, batched
+# puts, RESOURCE_EXHAUSTED-aware).
+MAP_INPUT_BATCH_SIZE = 100
+MAX_INPUTS_OUTSTANDING = 1000
+
+
+async def _map_invocation(
+    function: "_Function",
+    raw_input_gen: AsyncGenerator[tuple[tuple, dict], None],
+    order_outputs: bool,
+    return_exceptions: bool,
+    *,
+    function_call_id_out: Optional[list] = None,
+    wait_for_outputs: bool = True,
+) -> AsyncGenerator[Any, None]:
+    """The core pipeline: create map call → pump inputs concurrently with
+    polling outputs → yield results."""
+    if not function.is_hydrated:
+        await function.hydrate()
+    client = function.client
+    stub = client.stub
+
+    map_resp = await retry_transient_errors(
+        stub.FunctionMap,
+        api_pb2.FunctionMapRequest(
+            function_id=function.object_id,
+            function_call_type=api_pb2.FUNCTION_CALL_TYPE_MAP,
+            invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC,
+            return_exceptions=return_exceptions,
+        ),
+    )
+    function_call_id = map_resp.function_call_id
+    if function_call_id_out is not None:
+        function_call_id_out.append(function_call_id)
+
+    pump_done = asyncio.Event()
+    inputs_sent = 0
+
+    async def pump_inputs() -> None:
+        nonlocal inputs_sent
+        from .functions import _create_input
+
+        batch: list[api_pb2.FunctionPutInputsItem] = []
+
+        async def _flush() -> None:
+            nonlocal batch
+            if not batch:
+                return
+            req = api_pb2.FunctionPutInputsRequest(
+                function_id=function.object_id, function_call_id=function_call_id, inputs=batch
+            )
+            await retry_transient_errors(
+                stub.FunctionPutInputs,
+                req,
+                max_retries=8,
+                max_delay=15.0,
+                additional_status_codes=[__import__("grpc").StatusCode.RESOURCE_EXHAUSTED],
+            )
+            batch = []
+
+        idx = 0
+        try:
+            async with aclosing(raw_input_gen) as gen:
+                async for args, kwargs in gen:
+                    item = await _create_input(
+                        args, kwargs, stub, idx=idx, method_name=function._use_method_name
+                    )
+                    batch.append(item)
+                    idx += 1
+                    inputs_sent = idx
+                    if len(batch) >= MAP_INPUT_BATCH_SIZE:
+                        await _flush()
+            await _flush()
+        finally:
+            # Always unblock the poll loop — on pump failure it drains what
+            # was sent, then `await pump_task` surfaces the error instead of
+            # the caller hanging in the output long-poll.
+            inputs_sent = idx - len(batch)
+            pump_done.set()
+
+    async def poll_outputs() -> AsyncGenerator[tuple[int, Any], None]:
+        last_entry_id = ""
+        received = 0
+        while True:
+            resp = await retry_transient_errors(
+                stub.FunctionGetOutputs,
+                api_pb2.FunctionGetOutputsRequest(
+                    function_call_id=function_call_id,
+                    timeout=OUTPUTS_TIMEOUT,
+                    last_entry_id=last_entry_id,
+                    max_values=0,
+                    clear_on_success=False,
+                    requested_at=time.time(),
+                ),
+                attempt_timeout=OUTPUTS_TIMEOUT + 5.0,
+                max_retries=None,
+            )
+            last_entry_id = resp.last_entry_id or last_entry_id
+            for item in resp.outputs:
+                received += 1
+                value = await _decode_output(item, stub, client, return_exceptions)
+                yield item.idx, value
+            if pump_done.is_set() and received >= inputs_sent:
+                return
+            if pump_task.done() and pump_task.exception() is not None:
+                raise pump_task.exception()
+
+    async with TaskContext() as tc:
+        pump_task = tc.create_task(pump_inputs())
+        if not wait_for_outputs:
+            await pump_task
+            return
+        if order_outputs:
+            buffer: dict[int, Any] = {}
+            next_idx = 0
+            async for idx, value in poll_outputs():
+                buffer[idx] = value
+                while next_idx in buffer:
+                    yield buffer.pop(next_idx)
+                    next_idx += 1
+        else:
+            async for _idx, value in poll_outputs():
+                yield value
+        # surface pump errors (e.g. serialization failures)
+        await pump_task
+
+
+async def _decode_output(
+    item: api_pb2.FunctionGetOutputsItem, stub, client, return_exceptions: bool
+) -> Any:
+    from .functions import _process_result
+
+    try:
+        return await _process_result(item.result, item.data_format, stub, client)
+    except Exception as exc:
+        if return_exceptions:
+            return exc
+        raise
+
+
+async def _input_gen_from_iterators(
+    *input_iterators: Union[Iterable, AsyncIterable], kwargs: dict, star: bool
+) -> AsyncGenerator[tuple[tuple, dict], None]:
+    if star:
+        assert len(input_iterators) == 1
+        async for item in sync_or_async_iter(input_iterators[0]):
+            if not isinstance(item, (tuple, list)):
+                item = (item,)
+            yield tuple(item), kwargs
+    elif len(input_iterators) == 1:
+        async for item in sync_or_async_iter(input_iterators[0]):
+            yield (item,), kwargs
+    else:
+        # zip semantics over multiple iterators (like builtin map)
+        iters = [sync_or_async_iter(it) for it in input_iterators]
+        while True:
+            args = []
+            for it in iters:
+                try:
+                    args.append(await it.__anext__())
+                except StopAsyncIteration:
+                    return
+            yield tuple(args), kwargs
+
+
+def _map_sync(
+    function: "_Function",
+    *input_iterators: Iterable,
+    kwargs: dict = {},
+    order_outputs: bool = True,
+    return_exceptions: bool = False,
+) -> typing.Generator[Any, None, None]:
+    """Blocking .map() — a sync generator bridged off the synchronizer loop."""
+    gen = _map_invocation(
+        function,
+        _input_gen_from_iterators(*input_iterators, kwargs=kwargs, star=False),
+        order_outputs,
+        return_exceptions,
+    )
+    return synchronizer.run_generator(gen)
+
+
+async def _map_async(
+    function: "_Function",
+    *input_iterators: Union[Iterable, AsyncIterable],
+    kwargs: dict = {},
+    order_outputs: bool = True,
+    return_exceptions: bool = False,
+) -> AsyncGenerator[Any, None]:
+    async for item in _map_invocation(
+        function,
+        _input_gen_from_iterators(*input_iterators, kwargs=kwargs, star=False),
+        order_outputs,
+        return_exceptions,
+    ):
+        yield item
+
+
+def _starmap_sync(
+    function: "_Function",
+    input_iterator: Iterable,
+    *,
+    kwargs: dict = {},
+    order_outputs: bool = True,
+    return_exceptions: bool = False,
+) -> typing.Generator[Any, None, None]:
+    gen = _map_invocation(
+        function,
+        _input_gen_from_iterators(input_iterator, kwargs=kwargs, star=True),
+        order_outputs,
+        return_exceptions,
+    )
+    return synchronizer.run_generator(gen)
+
+
+def _for_each_sync(function: "_Function", *input_iterators: Iterable, kwargs: dict = {}, ignore_exceptions: bool = False) -> None:
+    for _ in _map_sync(
+        function,
+        *input_iterators,
+        kwargs=kwargs,
+        order_outputs=False,
+        return_exceptions=ignore_exceptions,
+    ):
+        pass
+
+
+async def _spawn_map_async(function: "_Function", *input_iterators, kwargs: dict = {}) -> "_FunctionCall":
+    """Pump all inputs, return a detached FunctionCall without waiting."""
+    from .functions import _FunctionCall
+
+    call_id_out: list = []
+    async for _ in _map_invocation(
+        function,
+        _input_gen_from_iterators(*input_iterators, kwargs=kwargs, star=False),
+        order_outputs=False,
+        return_exceptions=False,
+        function_call_id_out=call_id_out,
+        wait_for_outputs=False,
+    ):
+        pass
+    return _FunctionCall._new_hydrated(call_id_out[0], function.client, None)

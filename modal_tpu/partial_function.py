@@ -1,0 +1,272 @@
+"""Decorator flag algebra for methods and lifecycle hooks.
+
+Reference: py/modal/_partial_function.py — `_PartialFunction`
+(_partial_function.py:116), `_PartialFunctionFlags` (_partial_function.py:29),
+decorators `_method/_enter/_exit/_batched/_concurrent/_clustered`
+(_partial_function.py:283,589,617,640,701,780).
+
+A `PartialFunction` wraps a user function inside an `@app.cls` body (or a
+bare function for `@clustered`) and records *how* it should run: as a
+callable method, a lifecycle hook, batched, concurrency-enabled, or
+gang-scheduled on a TPU slice.
+"""
+
+from __future__ import annotations
+
+import enum
+import typing
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .exception import InvalidError
+
+
+class _PartialFunctionFlags(enum.IntFlag):
+    FUNCTION = 1
+    ENTER_PRE_SNAPSHOT = 2
+    ENTER_POST_SNAPSHOT = 4
+    EXIT = 8
+    BATCHED = 16
+    CONCURRENT = 32
+    CLUSTERED = 64
+
+    @staticmethod
+    def all() -> "_PartialFunctionFlags":
+        return ~_PartialFunctionFlags(0)
+
+
+@dataclass
+class _PartialFunctionParams:
+    is_generator: Optional[bool] = None
+    batch_max_size: Optional[int] = None
+    batch_wait_ms: Optional[int] = None
+    max_concurrent_inputs: Optional[int] = None
+    target_concurrent_inputs: Optional[int] = None
+    # clustered (gang) params — TPU-native: a cluster is a pod slice
+    cluster_size: Optional[int] = None
+    broadcast_inputs: bool = True
+    tpu_slice: Optional[str] = None  # e.g. "v5p-64": the whole gang's slice
+    fabric_size: Optional[int] = None
+
+    def update(self, other: "_PartialFunctionParams") -> None:
+        for f in self.__dataclass_fields__:
+            v = getattr(other, f)
+            if v is not None and v != self.__dataclass_fields__[f].default:
+                setattr(self, f, v)
+
+
+class _PartialFunction:
+    """Intermediate decorator state (reference _partial_function.py:116)."""
+
+    def __init__(
+        self,
+        raw_f: Callable,
+        flags: _PartialFunctionFlags,
+        params: Optional[_PartialFunctionParams] = None,
+    ):
+        self.raw_f = raw_f
+        self.flags = flags
+        self.params = params or _PartialFunctionParams()
+        self.wrapped = False  # set when consumed by @app.cls / @app.function
+        self.registered = False
+
+    @property
+    def name(self) -> str:
+        return self.raw_f.__name__
+
+    def add_flags(self, flags: _PartialFunctionFlags, params: Optional[_PartialFunctionParams] = None):
+        import dataclasses
+
+        # The inner partial is consumed by the new one: mark it wrapped so its
+        # __del__ doesn't warn, and copy params so stacked decorators don't
+        # share mutable state.
+        self.wrapped = True
+        new = _PartialFunction(self.raw_f, self.flags | flags, dataclasses.replace(self.params))
+        if params:
+            new.params.update(params)
+        return new
+
+    def __get__(self, obj: Any, objtype: Optional[type] = None) -> Any:
+        # Accessing an un-wrapped partial method on an instance: return the
+        # raw function bound, so local calls still work.
+        if obj is None:
+            return self
+        return self.raw_f.__get__(obj, objtype)
+
+    def __del__(self) -> None:
+        if not self.wrapped and not self.registered:
+            import warnings
+
+            try:
+                warnings.warn(
+                    f"method {self.name} was decorated but never attached to an app class"
+                )
+            except Exception:
+                pass
+
+
+def method(
+    _warn_parentheses_missing: Any = None,
+    *,
+    is_generator: Optional[bool] = None,
+) -> Callable[[Callable], _PartialFunction]:
+    """Mark an `@app.cls` method as remotely callable (reference
+    _partial_function.py:283)."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.method() with parentheses.")
+
+    def wrapper(raw_f: Callable) -> _PartialFunction:
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(
+                _PartialFunctionFlags.FUNCTION, _PartialFunctionParams(is_generator=is_generator)
+            )
+        return _PartialFunction(
+            raw_f, _PartialFunctionFlags.FUNCTION, _PartialFunctionParams(is_generator=is_generator)
+        )
+
+    return wrapper
+
+
+def enter(
+    _warn_parentheses_missing: Any = None,
+    *,
+    snap: bool = False,
+) -> Callable:
+    """Lifecycle hook run at container start (reference
+    _partial_function.py:617). With ``snap=True`` the hook runs *before* the
+    warm-state snapshot is taken (weights load etc. — TPU analogue of the
+    reference's memory-snapshot enter hooks)."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.enter() with parentheses.")
+    flag = _PartialFunctionFlags.ENTER_PRE_SNAPSHOT if snap else _PartialFunctionFlags.ENTER_POST_SNAPSHOT
+
+    def wrapper(raw_f: Callable):
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(flag)
+        return _PartialFunction(raw_f, flag)
+
+    return wrapper
+
+
+def exit(_warn_parentheses_missing: Any = None) -> Callable:  # noqa: A001
+    """Lifecycle hook run at container shutdown (reference
+    _partial_function.py:640)."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.exit() with parentheses.")
+
+    def wrapper(raw_f: Callable):
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(_PartialFunctionFlags.EXIT)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.EXIT)
+
+    return wrapper
+
+
+def batched(
+    _warn_parentheses_missing: Any = None,
+    *,
+    max_batch_size: int,
+    wait_ms: int,
+) -> Callable:
+    """Dynamic input batching (reference _partial_function.py:701): inputs are
+    grouped up to `max_batch_size` or until `wait_ms` lingers, then the user
+    function receives lists. On TPU this is the mechanism that keeps the MXU
+    fed — serving functions should combine it with padded batch shapes so one
+    compiled executable serves all batch sizes."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.batched() with parentheses.")
+    if max_batch_size < 1:
+        raise InvalidError("max_batch_size must be >= 1")
+    if wait_ms < 0:
+        raise InvalidError("wait_ms must be >= 0")
+
+    def wrapper(raw_f: Callable):
+        params = _PartialFunctionParams(batch_max_size=max_batch_size, batch_wait_ms=wait_ms)
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(_PartialFunctionFlags.BATCHED, params)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.FUNCTION | _PartialFunctionFlags.BATCHED, params)
+
+    return wrapper
+
+
+def concurrent(
+    _warn_parentheses_missing: Any = None,
+    *,
+    max_inputs: int,
+    target_inputs: Optional[int] = None,
+) -> Callable:
+    """Input concurrency within one container (reference
+    _partial_function.py:640 `_concurrent`)."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.concurrent() with parentheses.")
+    if target_inputs and target_inputs > max_inputs:
+        raise InvalidError("target_inputs must be <= max_inputs")
+
+    def wrapper(raw_f: Callable):
+        params = _PartialFunctionParams(
+            max_concurrent_inputs=max_inputs, target_concurrent_inputs=target_inputs or max_inputs
+        )
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(_PartialFunctionFlags.CONCURRENT, params)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.FUNCTION | _PartialFunctionFlags.CONCURRENT, params)
+
+    return wrapper
+
+
+def clustered(
+    size: int,
+    broadcast_inputs: bool = True,
+    tpu_slice: Optional[str] = None,
+    fabric_size: Optional[int] = None,
+) -> Callable:
+    """Gang-schedule `size` containers per input on one TPU pod slice.
+
+    TPU-native redesign of the reference's
+    `@modal.experimental.clustered(size, broadcast, rdma, fabric_size)`
+    (_partial_function.py:780-827): instead of i6pn+NCCL rendezvous, the gang
+    maps to the hosts of a pod slice, the control plane hands out ranks and a
+    coordinator address at TaskClusterHello, and the container entrypoint
+    calls `jax.distributed.initialize` before user code runs. `fabric_size`
+    constrains how many chips must share a single ICI torus (the analogue of
+    the reference's NVLink-fabric block constraint).
+    """
+    if size < 1:
+        raise InvalidError("cluster size must be >= 1")
+
+    def wrapper(raw_f: Callable):
+        params = _PartialFunctionParams(
+            cluster_size=size,
+            broadcast_inputs=broadcast_inputs,
+            tpu_slice=tpu_slice,
+            fabric_size=fabric_size,
+        )
+        if isinstance(raw_f, _PartialFunction):
+            if not (raw_f.flags & _PartialFunctionFlags.FUNCTION):
+                raise InvalidError("@clustered must wrap a function or @method")
+            return raw_f.add_flags(_PartialFunctionFlags.CLUSTERED, params)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.FUNCTION | _PartialFunctionFlags.CLUSTERED, params)
+
+    return wrapper
+
+
+def find_partial_methods_for_user_cls(user_cls: type, flags: int) -> dict[str, _PartialFunction]:
+    """Grab all partial methods matching `flags` from a user class body
+    (reference _partial_function.py find_partial_methods_for_user_cls)."""
+    out: dict[str, _PartialFunction] = {}
+    for parent_cls in reversed(user_cls.__mro__):
+        if parent_cls is object:
+            continue
+        for k, v in vars(parent_cls).items():
+            if isinstance(v, _PartialFunction) and (v.flags & flags):
+                v.registered = True
+                out[k] = v
+    return out
+
+
+def find_callables_for_obj(user_obj: Any, flags: int) -> dict[str, Callable]:
+    """Bound callables for lifecycle hook execution."""
+    user_cls = type(user_obj)
+    return {
+        k: pf.raw_f.__get__(user_obj)
+        for k, pf in find_partial_methods_for_user_cls(user_cls, flags).items()
+    }

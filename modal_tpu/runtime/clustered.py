@@ -1,0 +1,114 @@
+"""Cluster (gang) initialization inside the container.
+
+Reference: py/modal/_clustered_functions.py — `ClusterInfo` (:12),
+`_initialize_clustered_function` (:41): resolve own address, NCCL env setup,
+TaskClusterHello → rank/peers.
+
+TPU-native redesign: no NCCL. The rendezvous returns rank, coordinator
+address, and the slice topology; we call `jax.distributed.initialize` with
+them, so XLA collectives ride ICI within the slice and DCN across slices.
+`get_cluster_info()` exposes rank/peers exactly like the reference API;
+`get_fabric_peers()` returns same-ICI-domain peers (reference
+_clustered_functions.py:33-38 returns same-NVLink-fabric peers).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .._utils.async_utils import synchronizer
+from .._utils.grpc_utils import retry_transient_errors
+from ..client import _Client
+from ..config import logger
+from ..exception import ClusterError
+from ..proto import api_pb2
+
+
+@dataclass
+class ClusterInfo:
+    rank: int = 0
+    world_size: int = 1
+    container_ips: list[str] = field(default_factory=list)
+    coordinator_address: str = ""
+    cluster_id: str = ""
+    tpu_type: str = ""
+    topology: str = ""
+    num_hosts: int = 1
+    chips_per_host: int = 0
+    default_mesh: dict[str, int] = field(default_factory=dict)
+
+
+_cluster_info: Optional[ClusterInfo] = None
+
+
+def get_cluster_info() -> ClusterInfo:
+    """Rank/peer info for the current container (reference
+    get_cluster_info)."""
+    if _cluster_info is None:
+        return ClusterInfo()  # single-container default, like the reference
+    return _cluster_info
+
+
+def get_fabric_peers() -> list[str]:
+    """Peers sharing this container's ICI domain (TPU analogue of the
+    reference's NVLink-fabric peer query, _clustered_functions.py:33)."""
+    info = get_cluster_info()
+    return list(info.container_ips)
+
+
+def _own_address() -> str:
+    try:
+        hostname = socket.gethostname()
+        return socket.gethostbyname(hostname)
+    except OSError:
+        return "127.0.0.1"
+
+
+async def init_cluster(container_args: api_pb2.ContainerArguments, client: _Client) -> ClusterInfo:
+    """Rendezvous + jax.distributed.initialize. Must run before the first jax
+    import in user code; awaited on the entrypoint's own loop (the client's
+    channel lives there)."""
+    global _cluster_info
+
+    resp = await retry_transient_errors(
+        client.stub.TaskClusterHello,
+        api_pb2.TaskClusterHelloRequest(
+            task_id=container_args.task_id, container_address=_own_address()
+        ),
+        attempt_timeout=150.0,
+        max_retries=2,
+    )
+    info = ClusterInfo(
+        rank=resp.rank,
+        world_size=resp.world_size,
+        container_ips=list(resp.peer_addresses),
+        coordinator_address=resp.coordinator_address,
+        cluster_id=resp.cluster_id,
+        tpu_type=resp.slice_info.tpu_type,
+        topology=resp.slice_info.topology,
+        num_hosts=resp.slice_info.num_hosts or resp.world_size,
+        chips_per_host=resp.slice_info.chips_per_host,
+        default_mesh=dict(resp.slice_info.default_mesh),
+    )
+    _cluster_info = info
+    logger.info(
+        f"cluster rendezvous complete: rank={info.rank}/{info.world_size} "
+        f"coordinator={info.coordinator_address} slice={info.tpu_type}:{info.topology}"
+    )
+
+    if info.world_size > 1 and os.environ.get("MODAL_TPU_SKIP_JAX_DISTRIBUTED") != "1":
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=info.coordinator_address,
+            num_processes=info.world_size,
+            process_id=info.rank,
+        )
+        logger.info(
+            f"jax.distributed initialized: process {jax.process_index()}/{jax.process_count()}, "
+            f"{len(jax.devices())} global devices"
+        )
+    return info

@@ -1,0 +1,269 @@
+"""Container entrypoint: the process the worker execs.
+
+Reference: py/modal/_container_entrypoint.py — `main` (:468), `run_function`
+(:422), `call_function` (:114); bootstrap from ContainerArguments at
+MODAL_CONTAINER_ARGUMENTS_PATH (:475-490); clustered init hook (:451-457).
+
+TPU-first: for gang functions this is where `jax.distributed.initialize` runs
+— BEFORE user code imports jax — using rank/coordinator from the
+TaskClusterHello rendezvous (replacing the reference's i6pn/NCCL env
+bootstrap, _clustered_functions.py:41-83). The persistent XLA compilation
+cache is enabled here so warm restarts skip compilation (the TPU analogue of
+the reference's CRIU memory snapshots for cold-start elimination).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import sys
+import time
+import traceback
+from typing import Any, Optional
+
+from ..client import _Client
+from ..config import config, logger
+from ..exception import ExecutionError
+from ..proto import api_pb2
+from .._utils.grpc_utils import retry_transient_errors
+from ..serialization import deserialize
+from . import execution_context
+from .io_manager import ContainerIOManager, IOContext
+from .user_code import Service, import_class_service, import_single_function_service
+
+
+def load_container_arguments() -> api_pb2.ContainerArguments:
+    path = os.environ.get("MODAL_TPU_CONTAINER_ARGS_PATH")
+    if not path:
+        raise ExecutionError("MODAL_TPU_CONTAINER_ARGS_PATH not set — not a container environment")
+    with open(path, "rb") as f:
+        return api_pb2.ContainerArguments.FromString(f.read())
+
+
+def setup_compilation_cache() -> None:
+    """Persistent XLA compilation cache: compiled executables survive across
+    container restarts (cold-start elimination, SURVEY §7 hard part 2)."""
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or config["compilation_cache_dir"]
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    except OSError:
+        pass
+
+
+async def initialize_clustered(container_args: api_pb2.ContainerArguments, client: _Client) -> Optional[Any]:
+    """Gang rendezvous + jax.distributed.initialize (replaces reference
+    initialize_clustered_function, _clustered_functions.py:41)."""
+    from .clustered import init_cluster
+
+    return await init_cluster(container_args, client)
+
+
+async def run_lifecycle_hooks(hooks: list, name: str) -> None:
+    for hook in hooks:
+        logger.debug(f"running {name} hook {getattr(hook, '__name__', hook)}")
+        res = hook()
+        if inspect.isawaitable(res):
+            await res
+
+
+async def call_user_code(service: Service, ctx: IOContext, io: ContainerIOManager) -> list[api_pb2.GenericResult]:
+    """Run one IOContext (single input or batch) to results (reference
+    call_function, _container_entrypoint.py:114)."""
+    callable_ = service.get_callable(ctx.method_name)
+    is_gen = service.is_gen(ctx.method_name)
+    args, kwargs = ctx.batched_args_kwargs()
+    t0 = time.monotonic()
+    try:
+        if is_gen:
+            # stream items to the data channel; the unary output records DONE
+            count = 0
+            gen = callable_(*args, **kwargs)
+            if hasattr(gen, "__aiter__"):
+                async for item in gen:
+                    await io.push_generator_data(ctx.function_call_ids[0], item)
+                    count += 1
+            else:
+                for item in gen:
+                    await io.push_generator_data(ctx.function_call_ids[0], item)
+                    count += 1
+                    await asyncio.sleep(0)
+            await io.push_generator_done(ctx.function_call_ids[0], count)
+            done = api_pb2.GeneratorDone(items_total=count)
+            result = api_pb2.GenericResult(
+                status=api_pb2.GENERIC_STATUS_SUCCESS,
+                data=done.SerializeToString(),
+                data_format=api_pb2.DATA_FORMAT_GENERATOR_DONE,
+            )
+            return [result]
+        else:
+            if inspect.iscoroutinefunction(callable_):
+                value = await callable_(*args, **kwargs)
+            else:
+                value = await asyncio.to_thread(callable_, *args, **kwargs)
+            io.note_call_time(time.monotonic() - t0)
+            if ctx.is_batch:
+                if not isinstance(value, (list, tuple)) or len(value) != len(ctx.input_ids):
+                    raise ExecutionError(
+                        f"@batched function must return a list with one item per input "
+                        f"({len(ctx.input_ids)} inputs, got {type(value).__name__})"
+                    )
+                return [await io.format_result(v) for v in value]
+            return [await io.format_result(value)]
+    except BaseException as exc:  # noqa: BLE001 — every failure becomes a result
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            raise
+        logger.debug(f"user code raised: {type(exc).__name__}: {exc}")
+        err = io.format_exception(exc)
+        return [err for _ in ctx.input_ids]
+
+
+async def run_input_loop(service: Service, io: ContainerIOManager) -> None:
+    """Concurrent input execution under slots (reference run_inputs_outputs,
+    container_io_manager.py:845)."""
+    async with asyncio.TaskGroup() as tg:  # structured: all inputs finish before exit
+
+        async def _run_one(ctx: IOContext) -> None:
+            reset = execution_context._set_current_context_ids(
+                ctx.input_ids[0], ctx.function_call_ids[0]
+            )
+            try:
+                task = asyncio.current_task()
+                for iid in ctx.input_ids:
+                    io._running_tasks[iid] = task
+                results = await call_user_code(service, ctx, io)
+                await io.push_outputs(ctx, results)
+            except asyncio.CancelledError:
+                # input cancelled mid-flight: report TERMINATED
+                results = [
+                    api_pb2.GenericResult(
+                        status=api_pb2.GENERIC_STATUS_TERMINATED, exception="input cancelled"
+                    )
+                    for _ in ctx.input_ids
+                ]
+                try:
+                    await asyncio.shield(io.push_outputs(ctx, results))
+                except Exception:
+                    pass
+            finally:
+                for iid in ctx.input_ids:
+                    io._running_tasks.pop(iid, None)
+                reset()
+
+        async for ctx in io.generate_inputs():
+            tg.create_task(_run_one(ctx))
+
+
+async def main_async() -> int:
+    container_args = load_container_arguments()
+    task_id = container_args.task_id
+    function_def = container_args.function_def
+    config.override_locally("task_id", task_id)
+    execution_context._set_container_process()
+    setup_compilation_cache()
+
+    client = _Client(
+        container_args.server_url or config["server_url"], api_pb2.CLIENT_TYPE_CONTAINER
+    )
+    await client._open()
+    _Client.set_env_client(client)
+
+    await retry_transient_errors(
+        client.stub.ContainerHello,
+        api_pb2.ContainerHelloRequest(task_id=task_id),
+        max_retries=5,
+    )
+
+    io = ContainerIOManager(client, task_id, function_def)
+    io._function_id = container_args.function_id
+    heartbeat_task = asyncio.create_task(io.heartbeat_loop(), name="heartbeat")
+
+    exit_status = api_pb2.GENERIC_STATUS_SUCCESS
+    exit_exception = ""
+    service: Optional[Service] = None
+    try:
+        # Gang functions: rendezvous + jax.distributed BEFORE user imports
+        # (reference hook point: _container_entrypoint.py:451-457).
+        if function_def.group_size > 1 or container_args.world_size > 1:
+            await initialize_clustered(container_args, client)
+
+        # import user code + instantiate service
+        bound_params = None
+        if os.environ.get("MODAL_TPU_BOUND_PARAMS"):
+            bound_params = deserialize(bytes.fromhex(os.environ["MODAL_TPU_BOUND_PARAMS"]), client)
+        if function_def.is_class:
+            service = import_class_service(function_def, client, bound_params)
+        else:
+            service = import_single_function_service(function_def, client)
+
+        # lifecycle: enter hooks (pre-snapshot = warm weight load)
+        await run_lifecycle_hooks(service.enter_pre_snapshot, "enter(snap=True)")
+        if function_def.enable_memory_snapshot:
+            # TPU warm-state snapshot point: compiled executables are in the
+            # persistent cache; notify the control plane (analogue of the
+            # reference's ContainerCheckpoint → CRIU flow).
+            await retry_transient_errors(
+                client.stub.ContainerCheckpoint,
+                api_pb2.ContainerCheckpointRequest(task_id=task_id, checkpoint_id=""),
+                max_retries=2,
+            )
+        await run_lifecycle_hooks(service.enter_post_snapshot, "enter")
+
+        await run_input_loop(service, io)
+    except BaseException as exc:
+        if isinstance(exc, (KeyboardInterrupt,)):
+            exit_status = api_pb2.GENERIC_STATUS_TERMINATED
+            exit_exception = "interrupted"
+        else:
+            exit_status = api_pb2.GENERIC_STATUS_FAILURE
+            exit_exception = f"{type(exc).__name__}: {exc}"
+            traceback.print_exc()
+    finally:
+        io.terminate = True
+        if service is not None:
+            try:
+                await run_lifecycle_hooks(service.exit_hooks, "exit")
+            except Exception:
+                traceback.print_exc()
+        # volume auto-commit on shutdown (reference
+        # task_lifecycle_manager.py:117)
+        for _path, _vol_id in function_def.volume_mounts.items():
+            try:
+                await retry_transient_errors(
+                    client.stub.VolumeCommit, api_pb2.VolumeCommitRequest(volume_id=_vol_id), max_retries=1
+                )
+            except Exception:
+                pass
+        try:
+            await retry_transient_errors(
+                client.stub.TaskResult,
+                api_pb2.TaskResultRequest(
+                    task_id=task_id,
+                    result=api_pb2.GenericResult(status=exit_status, exception=exit_exception),
+                ),
+                max_retries=2,
+            )
+        except Exception:
+            pass
+        heartbeat_task.cancel()
+        try:
+            await heartbeat_task
+        except asyncio.CancelledError:
+            pass
+        await client._close()
+    return 0 if exit_status == api_pb2.GENERIC_STATUS_SUCCESS else 1
+
+
+def main() -> None:
+    # Run the entrypoint's async main on the synchronizer loop: all SDK
+    # coroutines (which the dual-surface wrappers pin to that loop) then run
+    # natively, and grpc channels stay loop-affine.
+    from .._utils.async_utils import synchronizer
+
+    sys.exit(synchronizer.run(main_async()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Execution context: contextvars for the currently-running input.
+
+Reference: py/modal/_runtime/execution_context.py — `is_local`
+(execution_context.py:13), `current_input_id`/`current_function_call_id`
+(execution_context.py:40).
+"""
+
+from __future__ import annotations
+
+from contextvars import ContextVar
+from typing import Optional
+
+_current_input_id: ContextVar[Optional[str]] = ContextVar("input_id", default=None)
+_current_function_call_id: ContextVar[Optional[str]] = ContextVar("function_call_id", default=None)
+_is_container: ContextVar[bool] = ContextVar("is_container", default=False)
+
+_container_process = False
+
+
+def _set_container_process() -> None:
+    global _container_process
+    _container_process = True
+
+
+def is_local() -> bool:
+    """True when running on the user's machine, False inside a container."""
+    return not _container_process
+
+
+def current_input_id() -> Optional[str]:
+    return _current_input_id.get()
+
+
+def current_function_call_id() -> Optional[str]:
+    return _current_function_call_id.get()
+
+
+def _set_current_context_ids(input_id: Optional[str], function_call_id: Optional[str]):
+    t1 = _current_input_id.set(input_id)
+    t2 = _current_function_call_id.set(function_call_id)
+
+    def reset() -> None:
+        _current_input_id.reset(t1)
+        _current_function_call_id.reset(t2)
+
+    return reset

@@ -1,0 +1,284 @@
+"""ContainerIOManager: heartbeats, input loop, batching, concurrency, outputs.
+
+Reference: py/modal/_runtime/container_io_manager.py — `_ContainerIOManager`
+(container_io_manager.py:463), heartbeat/cancellation loop
+(container_io_manager.py:577-643), `_generate_inputs` input fetch loop
+(container_io_manager.py:788-843), `InputSlots` (container_io_manager.py:417),
+`IOContext` batch assembly (container_io_manager.py:55,145-211), output
+batching ≤20/RPC (container_io_manager.py:870-885).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, AsyncGenerator, Callable, Optional
+
+from .._utils.async_utils import ConcurrencySemaphore, TaskContext
+from .._utils.blob_utils import MAX_OBJECT_SIZE_BYTES, blob_upload, resolve_blob_data
+from .._utils.grpc_utils import retry_transient_errors
+from ..client import _Client
+from ..config import config, logger
+from ..exception import InputCancellation
+from ..proto import api_pb2
+from ..serialization import deserialize, serialize, serialize_exception
+from . import execution_context
+
+MAX_OUTPUT_BATCH_SIZE = 20  # reference container_io_manager.py:874
+
+
+@dataclass
+class IOContext:
+    """One unit of user work: a single input, or a batch of inputs assembled
+    for a @batched function (reference IOContext, container_io_manager.py:55)."""
+
+    input_ids: list[str]
+    function_call_ids: list[str]
+    idxs: list[int]
+    retry_counts: list[int]
+    inputs: list[tuple[tuple, dict]]  # deserialized (args, kwargs) per input
+    method_name: str = ""
+    _cancelled: bool = False
+
+    @property
+    def is_batch(self) -> bool:
+        return len(self.input_ids) > 1
+
+    def batched_args_kwargs(self) -> tuple[tuple, dict]:
+        """Assemble per-parameter lists for @batched functions (reference
+        _args_and_kwargs, container_io_manager.py:145-211): each positional/
+        keyword argument becomes a list with one element per input."""
+        if not self.is_batch:
+            return self.inputs[0]
+        n_args = max(len(a) for a, _ in self.inputs)
+        args_lists: list[list] = [[] for _ in range(n_args)]
+        kwargs_lists: dict[str, list] = {}
+        all_keys: set[str] = set()
+        for _, kw in self.inputs:
+            all_keys.update(kw.keys())
+        for a, kw in self.inputs:
+            for i in range(n_args):
+                args_lists[i].append(a[i] if i < len(a) else None)
+            for k in all_keys:
+                kwargs_lists.setdefault(k, []).append(kw.get(k))
+        return tuple(args_lists), kwargs_lists
+
+
+class ContainerIOManager:
+    """Process-singleton owning the container's data plane."""
+
+    _singleton: Optional["ContainerIOManager"] = None
+
+    def __init__(self, client: _Client, task_id: str, function_def: api_pb2.Function):
+        self.client = client
+        self.stub = client.stub
+        self.task_id = task_id
+        self.function_def = function_def
+        self.current_input_ids: set[str] = set()
+        self.cancelled_input_ids: set[str] = set()
+        self._running_tasks: dict[str, asyncio.Task] = {}
+        self.terminate = False
+        self._waiting_for_checkpoint = False
+        self.heartbeat_condition = asyncio.Condition()
+        max_conc = function_def.max_concurrent_inputs or 1
+        self.input_slots = ConcurrencySemaphore(max_conc)
+        self.average_call_time = 0.0
+        self._calls_completed = 0
+        ContainerIOManager._singleton = self
+
+    @classmethod
+    def singleton(cls) -> Optional["ContainerIOManager"]:
+        return cls._singleton
+
+    # -- heartbeats ---------------------------------------------------------
+
+    async def heartbeat_loop(self) -> None:
+        """Heartbeat doubles as the cancellation channel (reference
+        container_io_manager.py:577-643)."""
+        interval = float(config.get("heartbeat_interval")) / 3
+        while not self.terminate:
+            try:
+                resp = await retry_transient_errors(
+                    self.stub.ContainerHeartbeat,
+                    api_pb2.ContainerHeartbeatRequest(
+                        task_id=self.task_id, supports_graceful_input_cancellation=True
+                    ),
+                    attempt_timeout=10.0,
+                    max_retries=2,
+                )
+                if resp.HasField("cancel_input_event"):
+                    event = resp.cancel_input_event
+                    if event.terminate_containers:
+                        self.terminate = True
+                    if event.input_ids:
+                        self._cancel_inputs(set(event.input_ids))
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                logger.warning(f"heartbeat failed: {type(exc).__name__}: {exc}")
+            await asyncio.sleep(max(1.0, interval))
+
+    def _cancel_inputs(self, input_ids: set[str]) -> None:
+        """Cancel running/pending inputs (reference IOContext.cancel →
+        SIGUSR1/task.cancel; here: asyncio cancellation of the input task)."""
+        for input_id in input_ids & set(self._running_tasks.keys()):
+            logger.debug(f"cancelling input {input_id}")
+            self._running_tasks[input_id].cancel()
+        self.cancelled_input_ids |= input_ids
+
+    # -- input loop ---------------------------------------------------------
+
+    async def generate_inputs(self) -> AsyncGenerator[IOContext, None]:
+        """The hot loop: acquire a slot → FunctionGetInputs (long-poll) →
+        assemble IOContext (reference _generate_inputs,
+        container_io_manager.py:788-843). Exits on kill_switch or after
+        scaledown_window idle."""
+        scaledown = self.function_def.autoscaler_settings.scaledown_window or 60
+        batch_max = self.function_def.batch_max_size or 1
+        idle_since = time.monotonic()
+        while not self.terminate:
+            await self.input_slots.acquire()
+            slot_held = True
+            try:
+                request = api_pb2.FunctionGetInputsRequest(
+                    function_id="",  # filled below; def carries no id — use env
+                    task_id=self.task_id,
+                    max_values=batch_max,
+                    average_call_time=self.average_call_time,
+                    input_concurrency=self.input_slots.value,
+                    batch_max_size=self.function_def.batch_max_size,
+                    batch_linger_ms=self.function_def.batch_linger_ms,
+                )
+                request.function_id = self._function_id
+                resp = await retry_transient_errors(
+                    self.stub.FunctionGetInputs, request, attempt_timeout=15.0, max_retries=None
+                )
+                if resp.rate_limit_sleep_duration:
+                    await asyncio.sleep(resp.rate_limit_sleep_duration)
+                items = [i for i in resp.inputs]
+                if any(i.kill_switch for i in items):
+                    logger.debug("kill switch received; draining")
+                    self.terminate = True
+                    return
+                if not items:
+                    if (
+                        time.monotonic() - idle_since > scaledown
+                        and not self.current_input_ids
+                        and self._min_containers_satisfied()
+                    ):
+                        logger.debug(f"idle for {scaledown}s; scaling down")
+                        return
+                    continue
+                idle_since = time.monotonic()
+                # deserialize up front (blob-aware)
+                ctx_inputs: list[tuple[tuple, dict]] = []
+                method_name = ""
+                for item in items:
+                    raw = item.input.args
+                    if item.input.args_blob_id:
+                        from .._utils.blob_utils import blob_download
+
+                        raw = await blob_download(item.input.args_blob_id, self.stub)
+                    args, kwargs = deserialize(raw, self.client) if raw else ((), {})
+                    ctx_inputs.append((args, kwargs))
+                    method_name = item.input.method_name or method_name
+                ctx = IOContext(
+                    input_ids=[i.input_id for i in items],
+                    function_call_ids=[i.function_call_id for i in items],
+                    idxs=[i.idx for i in items],
+                    retry_counts=[i.retry_count for i in items],
+                    inputs=ctx_inputs,
+                    method_name=method_name,
+                )
+                self.current_input_ids |= set(ctx.input_ids)
+                slot_held = False  # transferred to the runner
+                yield ctx
+            finally:
+                if slot_held:
+                    self.input_slots.release()
+
+    def _min_containers_satisfied(self) -> bool:
+        # v0: always allow scaledown; min_containers is re-satisfied by the
+        # control-plane autoscaler relaunching.
+        return True
+
+    _function_id: str = ""
+
+    # -- outputs ------------------------------------------------------------
+
+    async def push_outputs(self, ctx: IOContext, results: list[api_pb2.GenericResult]) -> None:
+        items = []
+        for i, result in enumerate(results):
+            items.append(
+                api_pb2.FunctionPutOutputsItem(
+                    input_id=ctx.input_ids[i],
+                    result=result,
+                    idx=ctx.idxs[i],
+                    function_call_id=ctx.function_call_ids[i],
+                    data_format=result.data_format,
+                    output_created_at=time.time(),
+                    retry_count=ctx.retry_counts[i],
+                )
+            )
+        for start in range(0, len(items), MAX_OUTPUT_BATCH_SIZE):
+            await retry_transient_errors(
+                self.stub.FunctionPutOutputs,
+                api_pb2.FunctionPutOutputsRequest(outputs=items[start : start + MAX_OUTPUT_BATCH_SIZE]),
+                max_retries=None,
+                additional_status_codes=[],
+            )
+        self.current_input_ids -= set(ctx.input_ids)
+        self.input_slots.release()
+
+    async def format_result(self, value: Any, data_format: int = api_pb2.DATA_FORMAT_PICKLE) -> api_pb2.GenericResult:
+        data = serialize(value)
+        result = api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS, data_format=data_format)
+        if len(data) > MAX_OBJECT_SIZE_BYTES:
+            result.data_blob_id = await blob_upload(data, self.stub)
+        else:
+            result.data = data
+        return result
+
+    def format_exception(self, exc: BaseException) -> api_pb2.GenericResult:
+        if isinstance(exc, (asyncio.CancelledError, InputCancellation)):
+            return api_pb2.GenericResult(
+                status=api_pb2.GENERIC_STATUS_TERMINATED, exception="input cancelled"
+            )
+        data, exc_repr, tb_str = serialize_exception(exc)
+        return api_pb2.GenericResult(
+            status=api_pb2.GENERIC_STATUS_FAILURE,
+            exception=exc_repr,
+            traceback=tb_str,
+            data=data,
+            data_format=api_pb2.DATA_FORMAT_PICKLE,
+        )
+
+    async def push_generator_data(self, function_call_id: str, value: Any) -> None:
+        data = serialize(value)
+        chunk = api_pb2.DataChunk(data_format=api_pb2.DATA_FORMAT_PICKLE)
+        if len(data) > MAX_OBJECT_SIZE_BYTES:
+            chunk.data_blob_id = await blob_upload(data, self.stub)
+        else:
+            chunk.data = data
+        await retry_transient_errors(
+            self.stub.FunctionCallPutData,
+            api_pb2.FunctionCallPutDataRequest(function_call_id=function_call_id, data_chunks=[chunk]),
+        )
+
+    async def push_generator_done(self, function_call_id: str, items_total: int) -> None:
+        done = api_pb2.GeneratorDone(items_total=items_total)
+        chunk = api_pb2.DataChunk(
+            data_format=api_pb2.DATA_FORMAT_GENERATOR_DONE, data=done.SerializeToString()
+        )
+        await retry_transient_errors(
+            self.stub.FunctionCallPutData,
+            api_pb2.FunctionCallPutDataRequest(function_call_id=function_call_id, data_chunks=[chunk]),
+        )
+
+    def note_call_time(self, dt: float) -> None:
+        self._calls_completed += 1
+        alpha = 1.0 / min(self._calls_completed, 100)
+        self.average_call_time = (1 - alpha) * self.average_call_time + alpha * dt

@@ -1,0 +1,22 @@
+"""Standalone control plane: ``python -m modal_tpu.server --port 9900 --workers 1``."""
+
+import argparse
+import asyncio
+
+from .supervisor import serve_forever
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="modal_tpu control plane + local workers")
+    parser.add_argument("--port", type=int, default=9900)
+    parser.add_argument("--workers", type=int, default=1)
+    parser.add_argument("--state-dir", type=str, default=None)
+    args = parser.parse_args()
+    try:
+        asyncio.run(serve_forever(port=args.port, num_workers=args.workers, state_dir=args.state_dir))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

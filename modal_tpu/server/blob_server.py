@@ -1,0 +1,56 @@
+"""HTTP blob store: large payloads bypass gRPC (reference test fixture:
+blob_server_factory, conftest.py:4080-4218; production analogue of S3
+presigned URLs)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..config import logger
+from .state import ServerState
+
+
+class BlobServer:
+    def __init__(self, state: ServerState, host: str = "127.0.0.1", port: int = 0):
+        self.state = state
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> str:
+        app = web.Application(client_max_size=8 * 1024 * 1024 * 1024)
+        app.router.add_put("/blob/{blob_id}", self._put)
+        app.router.add_get("/blob/{blob_id}", self._get)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        url = f"http://{self.host}:{self.port}"
+        self.state.blob_url_base = url
+        return url
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _put(self, request: web.Request) -> web.Response:
+        blob_id = request.match_info["blob_id"]
+        path = self.state.blob_path(blob_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            async for chunk in request.content.iter_chunked(1024 * 1024):
+                f.write(chunk)
+        os.replace(tmp, path)
+        return web.Response(status=200)
+
+    async def _get(self, request: web.Request) -> web.StreamResponse:
+        blob_id = request.match_info["blob_id"]
+        path = self.state.blob_path(blob_id)
+        if not os.path.exists(path):
+            return web.Response(status=404, text="blob not found")
+        return web.FileResponse(path)

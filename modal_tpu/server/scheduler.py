@@ -1,0 +1,268 @@
+"""Scheduler: autoscaling + TPU gang placement.
+
+Net-new relative to the reference (its scheduler is closed server-side;
+SURVEY §7 hard part 1). Responsibilities:
+
+- **Autoscaling**: per function, keep `desired = clamp(backlog + buffer,
+  min_containers, max_containers)` containers running; idle containers drain
+  themselves after `scaledown_window` (the container input loop exits).
+- **Chip placement**: a task requiring N chips is pinned to N free chip ids on
+  one worker (`TPU_VISIBLE_DEVICES`-style isolation).
+- **Gang scheduling** (`group_size > 1`): all gang members are allocated
+  atomically — one per host of the pod slice — before any is launched, and
+  torn down together (one host fails ⇒ gang fails). The gang shares a
+  `cluster_id`; TaskClusterHello blocks until every rank reports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..config import logger
+from ..proto import api_pb2
+from ..tpu_config import parse_tpu_config, slice_info_proto
+from .state import ClusterState, FunctionState, ServerState, TaskState_, WorkerState, make_id
+
+SCHEDULE_INTERVAL = 0.05
+# Containers whose heartbeat is this stale are considered dead (reference
+# unhealthy threshold: 50 × heartbeat_interval, container_io_manager.py:605;
+# locally we use a much tighter bound).
+TASK_HEARTBEAT_TIMEOUT = 120.0
+
+
+class Scheduler:
+    def __init__(self, state: ServerState, servicer=None):
+        self.s = state
+        self.servicer = servicer  # for shared task-failure handling
+        self._task: Optional[asyncio.Task] = None
+        self._last_reap = 0.0
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="scheduler")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                self.s.schedule_event.clear()
+                await self._schedule_once()
+                if time.time() - self._last_reap > 10.0:
+                    self._last_reap = time.time()
+                    await self.reap_dead_tasks()
+            except Exception:
+                logger.exception("scheduler iteration failed")
+            try:
+                await asyncio.wait_for(self.s.schedule_event.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+            await asyncio.sleep(SCHEDULE_INTERVAL)
+
+    async def _schedule_once(self) -> None:
+        for fn in list(self.s.functions.values()):
+            app = self.s.apps.get(fn.app_id)
+            if app is not None and app.done:
+                continue
+            backlog = sum(1 for iid in fn.pending if self.s.inputs[iid].status == "pending")
+            settings = fn.autoscaler
+            live = [
+                tid
+                for tid in fn.task_ids
+                if self.s.tasks[tid].state
+                in (
+                    api_pb2.TASK_STATE_QUEUED,
+                    api_pb2.TASK_STATE_WORKER_ASSIGNED,
+                    api_pb2.TASK_STATE_CREATED,
+                    api_pb2.TASK_STATE_ACTIVE,
+                    api_pb2.TASK_STATE_IDLE,
+                )
+            ]
+            group_size = fn.definition.group_size or 0
+            if group_size > 1:
+                # one gang per pending input, at most one gang live at a time
+                # per function (v0 policy)
+                if backlog > 0 and not live:
+                    await self._launch_gang(fn, group_size)
+                continue
+            max_containers = settings.max_containers or 8
+            desired = min(backlog + settings.buffer_containers, max_containers)
+            desired = max(desired, settings.min_containers)
+            need = desired - len(live)
+            for _ in range(max(0, need)):
+                if not await self._launch_task(fn):
+                    break  # no capacity right now
+
+    # ------------------------------------------------------------------
+
+    def _chips_needed(self, fn: FunctionState) -> int:
+        tpu = fn.definition.resources.tpu_config
+        if not tpu.tpu_type:
+            return 0
+        spec = parse_tpu_config(tpu.tpu_type)
+        # single-task share: one host's worth of chips (gangs span hosts)
+        return min(spec.chips, spec.chips_per_host) if spec else 0
+
+    def _pick_worker(
+        self, chips_needed: int, reserved: Optional[dict[str, int]] = None
+    ) -> Optional[WorkerState]:
+        """Least-loaded worker with enough free chips. `reserved` counts chips
+        tentatively claimed by a gang being placed (so multi-rank placement on
+        one host can't double-book chips)."""
+        best: Optional[WorkerState] = None
+        for worker in self.s.workers.values():
+            if time.time() - worker.last_heartbeat > 60.0:
+                continue
+            free = len(worker.free_chips()) - (reserved or {}).get(worker.worker_id, 0)
+            if chips_needed > 0 and free < chips_needed:
+                continue
+            if best is None or len(worker.active_tasks) < len(best.active_tasks):
+                best = worker
+        return best
+
+    async def _launch_task(
+        self,
+        fn: FunctionState,
+        cluster: Optional[ClusterState] = None,
+        rank: int = 0,
+        worker: Optional[WorkerState] = None,
+    ) -> Optional[TaskState_]:
+        chips_needed = self._chips_needed(fn)
+        if worker is None:
+            worker = self._pick_worker(chips_needed)
+        if worker is None:
+            return None
+        task_id = make_id("ta")
+        chip_ids = worker.free_chips()[:chips_needed] if chips_needed else []
+        if chips_needed and len(chip_ids) < chips_needed:
+            # never launch under-allocated: the container would contend for
+            # chips already pinned to another task
+            return None
+        for c in chip_ids:
+            worker.chips_in_use[c] = task_id
+        task = TaskState_(
+            task_id=task_id,
+            function_id=fn.function_id,
+            app_id=fn.app_id,
+            state=api_pb2.TASK_STATE_WORKER_ASSIGNED,
+            worker_id=worker.worker_id,
+            rank=rank,
+            cluster_id=cluster.cluster_id if cluster else "",
+            tpu_chip_ids=chip_ids,
+        )
+        self.s.tasks[task_id] = task
+        fn.task_ids.add(task_id)
+        worker.active_tasks.add(task_id)
+        args = self._container_arguments(fn, task, cluster)
+        assignment = api_pb2.TaskAssignment(
+            task_id=task_id, container_arguments=args, tpu_chip_ids=chip_ids
+        )
+        await worker.events.put(api_pb2.WorkerPollResponse(assignment=assignment))
+        logger.debug(f"scheduled task {task_id} for {fn.tag} on {worker.worker_id} chips={chip_ids}")
+        return task
+
+    async def _launch_gang(self, fn: FunctionState, group_size: int) -> None:
+        """Atomic gang allocation: reserve all members before launching any
+        (SURVEY §7 hard part 1: atomicity, rank stability)."""
+        from .._utils.grpc_utils import find_free_port
+
+        tpu = fn.definition.resources.tpu_config
+        spec = parse_tpu_config(tpu.tpu_type) if tpu.tpu_type else None
+        # pick workers for all ranks first; allow worker reuse when there are
+        # fewer workers than ranks (local dev: many "hosts" on one machine)
+        chips_needed = self._chips_needed(fn)
+        chosen: list[WorkerState] = []
+        reserved: dict[str, int] = {}
+        for r in range(group_size):
+            w = self._pick_worker(chips_needed, reserved=reserved)
+            if w is None:
+                return  # not enough capacity; retry next tick
+            reserved[w.worker_id] = reserved.get(w.worker_id, 0) + chips_needed
+            chosen.append(w)
+        cluster = ClusterState(
+            cluster_id=make_id("cl"),
+            function_id=fn.function_id,
+            size=group_size,
+            coordinator_port=find_free_port(),
+        )
+        if spec is not None:
+            cluster.slice_info = slice_info_proto(spec)
+            cluster.slice_info.num_hosts = group_size
+        self.s.clusters[cluster.cluster_id] = cluster
+        for r, w in enumerate(chosen):
+            task = await self._launch_task(fn, cluster=cluster, rank=r, worker=w)
+            if task is None:
+                # rollback: tear down partial gang
+                for tid in cluster.task_ids:
+                    t = self.s.tasks[tid]
+                    t.terminate = True
+                logger.warning(f"gang allocation failed for {fn.tag}; rolled back")
+                return
+            cluster.task_ids.append(task.task_id)
+
+    def _container_arguments(
+        self, fn: FunctionState, task: TaskState_, cluster: Optional[ClusterState]
+    ) -> api_pb2.ContainerArguments:
+        app = self.s.apps.get(fn.app_id)
+        args = api_pb2.ContainerArguments(
+            task_id=task.task_id,
+            function_id=fn.function_id,
+            app_id=fn.app_id,
+            function_def=fn.definition,
+            environment_name=app.environment_name if app else "",
+        )
+        # secrets resolve to env at assignment time
+        for secret_id in fn.definition.secret_ids:
+            secret = self.s.secrets.get(secret_id)
+            if secret is not None:
+                for k, v in secret.env_dict.items():
+                    args.env[k] = v
+        if fn.serialized_params:
+            args.env["MODAL_TPU_BOUND_PARAMS"] = fn.serialized_params.hex()
+        if cluster is not None:
+            args.rank = task.rank
+            args.world_size = cluster.size
+            if cluster.slice_info is not None:
+                args.slice_info.CopyFrom(cluster.slice_info)
+        if app is not None:
+            layout = api_pb2.AppLayout()
+            for tag, fn_id in app.function_ids.items():
+                layout.objects[tag] = fn_id
+            for tag, cls_id in app.class_ids.items():
+                layout.objects[tag] = cls_id
+            args.app_layout.CopyFrom(layout)
+        return args
+
+    async def reap_dead_tasks(self) -> None:
+        """Fail tasks whose containers stopped heartbeating (failure
+        detection; reference surfaces this as TaskState PREEMPTED/FAILED).
+        Claimed inputs of a dead task retry or fail so clients never hang."""
+        now = time.time()
+        for task in list(self.s.tasks.values()):
+            if task.state == api_pb2.TASK_STATE_ACTIVE and task.last_heartbeat:
+                if now - task.last_heartbeat > TASK_HEARTBEAT_TIMEOUT:
+                    logger.warning(f"task {task.task_id} heartbeat lost; failing")
+                    task.state = api_pb2.TASK_STATE_FAILED
+                    task.terminate = True
+                    task.finished_at = now
+                    result = api_pb2.GenericResult(
+                        status=api_pb2.GENERIC_STATUS_INTERNAL_FAILURE,
+                        exception=f"container {task.task_id} lost (heartbeat timeout)",
+                    )
+                    if self.servicer is not None:
+                        await self.servicer._fail_claimed_inputs(task, result)
+                        self.servicer._release_task(task)
+                    worker = self.s.workers.get(task.worker_id)
+                    if worker is not None:
+                        await worker.events.put(
+                            api_pb2.WorkerPollResponse(
+                                stop=api_pb2.TaskStopEvent(task_id=task.task_id, force=True)
+                            )
+                        )

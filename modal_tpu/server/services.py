@@ -1,0 +1,1323 @@
+"""Control-plane RPC implementations.
+
+The real backend the reference doesn't ship (its control plane is closed
+source; SURVEY §7 step 3 "the mock made real"). Handlers follow the contract
+encoded in the reference's client call sites: FunctionMap/GetOutputs long-poll
+semantics (_functions.py:140-262), FunctionGetInputs/PutOutputs container
+loops (container_io_manager.py:788-886), TaskClusterHello gang rendezvous
+(_clustered_functions.py:70-83).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import time
+from typing import Any, Optional
+
+import grpc
+
+from ..config import logger
+from ..proto import api_pb2
+from .state import (
+    AppState,
+    ClusterState,
+    DictState,
+    FunctionCallState,
+    FunctionState,
+    ImageState,
+    InputState,
+    QueueState,
+    SecretState,
+    ServerState,
+    TaskState_,
+    VolumeState,
+    WorkerState,
+    make_id,
+)
+
+CREATE_IF_MISSING = api_pb2.OBJECT_CREATION_TYPE_CREATE_IF_MISSING
+FAIL_IF_EXISTS = api_pb2.OBJECT_CREATION_TYPE_CREATE_FAIL_IF_EXISTS
+EPHEMERAL = api_pb2.OBJECT_CREATION_TYPE_EPHEMERAL
+ANONYMOUS = api_pb2.OBJECT_CREATION_TYPE_ANONYMOUS_OWNED_BY_APP
+
+
+class ModalTPUServicer:
+    """All RPC handlers. One instance per control plane."""
+
+    def __init__(self, state: ServerState):
+        self.s = state
+
+    # ------------------------------------------------------------------
+    # Misc
+    # ------------------------------------------------------------------
+
+    async def ClientHello(self, request: api_pb2.ClientHelloRequest, context) -> api_pb2.ClientHelloResponse:
+        return api_pb2.ClientHelloResponse(server_version="0.1.0", image_builder_version="2026.07")
+
+    async def EnvironmentList(self, request, context):
+        names = sorted({env for env, _ in self.s.deployed_apps.keys()} | {""})
+        return api_pb2.EnvironmentListResponse(
+            items=[api_pb2.EnvironmentListItem(name=n or "main") for n in names]
+        )
+
+    async def EnvironmentCreate(self, request, context):
+        return api_pb2.EnvironmentCreateResponse()
+
+    async def EnvironmentDelete(self, request, context):
+        return api_pb2.EnvironmentDeleteResponse()
+
+    async def EnvironmentUpdate(self, request, context):
+        return api_pb2.EnvironmentUpdateResponse()
+
+    async def TokenFlowCreate(self, request, context):
+        return api_pb2.TokenFlowCreateResponse(token_flow_id="tf-local", web_url="http://localhost/token", code="LOCAL")
+
+    async def TokenFlowWait(self, request, context):
+        return api_pb2.TokenFlowWaitResponse(token_id="tk-local", token_secret="ts-local", workspace_name="local")
+
+    # ------------------------------------------------------------------
+    # Apps
+    # ------------------------------------------------------------------
+
+    async def AppCreate(self, request: api_pb2.AppCreateRequest, context) -> api_pb2.AppCreateResponse:
+        app_id = make_id("ap")
+        self.s.apps[app_id] = AppState(
+            app_id=app_id,
+            description=request.description,
+            state=request.app_state or api_pb2.APP_STATE_INITIALIZING,
+            environment_name=request.environment_name,
+        )
+        return api_pb2.AppCreateResponse(app_id=app_id, app_page_url=f"http://local/apps/{app_id}")
+
+    async def AppGetOrCreate(self, request: api_pb2.AppGetOrCreateRequest, context) -> api_pb2.AppGetOrCreateResponse:
+        key = (request.environment_name, request.app_name)
+        app_id = self.s.deployed_apps.get(key)
+        if app_id is None:
+            if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
+                await context.abort(grpc.StatusCode.NOT_FOUND, f"app {request.app_name!r} not found")
+            app_id = make_id("ap")
+            self.s.apps[app_id] = AppState(
+                app_id=app_id,
+                name=request.app_name,
+                description=request.app_name,
+                state=api_pb2.APP_STATE_DEPLOYED,
+                environment_name=request.environment_name,
+            )
+            self.s.deployed_apps[key] = app_id
+        elif request.object_creation_type == FAIL_IF_EXISTS:
+            await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"app {request.app_name!r} exists")
+        return api_pb2.AppGetOrCreateResponse(app_id=app_id)
+
+    async def AppHeartbeat(self, request, context) -> api_pb2.AppHeartbeatResponse:
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"app {request.app_id} not found")
+        app.last_heartbeat = time.time()
+        return api_pb2.AppHeartbeatResponse()
+
+    async def AppPublish(self, request: api_pb2.AppPublishRequest, context) -> api_pb2.AppPublishResponse:
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
+        app.state = request.app_state
+        app.function_ids.update(request.function_ids)
+        app.class_ids.update(request.class_ids)
+        if request.name:
+            app.name = request.name
+            self.s.deployed_apps[(app.environment_name, request.name)] = app.app_id
+            for (env, app_name, tag) in list(self.s.deployed_functions.keys()):
+                if env == app.environment_name and app_name == request.name:
+                    del self.s.deployed_functions[(env, app_name, tag)]
+            for tag, fn_id in request.function_ids.items():
+                self.s.deployed_functions[(app.environment_name, request.name, tag)] = fn_id
+            app.version += 1
+            app.deployment_history.append(
+                api_pb2.AppDeploymentHistory(
+                    app_id=app.app_id,
+                    version=app.version,
+                    deployed_at=time.time(),
+                    deployment_tag=request.deployment_tag,
+                    commit_info=request.commit_info,
+                )
+            )
+        self.s.schedule_event.set()  # min_containers may need warm pools
+        return api_pb2.AppPublishResponse(url=f"http://local/apps/{app.app_id}")
+
+    async def AppClientDisconnect(self, request, context) -> api_pb2.AppClientDisconnectResponse:
+        app = self.s.apps.get(request.app_id)
+        if app is not None and app.state in (api_pb2.APP_STATE_EPHEMERAL, api_pb2.APP_STATE_INITIALIZING):
+            await self._stop_app(app)
+        return api_pb2.AppClientDisconnectResponse()
+
+    async def AppStop(self, request, context) -> api_pb2.AppStopResponse:
+        app = self.s.apps.get(request.app_id)
+        if app is not None:
+            await self._stop_app(app)
+        return api_pb2.AppStopResponse()
+
+    async def _stop_app(self, app: AppState) -> None:
+        app.state = api_pb2.APP_STATE_STOPPED
+        app.stopped_at = time.time()
+        app.done = True
+        # stop tasks belonging to the app
+        for task in list(self.s.tasks.values()):
+            if task.app_id == app.app_id and task.state not in (
+                api_pb2.TASK_STATE_COMPLETED,
+                api_pb2.TASK_STATE_FAILED,
+                api_pb2.TASK_STATE_TERMINATED,
+            ):
+                task.terminate = True
+                worker = self.s.workers.get(task.worker_id)
+                if worker is not None:
+                    await worker.events.put(
+                        api_pb2.WorkerPollResponse(stop=api_pb2.TaskStopEvent(task_id=task.task_id))
+                    )
+        # wake any input long-polls so containers see kill switches
+        for fn_id in app.function_ids.values():
+            fn = self.s.functions.get(fn_id)
+            if fn is not None:
+                async with fn.input_condition:
+                    fn.input_condition.notify_all()
+        await self.s.notify_logs(app.app_id)
+        async with app.log_condition:
+            app.log_condition.notify_all()
+
+    async def AppGetLayout(self, request, context) -> api_pb2.AppGetLayoutResponse:
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
+        layout = api_pb2.AppLayout()
+        for tag, fn_id in app.function_ids.items():
+            layout.objects[tag] = fn_id
+            fn = self.s.functions.get(fn_id)
+            if fn is not None:
+                layout.function_metadata[tag].CopyFrom(self._function_metadata(fn))
+        for tag, cls_id in app.class_ids.items():
+            layout.objects[tag] = cls_id
+        return api_pb2.AppGetLayoutResponse(app_layout=layout)
+
+    async def AppList(self, request, context) -> api_pb2.AppListResponse:
+        items = []
+        for app in self.s.apps.values():
+            if request.environment_name and app.environment_name != request.environment_name:
+                continue
+            n_running = sum(
+                1
+                for t in self.s.tasks.values()
+                if t.app_id == app.app_id and t.state == api_pb2.TASK_STATE_ACTIVE
+            )
+            items.append(
+                api_pb2.AppListItem(
+                    app_id=app.app_id,
+                    description=app.description,
+                    state=app.state,
+                    created_at=app.created_at,
+                    stopped_at=app.stopped_at,
+                    name=app.name,
+                    n_running_tasks=n_running,
+                )
+            )
+        return api_pb2.AppListResponse(apps=sorted(items, key=lambda a: a.created_at, reverse=True))
+
+    async def AppDeploy(self, request, context) -> api_pb2.AppDeployResponse:
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
+        app.state = api_pb2.APP_STATE_DEPLOYED
+        self.s.deployed_apps[(app.environment_name, request.name)] = app.app_id
+        return api_pb2.AppDeployResponse(url=f"http://local/apps/{app.app_id}")
+
+    async def AppGetByDeploymentName(self, request, context) -> api_pb2.AppGetByDeploymentNameResponse:
+        app_id = self.s.deployed_apps.get((request.environment_name, request.name))
+        if app_id is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"deployed app {request.name!r} not found")
+        return api_pb2.AppGetByDeploymentNameResponse(app_id=app_id)
+
+    async def AppDeploymentHistory(self, request, context) -> api_pb2.AppDeploymentHistoryResponse:
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
+        return api_pb2.AppDeploymentHistoryResponse(history=app.deployment_history)
+
+    async def AppGetLogs(self, request: api_pb2.AppGetLogsRequest, context):
+        """Server-streaming log tail with long-poll (reference AppGetLogs)."""
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
+        pos = int(request.last_entry_id) if request.last_entry_id else 0
+        deadline = time.monotonic() + (request.timeout or 55.0)
+        while time.monotonic() < deadline:
+            entries = app.log_entries[pos:]
+            if entries:
+                for i, entry in enumerate(entries):
+                    batch = api_pb2.TaskLogsBatch(entry_id=str(pos + i + 1))
+                    batch.items.append(entry)
+                    yield batch
+                pos += len(entries)
+            if app.done:
+                yield api_pb2.TaskLogsBatch(app_done=True, entry_id=str(pos))
+                return
+            async with app.log_condition:
+                try:
+                    await asyncio.wait_for(app.log_condition.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+
+    # ------------------------------------------------------------------
+    # Blobs
+    # ------------------------------------------------------------------
+
+    async def BlobCreate(self, request: api_pb2.BlobCreateRequest, context) -> api_pb2.BlobCreateResponse:
+        blob_id = "bl-" + hashlib.sha256(
+            (request.content_sha256_base64 + str(time.time_ns())).encode()
+        ).hexdigest()[:16]
+        return api_pb2.BlobCreateResponse(
+            blob_id=blob_id, upload_url=f"{self.s.blob_url_base}/blob/{blob_id}"
+        )
+
+    async def BlobGet(self, request, context) -> api_pb2.BlobGetResponse:
+        return api_pb2.BlobGetResponse(download_url=f"{self.s.blob_url_base}/blob/{request.blob_id}")
+
+    # ------------------------------------------------------------------
+    # Functions — definition
+    # ------------------------------------------------------------------
+
+    def _function_metadata(self, fn: FunctionState) -> api_pb2.FunctionHandleMetadata:
+        d = fn.definition
+        return api_pb2.FunctionHandleMetadata(
+            function_name=d.function_name,
+            function_type=d.function_type,
+            web_url=fn.web_url,
+            is_generator=d.function_type == api_pb2.FUNCTION_TYPE_GENERATOR,
+            definition_id=fn.function_id,
+            input_concurrency=d.max_concurrent_inputs,
+            batch_max_size=d.batch_max_size,
+            batch_wait_ms=d.batch_linger_ms,
+            schema=d.function_schema,
+        )
+
+    async def FunctionCreate(self, request: api_pb2.FunctionCreateRequest, context) -> api_pb2.FunctionCreateResponse:
+        if request.app_id and request.app_id not in self.s.apps:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"app {request.app_id} not found")
+        function_id = request.existing_function_id or make_id("fu")
+        fn = FunctionState(
+            function_id=function_id,
+            app_id=request.app_id,
+            tag=request.tag or request.function.function_name,
+            definition=request.function,
+        )
+        self.s.functions[function_id] = fn
+        app = self.s.apps.get(request.app_id)
+        if app is not None:
+            app.function_ids[fn.tag] = function_id
+        self.s.schedule_event.set()
+        return api_pb2.FunctionCreateResponse(
+            function_id=function_id, handle_metadata=self._function_metadata(fn)
+        )
+
+    async def FunctionGet(self, request: api_pb2.FunctionGetRequest, context) -> api_pb2.FunctionGetResponse:
+        key = (request.environment_name, request.app_name, request.object_tag)
+        fn_id = self.s.deployed_functions.get(key)
+        if fn_id is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"function {request.app_name}/{request.object_tag} not found"
+            )
+        fn = self.s.functions[fn_id]
+        return api_pb2.FunctionGetResponse(function_id=fn_id, handle_metadata=self._function_metadata(fn))
+
+    async def FunctionBindParams(self, request, context) -> api_pb2.FunctionBindParamsResponse:
+        parent = self.s.functions.get(request.function_id)
+        if parent is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
+        bound_id = make_id("fu")
+        bound_def = api_pb2.Function()
+        bound_def.CopyFrom(parent.definition)
+        bound = FunctionState(
+            function_id=bound_id,
+            app_id=parent.app_id,
+            tag=parent.tag,
+            definition=bound_def,
+            bound_parent=parent.function_id,
+            serialized_params=request.serialized_params,
+        )
+        self.s.functions[bound_id] = bound
+        return api_pb2.FunctionBindParamsResponse(
+            bound_function_id=bound_id, handle_metadata=self._function_metadata(bound)
+        )
+
+    async def FunctionUpdateSchedulingParams(self, request, context):
+        fn = self.s.functions.get(request.function_id)
+        if fn is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
+        fn.autoscaler_override = request.settings
+        self.s.schedule_event.set()
+        return api_pb2.FunctionUpdateSchedulingParamsResponse()
+
+    async def FunctionGetCurrentStats(self, request, context) -> api_pb2.FunctionStats:
+        fn = self.s.functions.get(request.function_id)
+        if fn is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
+        active = sum(
+            1 for tid in fn.task_ids if self.s.tasks[tid].state == api_pb2.TASK_STATE_ACTIVE
+        )
+        return api_pb2.FunctionStats(
+            backlog=len(fn.pending), num_total_tasks=len(fn.task_ids), num_active_tasks=active
+        )
+
+    # ------------------------------------------------------------------
+    # Functions — invocation data plane
+    # ------------------------------------------------------------------
+
+    def _enqueue_input(self, fn: FunctionState, call: FunctionCallState, item: api_pb2.FunctionPutInputsItem) -> InputState:
+        input_id = make_id("in")
+        inp = InputState(
+            input_id=input_id,
+            function_call_id=call.function_call_id,
+            idx=item.idx,
+            input=item.input,
+        )
+        self.s.inputs[input_id] = inp
+        call.input_ids.append(input_id)
+        call.num_inputs += 1
+        fn.pending.append(input_id)
+        return inp
+
+    async def FunctionMap(self, request: api_pb2.FunctionMapRequest, context) -> api_pb2.FunctionMapResponse:
+        fn = self.s.functions.get(request.function_id)
+        if fn is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"function {request.function_id} not found")
+        call_id = make_id("fc")
+        call = FunctionCallState(
+            function_call_id=call_id,
+            function_id=request.function_id,
+            call_type=request.function_call_type,
+            invocation_type=request.invocation_type,
+            return_exceptions=request.return_exceptions,
+        )
+        self.s.function_calls[call_id] = call
+        resp = api_pb2.FunctionMapResponse(
+            function_call_id=call_id,
+            function_call_jwt=call_id,
+            max_inputs_outstanding=1000,
+        )
+        for item in request.pipelined_inputs:
+            inp = self._enqueue_input(fn, call, item)
+            resp.pipelined_inputs.append(
+                api_pb2.FunctionPutInputsResponseItem(idx=item.idx, input_id=inp.input_id)
+            )
+        async with fn.input_condition:
+            fn.input_condition.notify_all()
+        self.s.schedule_event.set()
+        return resp
+
+    async def FunctionPutInputs(self, request, context) -> api_pb2.FunctionPutInputsResponse:
+        fn = self.s.functions.get(request.function_id)
+        call = self.s.function_calls.get(request.function_call_id)
+        if fn is None or call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "function or call not found")
+        resp = api_pb2.FunctionPutInputsResponse()
+        for item in request.inputs:
+            inp = self._enqueue_input(fn, call, item)
+            resp.inputs.append(api_pb2.FunctionPutInputsResponseItem(idx=item.idx, input_id=inp.input_id))
+        async with fn.input_condition:
+            fn.input_condition.notify_all()
+        self.s.schedule_event.set()
+        return resp
+
+    async def FunctionRetryInputs(self, request, context) -> api_pb2.FunctionRetryInputsResponse:
+        call = self.s.function_calls.get(request.function_call_jwt)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        fn = self.s.functions[call.function_id]
+        jwts = []
+        for item in request.inputs:
+            old = self.s.inputs.get(item.input_id)
+            if old is None:
+                continue
+            old.status = "pending"
+            old.retry_count = item.retry_count
+            old.input.CopyFrom(item.input)
+            fn.pending.append(old.input_id)
+            jwts.append(old.input_id)
+        async with fn.input_condition:
+            fn.input_condition.notify_all()
+        self.s.schedule_event.set()
+        return api_pb2.FunctionRetryInputsResponse(input_jwts=jwts)
+
+    async def FunctionGetOutputs(self, request: api_pb2.FunctionGetOutputsRequest, context) -> api_pb2.FunctionGetOutputsResponse:
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"call {request.function_call_id} not found")
+        deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
+        while True:
+            start = call.outputs_consumed if request.clear_on_success else int(request.last_entry_id or 0)
+            available = call.outputs[start:]
+            if available:
+                n = len(available) if request.max_values <= 0 else min(len(available), request.max_values)
+                taken = available[:n]
+                if request.clear_on_success:
+                    call.outputs_consumed += n
+                return api_pb2.FunctionGetOutputsResponse(
+                    outputs=taken,
+                    last_entry_id=str(start + n),
+                    num_unfinished_inputs=call.num_inputs - call.num_done,
+                )
+            if time.monotonic() >= deadline:
+                return api_pb2.FunctionGetOutputsResponse(
+                    outputs=[],
+                    last_entry_id=str(start),
+                    num_unfinished_inputs=call.num_inputs - call.num_done,
+                )
+            async with call.output_condition:
+                try:
+                    await asyncio.wait_for(
+                        call.output_condition.wait(), timeout=max(0.05, deadline - time.monotonic())
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+    async def FunctionCallGetData(self, request: api_pb2.FunctionCallGetDataRequest, context):
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        idx = int(request.last_index)
+        deadline = time.monotonic() + 55.0
+        while time.monotonic() < deadline:
+            chunks = call.data_chunks[idx:]
+            if chunks:
+                for c in chunks:
+                    yield c
+                idx += len(chunks)
+                if chunks[-1].data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
+                    return
+                deadline = time.monotonic() + 55.0
+                continue
+            async with call.data_condition:
+                try:
+                    await asyncio.wait_for(call.data_condition.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def FunctionCallPutData(self, request: api_pb2.FunctionCallPutDataRequest, context):
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        for chunk in request.data_chunks:
+            new = api_pb2.DataChunk()
+            new.CopyFrom(chunk)
+            new.index = len(call.data_chunks) + 1
+            call.data_chunks.append(new)
+        async with call.data_condition:
+            call.data_condition.notify_all()
+        return api_pb2.FunctionCallPutDataResponse()
+
+    async def FunctionCallList(self, request, context) -> api_pb2.FunctionCallListResponse:
+        calls = [
+            api_pb2.FunctionCallInfo(
+                function_call_id=c.function_call_id,
+                created_at=c.created_at,
+                type=c.call_type,
+                num_inputs=c.num_inputs,
+                num_outputs=len(c.outputs),
+            )
+            for c in self.s.function_calls.values()
+            if c.function_id == request.function_id
+        ]
+        return api_pb2.FunctionCallListResponse(calls=calls)
+
+    async def FunctionCallCancel(self, request, context) -> api_pb2.FunctionCallCancelResponse:
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        call.cancelled = True
+        fn = self.s.functions[call.function_id]
+        # drop pending inputs; notify running tasks via heartbeat channel
+        for input_id in call.input_ids:
+            inp = self.s.inputs.get(input_id)
+            if inp is None:
+                continue
+            if inp.status == "pending":
+                inp.status = "cancelled"
+                if input_id in fn.pending:
+                    fn.pending.remove(input_id)
+            elif inp.status == "claimed":
+                task = self.s.tasks.get(inp.claimed_by)
+                if task is not None:
+                    task.cancelled_input_ids.append(input_id)
+                    if request.terminate_containers:
+                        task.terminate = True
+        async with call.output_condition:
+            call.output_condition.notify_all()
+        return api_pb2.FunctionCallCancelResponse()
+
+    async def FunctionCallGetInfo(self, request, context) -> api_pb2.FunctionCallGetInfoResponse:
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        return api_pb2.FunctionCallGetInfoResponse(
+            info=api_pb2.FunctionCallInfo(
+                function_call_id=call.function_call_id,
+                created_at=call.created_at,
+                type=call.call_type,
+                num_inputs=call.num_inputs,
+                num_outputs=len(call.outputs),
+            ),
+            function_id=call.function_id,
+        )
+
+    # ------------------------------------------------------------------
+    # Container data plane
+    # ------------------------------------------------------------------
+
+    async def ContainerHello(self, request, context) -> api_pb2.ContainerHelloResponse:
+        task = self.s.tasks.get(request.task_id)
+        if task is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not found")
+        task.state = api_pb2.TASK_STATE_ACTIVE
+        task.started_at = task.started_at or time.time()
+        task.last_heartbeat = time.time()
+        return api_pb2.ContainerHelloResponse()
+
+    async def ContainerHeartbeat(self, request, context) -> api_pb2.ContainerHeartbeatResponse:
+        task = self.s.tasks.get(request.task_id)
+        if task is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "task not found")
+        task.last_heartbeat = time.time()
+        resp = api_pb2.ContainerHeartbeatResponse()
+        if task.cancelled_input_ids:
+            resp.cancel_input_event.input_ids.extend(task.cancelled_input_ids)
+            task.cancelled_input_ids = []
+        if task.terminate:
+            resp.cancel_input_event.terminate_containers = True
+        return resp
+
+    async def FunctionGetInputs(self, request: api_pb2.FunctionGetInputsRequest, context) -> api_pb2.FunctionGetInputsResponse:
+        fn = self.s.functions.get(request.function_id)
+        task = self.s.tasks.get(request.task_id)
+        if fn is None or task is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "function or task not found")
+        # Long-poll for inputs; kill_switch when the app stops or the task is
+        # being drained (reference container_io_manager.py:820).
+        deadline = time.monotonic() + 10.0
+        while True:
+            app = self.s.apps.get(fn.app_id)
+            if task.terminate or (app is not None and app.done):
+                return api_pb2.FunctionGetInputsResponse(
+                    inputs=[api_pb2.FunctionGetInputsItem(kill_switch=True)]
+                )
+            batch_size = max(1, request.max_values or 1)
+            items = []
+            cluster = self.s.clusters.get(task.cluster_id) if task.cluster_id else None
+            broadcast = cluster is not None and fn.definition.broadcast_inputs
+            if broadcast:
+                # Gang broadcast: every gang member receives a copy of each
+                # input (reference broadcast semantics,
+                # _partial_function.py:780 `broadcast`); the input leaves the
+                # queue once all ranks have it. Outputs are deduped first-win
+                # in FunctionPutOutputs.
+                for input_id in list(fn.pending):
+                    if len(items) >= batch_size:
+                        break
+                    inp = self.s.inputs[input_id]
+                    if inp.status != "pending" or task.task_id in inp.delivered_to:
+                        continue
+                    inp.delivered_to.add(task.task_id)
+                    inp.claimed_by = inp.claimed_by or task.task_id
+                    inp.claimed_at = inp.claimed_at or time.time()
+                    if len(inp.delivered_to) >= cluster.size:
+                        inp.status = "claimed"
+                        fn.pending.remove(input_id)
+                    items.append(
+                        api_pb2.FunctionGetInputsItem(
+                            input_id=inp.input_id,
+                            input=inp.input,
+                            function_call_id=inp.function_call_id,
+                            idx=inp.idx,
+                            retry_count=inp.retry_count,
+                        )
+                    )
+            else:
+                while fn.pending and len(items) < batch_size:
+                    input_id = fn.pending.pop(0)
+                    inp = self.s.inputs[input_id]
+                    if inp.status != "pending":
+                        continue
+                    inp.status = "claimed"
+                    inp.claimed_by = task.task_id
+                    inp.claimed_at = time.time()
+                    items.append(
+                        api_pb2.FunctionGetInputsItem(
+                            input_id=inp.input_id,
+                            input=inp.input,
+                            function_call_id=inp.function_call_id,
+                            idx=inp.idx,
+                            retry_count=inp.retry_count,
+                        )
+                    )
+            if items:
+                return api_pb2.FunctionGetInputsResponse(inputs=items)
+            if time.monotonic() >= deadline:
+                return api_pb2.FunctionGetInputsResponse(inputs=[])
+            async with fn.input_condition:
+                try:
+                    await asyncio.wait_for(
+                        fn.input_condition.wait(), timeout=max(0.05, deadline - time.monotonic())
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+    async def FunctionPutOutputs(self, request: api_pb2.FunctionPutOutputsRequest, context) -> api_pb2.FunctionPutOutputsResponse:
+        touched: set[str] = set()
+        for item in request.outputs:
+            call = self.s.function_calls.get(item.function_call_id)
+            if call is None:
+                continue
+            inp = self.s.inputs.get(item.input_id)
+            if inp is not None:
+                if inp.status == "done":
+                    continue  # duplicate (e.g. gang peer)
+                inp.status = "done"
+            call.outputs.append(
+                api_pb2.FunctionGetOutputsItem(
+                    result=item.result,
+                    idx=item.idx,
+                    input_id=item.input_id,
+                    data_format=item.data_format,
+                    retry_count=item.retry_count,
+                )
+            )
+            call.num_done += 1
+            touched.add(call.function_call_id)
+        for call_id in touched:
+            call = self.s.function_calls[call_id]
+            async with call.output_condition:
+                call.output_condition.notify_all()
+        return api_pb2.FunctionPutOutputsResponse()
+
+    async def ContainerCheckpoint(self, request, context):
+        return api_pb2.ContainerCheckpointResponse()
+
+    async def ContainerStop(self, request, context):
+        task = self.s.tasks.get(request.task_id)
+        if task is not None:
+            task.terminate = True
+        return api_pb2.ContainerStopResponse()
+
+    async def ContainerLog(self, request: api_pb2.ContainerLogRequest, context):
+        task = self.s.tasks.get(request.task_id)
+        if task is not None:
+            app = self.s.apps.get(task.app_id)
+            if app is not None:
+                for entry in request.logs:
+                    e = api_pb2.TaskLogs()
+                    e.CopyFrom(entry)
+                    e.task_id = task.task_id
+                    app.log_entries.append(e)
+                async with app.log_condition:
+                    app.log_condition.notify_all()
+        return api_pb2.ContainerLogResponse()
+
+    async def TaskResult(self, request: api_pb2.TaskResultRequest, context) -> api_pb2.TaskResultResponse:
+        task = self.s.tasks.get(request.task_id)
+        if task is not None:
+            task.result = request.result
+            if request.result.status == api_pb2.GENERIC_STATUS_SUCCESS:
+                task.state = api_pb2.TASK_STATE_COMPLETED
+            else:
+                task.state = api_pb2.TASK_STATE_FAILED
+                await self._fail_claimed_inputs(task, request.result)
+            task.finished_at = time.time()
+            self._release_task(task)
+        return api_pb2.TaskResultResponse()
+
+    async def _fail_claimed_inputs(self, task: TaskState_, result: api_pb2.GenericResult) -> None:
+        """Inputs claimed by a dead container either retry or fail
+        (reference: server-driven FunctionRetryInputs semantics)."""
+        for inp in self.s.inputs.values():
+            if inp.claimed_by == task.task_id and inp.status == "claimed":
+                call = self.s.function_calls.get(inp.function_call_id)
+                fn = self.s.functions.get(task.function_id)
+                if call is None or fn is None:
+                    continue
+                retries = fn.definition.retry_policy.retries
+                if inp.retry_count < retries:
+                    inp.retry_count += 1
+                    inp.status = "pending"
+                    fn.pending.append(inp.input_id)
+                    async with fn.input_condition:
+                        fn.input_condition.notify_all()
+                    self.s.schedule_event.set()
+                else:
+                    inp.status = "done"
+                    call.outputs.append(
+                        api_pb2.FunctionGetOutputsItem(
+                            result=result, idx=inp.idx, input_id=inp.input_id, retry_count=inp.retry_count
+                        )
+                    )
+                    call.num_done += 1
+                    async with call.output_condition:
+                        call.output_condition.notify_all()
+
+    def _release_task(self, task: TaskState_) -> None:
+        worker = self.s.workers.get(task.worker_id)
+        if worker is not None:
+            worker.active_tasks.discard(task.task_id)
+            for chip, tid in list(worker.chips_in_use.items()):
+                if tid == task.task_id:
+                    del worker.chips_in_use[chip]
+        fn = self.s.functions.get(task.function_id)
+        if fn is not None:
+            fn.task_ids.discard(task.task_id)
+        self.s.schedule_event.set()
+
+    async def TaskClusterHello(self, request: api_pb2.TaskClusterHelloRequest, context) -> api_pb2.TaskClusterHelloResponse:
+        """Gang rendezvous: block until all ranks report, then return rank +
+        coordinator + slice topology (reference api.proto:3935-3953; feeds
+        jax.distributed.initialize in the entrypoint)."""
+        task = self.s.tasks.get(request.task_id)
+        if task is None or not task.cluster_id:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "task has no cluster")
+        cluster = self.s.clusters[task.cluster_id]
+        task.container_address = request.container_address
+        async with cluster.condition:
+            cluster.reported[request.task_id] = request.container_address
+            cluster.condition.notify_all()
+            deadline = time.monotonic() + 120.0
+            while len(cluster.reported) < cluster.size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "gang rendezvous timeout")
+                try:
+                    await asyncio.wait_for(cluster.condition.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass
+        rank = cluster.task_ids.index(request.task_id)
+        rank0_addr = cluster.reported[cluster.task_ids[0]]
+        coordinator_host = rank0_addr.rsplit(":", 1)[0] if ":" in rank0_addr else rank0_addr
+        resp = api_pb2.TaskClusterHelloResponse(
+            rank=rank,
+            world_size=cluster.size,
+            coordinator_address=f"{coordinator_host}:{cluster.coordinator_port}",
+            peer_addresses=[cluster.reported[tid] for tid in cluster.task_ids],
+            cluster_id=cluster.cluster_id,
+        )
+        if cluster.slice_info is not None:
+            resp.slice_info.CopyFrom(cluster.slice_info)
+        return resp
+
+    # ------------------------------------------------------------------
+    # Workers
+    # ------------------------------------------------------------------
+
+    async def WorkerRegister(self, request: api_pb2.WorkerRegisterRequest, context) -> api_pb2.WorkerRegisterResponse:
+        worker_id = request.worker_id or make_id("wk")
+        self.s.workers[worker_id] = WorkerState(
+            worker_id=worker_id,
+            hostname=request.hostname,
+            tpu_type=request.tpu_type,
+            num_chips=request.num_chips,
+            topology=request.topology,
+            milli_cpu=request.milli_cpu,
+            memory_mb=request.memory_mb,
+            container_address=request.container_address,
+            slice_index=request.slice_index,
+        )
+        self.s.schedule_event.set()
+        return api_pb2.WorkerRegisterResponse(worker_id=worker_id)
+
+    async def WorkerPoll(self, request: api_pb2.WorkerPollRequest, context):
+        worker = self.s.workers.get(request.worker_id)
+        if worker is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "worker not registered")
+        while True:
+            event = await worker.events.get()
+            yield event
+
+    async def WorkerHeartbeat(self, request, context) -> api_pb2.WorkerHeartbeatResponse:
+        worker = self.s.workers.get(request.worker_id)
+        if worker is not None:
+            worker.last_heartbeat = time.time()
+        return api_pb2.WorkerHeartbeatResponse()
+
+    # ------------------------------------------------------------------
+    # Images
+    # ------------------------------------------------------------------
+
+    async def ImageGetOrCreate(self, request: api_pb2.ImageGetOrCreateRequest, context) -> api_pb2.ImageGetOrCreateResponse:
+        key = hashlib.sha256(request.image.SerializeToString()).hexdigest()[:16]
+        image_id = self.s.images_by_hash.get(key)
+        if image_id is None:
+            image_id = make_id("im")
+            metadata = api_pb2.ImageMetadata(
+                image_builder_version=request.builder_version or "2026.07",
+                python_version="local",
+            )
+            self.s.images[image_id] = ImageState(
+                image_id=image_id, definition=request.image, metadata=metadata, built=True
+            )
+            self.s.images_by_hash[key] = image_id
+        return api_pb2.ImageGetOrCreateResponse(image_id=image_id, metadata=self.s.images[image_id].metadata)
+
+    async def ImageJoinStreaming(self, request, context) -> api_pb2.ImageJoinStreamingResponse:
+        image = self.s.images.get(request.image_id)
+        if image is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "image not found")
+        return api_pb2.ImageJoinStreamingResponse(
+            result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+            eof=True,
+            metadata=image.metadata,
+        )
+
+    async def ImageFromId(self, request, context) -> api_pb2.ImageFromIdResponse:
+        image = self.s.images.get(request.image_id)
+        if image is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "image not found")
+        return api_pb2.ImageFromIdResponse(image_id=request.image_id, metadata=image.metadata)
+
+    # ------------------------------------------------------------------
+    # Mounts
+    # ------------------------------------------------------------------
+
+    async def MountPutFile(self, request: api_pb2.MountPutFileRequest, context) -> api_pb2.MountPutFileResponse:
+        if request.WhichOneof("data_oneof") is None:
+            return api_pb2.MountPutFileResponse(exists=self.s.has_block(request.sha256_hex))
+        data = request.data
+        if request.data_blob_id:
+            with open(self.s.blob_path(request.data_blob_id), "rb") as f:
+                data = f.read()
+        self.s.put_block(request.sha256_hex, data)
+        return api_pb2.MountPutFileResponse(exists=True)
+
+    async def MountGetOrCreate(self, request: api_pb2.MountGetOrCreateRequest, context) -> api_pb2.MountGetOrCreateResponse:
+        missing = [f.sha256_hex for f in request.files if not self.s.has_block(f.sha256_hex)]
+        if missing:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, f"missing file content: {missing[:3]}"
+            )
+        mount_id = make_id("mo")
+        # store manifest as a block so workers can materialize it
+        manifest = json.dumps(
+            [
+                {"filename": f.filename, "sha256_hex": f.sha256_hex, "mode": f.mode, "size": f.size}
+                for f in request.files
+            ]
+        ).encode()
+        self.s.put_block("mount-" + mount_id, manifest)
+        digest = hashlib.sha256(manifest).hexdigest()
+        return api_pb2.MountGetOrCreateResponse(
+            mount_id=mount_id,
+            handle_metadata=api_pb2.MountHandleMetadata(content_checksum_sha256_hex=digest),
+        )
+
+    # ------------------------------------------------------------------
+    # Volumes
+    # ------------------------------------------------------------------
+
+    async def VolumeGetOrCreate(self, request: api_pb2.VolumeGetOrCreateRequest, context) -> api_pb2.VolumeGetOrCreateResponse:
+        if request.object_creation_type == EPHEMERAL or not request.deployment_name:
+            volume_id = make_id("vo")
+            self.s.volumes[volume_id] = VolumeState(volume_id=volume_id, version=request.version)
+            return api_pb2.VolumeGetOrCreateResponse(
+                volume_id=volume_id, metadata=api_pb2.VolumeMetadata(version=request.version)
+            )
+        key = (request.environment_name, request.deployment_name)
+        volume_id = self.s.deployed_volumes.get(key)
+        if volume_id is None:
+            if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
+                await context.abort(grpc.StatusCode.NOT_FOUND, f"volume {request.deployment_name!r} not found")
+            volume_id = make_id("vo")
+            self.s.volumes[volume_id] = VolumeState(
+                volume_id=volume_id, name=request.deployment_name, version=request.version
+            )
+            self.s.deployed_volumes[key] = volume_id
+        vol = self.s.volumes[volume_id]
+        return api_pb2.VolumeGetOrCreateResponse(
+            volume_id=volume_id, metadata=api_pb2.VolumeMetadata(version=vol.version, name=vol.name)
+        )
+
+    async def VolumePutFiles2(self, request: api_pb2.VolumePutFiles2Request, context) -> api_pb2.VolumePutFiles2Response:
+        vol = self.s.volumes.get(request.volume_id)
+        if vol is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        missing = sorted(
+            {sha for f in request.files for sha in f.block_sha256_hex if not self.s.has_block(sha)}
+        )
+        if missing:
+            return api_pb2.VolumePutFiles2Response(missing_blocks=missing)
+        for f in request.files:
+            path = f.path.lstrip("/")
+            if request.disallow_overwrite_existing_files and path in vol.files:
+                await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"file {path!r} already exists")
+            new = api_pb2.VolumeFile()
+            new.CopyFrom(f)
+            new.path = path
+            new.mtime = time.time()
+            vol.files[path] = new
+        return api_pb2.VolumePutFiles2Response()
+
+    async def VolumeBlockPut(self, request, context) -> api_pb2.VolumeBlockPutResponse:
+        import hashlib as _h
+
+        actual = _h.sha256(request.data).hexdigest()
+        if actual != request.sha256_hex:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "block hash mismatch")
+        self.s.put_block(request.sha256_hex, request.data)
+        return api_pb2.VolumeBlockPutResponse()
+
+    async def VolumeBlockGet(self, request, context) -> api_pb2.VolumeBlockGetResponse:
+        if not self.s.has_block(request.sha256_hex):
+            await context.abort(grpc.StatusCode.NOT_FOUND, "block not found")
+        return api_pb2.VolumeBlockGetResponse(
+            data=self.s.get_block(request.sha256_hex, request.offset, request.length)
+        )
+
+    async def VolumeGetFile2(self, request, context) -> api_pb2.VolumeGetFile2Response:
+        vol = self.s.volumes.get(request.volume_id)
+        if vol is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        f = vol.files.get(request.path.lstrip("/"))
+        if f is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"file {request.path!r} not found")
+        from .._utils.hash_utils import BLOCK_SIZE
+
+        return api_pb2.VolumeGetFile2Response(file=f, block_size=BLOCK_SIZE)
+
+    async def VolumeListFiles(self, request, context) -> api_pb2.VolumeListFilesResponse:
+        vol = self.s.volumes.get(request.volume_id)
+        if vol is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        prefix = request.path.lstrip("/").rstrip("/")
+        files = []
+        for path, f in sorted(vol.files.items()):
+            if prefix and not (path == prefix or path.startswith(prefix + "/")):
+                continue
+            if not request.recursive and prefix:
+                rel = path[len(prefix) :].lstrip("/")
+                if "/" in rel:
+                    continue
+            elif not request.recursive and "/" in path:
+                continue
+            files.append(f)
+        return api_pb2.VolumeListFilesResponse(files=files)
+
+    async def VolumeRemoveFile(self, request, context) -> api_pb2.VolumeRemoveFileResponse:
+        vol = self.s.volumes.get(request.volume_id)
+        if vol is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        path = request.path.lstrip("/")
+        if request.recursive:
+            for p in list(vol.files):
+                if p == path or p.startswith(path + "/"):
+                    del vol.files[p]
+        elif path in vol.files:
+            del vol.files[path]
+        else:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"file {path!r} not found")
+        return api_pb2.VolumeRemoveFileResponse()
+
+    async def VolumeCopyFiles(self, request, context) -> api_pb2.VolumeCopyFilesResponse:
+        vol = self.s.volumes.get(request.volume_id)
+        if vol is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        dst = request.dst_path.lstrip("/")
+        for src in request.src_paths:
+            src = src.lstrip("/")
+            f = vol.files.get(src)
+            if f is None:
+                await context.abort(grpc.StatusCode.NOT_FOUND, f"file {src!r} not found")
+            new = api_pb2.VolumeFile()
+            new.CopyFrom(f)
+            new.path = (dst.rstrip("/") + "/" + src.rsplit("/", 1)[-1]) if dst.endswith("/") or len(request.src_paths) > 1 else dst
+            vol.files[new.path] = new
+        return api_pb2.VolumeCopyFilesResponse()
+
+    async def VolumeCommit(self, request, context) -> api_pb2.VolumeCommitResponse:
+        vol = self.s.volumes.get(request.volume_id)
+        if vol is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        vol.committed_version += 1
+        return api_pb2.VolumeCommitResponse(skip_reload=False)
+
+    async def VolumeReload(self, request, context) -> api_pb2.VolumeReloadResponse:
+        if request.volume_id not in self.s.volumes:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return api_pb2.VolumeReloadResponse()
+
+    async def VolumeRename(self, request, context) -> api_pb2.VolumeRenameResponse:
+        vol = self.s.volumes.get(request.volume_id)
+        if vol is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        for key, vid in list(self.s.deployed_volumes.items()):
+            if vid == vol.volume_id:
+                del self.s.deployed_volumes[key]
+                self.s.deployed_volumes[(key[0], request.name)] = vid
+        vol.name = request.name
+        return api_pb2.VolumeRenameResponse()
+
+    async def VolumeDelete(self, request, context) -> api_pb2.VolumeDeleteResponse:
+        vol = self.s.volumes.pop(request.volume_id, None)
+        if vol is not None:
+            for key, vid in list(self.s.deployed_volumes.items()):
+                if vid == request.volume_id:
+                    del self.s.deployed_volumes[key]
+        return api_pb2.VolumeDeleteResponse()
+
+    async def VolumeList(self, request, context) -> api_pb2.VolumeListResponse:
+        items = [
+            api_pb2.VolumeListItem(volume_id=v.volume_id, name=v.name, created_at=v.created_at, version=v.version)
+            for v in self.s.volumes.values()
+            if v.name
+        ]
+        return api_pb2.VolumeListResponse(items=items)
+
+    # ------------------------------------------------------------------
+    # Secrets
+    # ------------------------------------------------------------------
+
+    async def SecretGetOrCreate(self, request: api_pb2.SecretGetOrCreateRequest, context) -> api_pb2.SecretGetOrCreateResponse:
+        if request.object_creation_type in (ANONYMOUS, EPHEMERAL) or (
+            not request.deployment_name and request.env_dict
+        ):
+            secret_id = make_id("st")
+            self.s.secrets[secret_id] = SecretState(secret_id=secret_id, env_dict=dict(request.env_dict))
+            return api_pb2.SecretGetOrCreateResponse(secret_id=secret_id)
+        key = (request.environment_name, request.deployment_name)
+        secret_id = self.s.deployed_secrets.get(key)
+        if secret_id is None:
+            if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS) and not request.env_dict:
+                await context.abort(grpc.StatusCode.NOT_FOUND, f"secret {request.deployment_name!r} not found")
+            secret_id = make_id("st")
+            self.s.secrets[secret_id] = SecretState(
+                secret_id=secret_id, name=request.deployment_name, env_dict=dict(request.env_dict)
+            )
+            self.s.deployed_secrets[key] = secret_id
+        elif request.object_creation_type == FAIL_IF_EXISTS:
+            await context.abort(grpc.StatusCode.ALREADY_EXISTS, "secret exists")
+        elif request.env_dict:
+            self.s.secrets[secret_id].env_dict = dict(request.env_dict)
+        self.s.secrets[secret_id].last_used_at = time.time()
+        return api_pb2.SecretGetOrCreateResponse(secret_id=secret_id)
+
+    async def SecretList(self, request, context) -> api_pb2.SecretListResponse:
+        items = [
+            api_pb2.SecretListItem(
+                label=s.name, created_at=s.created_at, last_used_at=s.last_used_at, secret_id=s.secret_id
+            )
+            for s in self.s.secrets.values()
+            if s.name
+        ]
+        return api_pb2.SecretListResponse(items=items)
+
+    async def SecretDelete(self, request, context) -> api_pb2.SecretDeleteResponse:
+        secret = self.s.secrets.pop(request.secret_id, None)
+        if secret is not None:
+            for key, sid in list(self.s.deployed_secrets.items()):
+                if sid == request.secret_id:
+                    del self.s.deployed_secrets[key]
+        return api_pb2.SecretDeleteResponse()
+
+    # ------------------------------------------------------------------
+    # Dicts
+    # ------------------------------------------------------------------
+
+    async def DictGetOrCreate(self, request: api_pb2.DictGetOrCreateRequest, context) -> api_pb2.DictGetOrCreateResponse:
+        if request.object_creation_type == EPHEMERAL or not request.deployment_name:
+            dict_id = make_id("di")
+            self.s.dicts[dict_id] = DictState(dict_id=dict_id)
+            return api_pb2.DictGetOrCreateResponse(dict_id=dict_id)
+        key = (request.environment_name, request.deployment_name)
+        dict_id = self.s.deployed_dicts.get(key)
+        if dict_id is None:
+            if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
+                await context.abort(grpc.StatusCode.NOT_FOUND, f"dict {request.deployment_name!r} not found")
+            dict_id = make_id("di")
+            self.s.dicts[dict_id] = DictState(dict_id=dict_id, name=request.deployment_name)
+            self.s.deployed_dicts[key] = dict_id
+        return api_pb2.DictGetOrCreateResponse(dict_id=dict_id)
+
+    async def DictUpdate(self, request, context) -> api_pb2.DictUpdateResponse:
+        d = self.s.dicts.get(request.dict_id)
+        if d is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "dict not found")
+        if request.if_not_exists and len(request.updates) == 1:
+            entry = request.updates[0]
+            if bytes(entry.key) in d.data:
+                return api_pb2.DictUpdateResponse(created=False)
+            d.data[bytes(entry.key)] = bytes(entry.value)
+            return api_pb2.DictUpdateResponse(created=True)
+        for entry in request.updates:
+            d.data[bytes(entry.key)] = bytes(entry.value)
+        return api_pb2.DictUpdateResponse(created=True)
+
+    async def DictGet(self, request, context) -> api_pb2.DictGetResponse:
+        d = self.s.dicts.get(request.dict_id)
+        if d is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "dict not found")
+        value = d.data.get(bytes(request.key))
+        return api_pb2.DictGetResponse(found=value is not None, value=value or b"")
+
+    async def DictPop(self, request, context) -> api_pb2.DictPopResponse:
+        d = self.s.dicts.get(request.dict_id)
+        if d is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "dict not found")
+        value = d.data.pop(bytes(request.key), None)
+        return api_pb2.DictPopResponse(found=value is not None, value=value or b"")
+
+    async def DictContains(self, request, context) -> api_pb2.DictContainsResponse:
+        d = self.s.dicts.get(request.dict_id)
+        if d is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "dict not found")
+        return api_pb2.DictContainsResponse(found=bytes(request.key) in d.data)
+
+    async def DictLen(self, request, context) -> api_pb2.DictLenResponse:
+        d = self.s.dicts.get(request.dict_id)
+        if d is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "dict not found")
+        return api_pb2.DictLenResponse(len=len(d.data))
+
+    async def DictContents(self, request, context) -> api_pb2.DictContentsResponse:
+        d = self.s.dicts.get(request.dict_id)
+        if d is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "dict not found")
+        return api_pb2.DictContentsResponse(
+            items=[api_pb2.DictEntry(key=k, value=v) for k, v in d.data.items()]
+        )
+
+    async def DictClear(self, request, context) -> api_pb2.DictClearResponse:
+        d = self.s.dicts.get(request.dict_id)
+        if d is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "dict not found")
+        d.data.clear()
+        return api_pb2.DictClearResponse()
+
+    async def DictDelete(self, request, context) -> api_pb2.DictDeleteResponse:
+        d = self.s.dicts.pop(request.dict_id, None)
+        if d is not None:
+            for key, did in list(self.s.deployed_dicts.items()):
+                if did == request.dict_id:
+                    del self.s.deployed_dicts[key]
+        return api_pb2.DictDeleteResponse()
+
+    async def DictList(self, request, context) -> api_pb2.DictListResponse:
+        items = [
+            api_pb2.DictListItem(name=d.name, created_at=d.created_at, dict_id=d.dict_id)
+            for d in self.s.dicts.values()
+            if d.name
+        ]
+        return api_pb2.DictListResponse(items=items)
+
+    # ------------------------------------------------------------------
+    # Queues
+    # ------------------------------------------------------------------
+
+    async def QueueGetOrCreate(self, request: api_pb2.QueueGetOrCreateRequest, context) -> api_pb2.QueueGetOrCreateResponse:
+        if request.object_creation_type == EPHEMERAL or not request.deployment_name:
+            queue_id = make_id("qu")
+            self.s.queues[queue_id] = QueueState(queue_id=queue_id)
+            return api_pb2.QueueGetOrCreateResponse(queue_id=queue_id)
+        key = (request.environment_name, request.deployment_name)
+        queue_id = self.s.deployed_queues.get(key)
+        if queue_id is None:
+            if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
+                await context.abort(grpc.StatusCode.NOT_FOUND, f"queue {request.deployment_name!r} not found")
+            queue_id = make_id("qu")
+            self.s.queues[queue_id] = QueueState(queue_id=queue_id, name=request.deployment_name)
+            self.s.deployed_queues[key] = queue_id
+        return api_pb2.QueueGetOrCreateResponse(queue_id=queue_id)
+
+    async def QueuePut(self, request: api_pb2.QueuePutRequest, context) -> api_pb2.QueuePutResponse:
+        q = self.s.queues.get(request.queue_id)
+        if q is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "queue not found")
+        part = q.partition(request.partition_key)
+        for v in request.values:
+            part.next_entry += 1
+            part.items.append((str(part.next_entry), bytes(v)))
+        async with part.condition:
+            part.condition.notify_all()
+        return api_pb2.QueuePutResponse()
+
+    async def QueueGet(self, request: api_pb2.QueueGetRequest, context) -> api_pb2.QueueGetResponse:
+        q = self.s.queues.get(request.queue_id)
+        if q is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "queue not found")
+        part = q.partition(request.partition_key)
+        n = max(1, request.n_values)
+        deadline = time.monotonic() + (request.timeout or 0.0)
+        while True:
+            if part.items:
+                taken = part.items[:n]
+                del part.items[:n]
+                return api_pb2.QueueGetResponse(values=[v for _, v in taken])
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return api_pb2.QueueGetResponse(values=[])
+            async with part.condition:
+                try:
+                    await asyncio.wait_for(part.condition.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def QueueNextItems(self, request: api_pb2.QueueNextItemsRequest, context) -> api_pb2.QueueNextItemsResponse:
+        q = self.s.queues.get(request.queue_id)
+        if q is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "queue not found")
+        part = q.partition(request.partition_key)
+        last = int(request.last_entry_id) if request.last_entry_id else 0
+        deadline = time.monotonic() + (request.item_poll_timeout or 0.0)
+        while True:
+            items = [
+                api_pb2.QueueItem(value=v, entry_id=eid) for eid, v in part.items if int(eid) > last
+            ]
+            if items:
+                return api_pb2.QueueNextItemsResponse(items=items)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return api_pb2.QueueNextItemsResponse(items=[])
+            async with part.condition:
+                try:
+                    await asyncio.wait_for(part.condition.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def QueueLen(self, request, context) -> api_pb2.QueueLenResponse:
+        q = self.s.queues.get(request.queue_id)
+        if q is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "queue not found")
+        if request.total:
+            return api_pb2.QueueLenResponse(len=sum(len(p.items) for p in q.partitions.values()))
+        return api_pb2.QueueLenResponse(len=len(q.partition(request.partition_key).items))
+
+    async def QueueClear(self, request, context) -> api_pb2.QueueClearResponse:
+        q = self.s.queues.get(request.queue_id)
+        if q is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "queue not found")
+        if request.all_partitions:
+            q.partitions.clear()
+        else:
+            q.partition(request.partition_key).items.clear()
+        return api_pb2.QueueClearResponse()
+
+    async def QueueDelete(self, request, context) -> api_pb2.QueueDeleteResponse:
+        q = self.s.queues.pop(request.queue_id, None)
+        if q is not None:
+            for key, qid in list(self.s.deployed_queues.items()):
+                if qid == request.queue_id:
+                    del self.s.deployed_queues[key]
+        return api_pb2.QueueDeleteResponse()
+
+    async def QueueList(self, request, context) -> api_pb2.QueueListResponse:
+        items = [
+            api_pb2.QueueListItem(
+                name=q.name,
+                created_at=q.created_at,
+                num_partitions=len(q.partitions),
+                total_size=sum(len(p.items) for p in q.partitions.values()),
+                queue_id=q.queue_id,
+            )
+            for q in self.s.queues.values()
+            if q.name
+        ]
+        return api_pb2.QueueListResponse(items=items)

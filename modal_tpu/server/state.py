@@ -1,0 +1,304 @@
+"""Control-plane state: the in-memory data model.
+
+Shaped after the reference's test servicer state (reference:
+py/test/conftest.py:701-820 MockClientServicer — apps, functions, input/output
+queues, volumes, secrets) but built as a real backend: long-poll conditions,
+task/worker scheduling state, gang (pod-slice) allocation, and an on-disk blob
++ volume-block store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..proto import api_pb2
+
+_id_counters: dict[str, itertools.count] = {}
+
+
+def make_id(prefix: str) -> str:
+    counter = _id_counters.setdefault(prefix, itertools.count(1))
+    return f"{prefix}-{next(counter):08d}"
+
+
+@dataclass
+class AppState:
+    app_id: str
+    description: str = ""
+    name: str = ""
+    state: int = api_pb2.APP_STATE_INITIALIZING
+    environment_name: str = ""
+    created_at: float = field(default_factory=time.time)
+    stopped_at: float = 0.0
+    last_heartbeat: float = field(default_factory=time.time)
+    function_ids: dict[str, str] = field(default_factory=dict)
+    class_ids: dict[str, str] = field(default_factory=dict)
+    deployment_history: list[api_pb2.AppDeploymentHistory] = field(default_factory=list)
+    version: int = 0
+    log_entries: list[api_pb2.TaskLogs] = field(default_factory=list)
+    log_condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    done: bool = False
+
+
+@dataclass
+class InputState:
+    input_id: str
+    function_call_id: str
+    idx: int
+    input: api_pb2.FunctionInput
+    status: str = "pending"  # pending | claimed | done | cancelled
+    retry_count: int = 0
+    claimed_by: str = ""  # task_id
+    claimed_at: float = 0.0
+    created_at: float = field(default_factory=time.time)
+    # gang broadcast: which gang members have received this input
+    delivered_to: set = field(default_factory=set)
+
+
+@dataclass
+class FunctionCallState:
+    function_call_id: str
+    function_id: str
+    call_type: int = api_pb2.FUNCTION_CALL_TYPE_UNARY
+    invocation_type: int = api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
+    created_at: float = field(default_factory=time.time)
+    input_ids: list[str] = field(default_factory=list)
+    outputs: list[api_pb2.FunctionGetOutputsItem] = field(default_factory=list)
+    outputs_consumed: int = 0
+    output_condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    data_chunks: list[api_pb2.DataChunk] = field(default_factory=list)
+    data_condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    num_inputs: int = 0
+    num_done: int = 0
+    cancelled: bool = False
+    return_exceptions: bool = False
+
+
+@dataclass
+class FunctionState:
+    function_id: str
+    app_id: str
+    tag: str
+    definition: api_pb2.Function
+    created_at: float = field(default_factory=time.time)
+    # queue of pending input_ids awaiting a container
+    pending: list[str] = field(default_factory=list)
+    input_condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    # autoscaler bookkeeping
+    task_ids: set[str] = field(default_factory=set)
+    web_url: str = ""
+    bound_parent: Optional[str] = None  # parametrized variant parent id
+    serialized_params: bytes = b""
+    autoscaler_override: Optional[api_pb2.AutoscalerSettings] = None
+
+    @property
+    def autoscaler(self) -> api_pb2.AutoscalerSettings:
+        return self.autoscaler_override or self.definition.autoscaler_settings
+
+
+@dataclass
+class TaskState_:
+    task_id: str
+    function_id: str
+    app_id: str
+    state: int = api_pb2.TASK_STATE_QUEUED
+    worker_id: str = ""
+    rank: int = 0
+    cluster_id: str = ""
+    created_at: float = field(default_factory=time.time)
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    last_heartbeat: float = 0.0
+    cancelled_input_ids: list[str] = field(default_factory=list)
+    terminate: bool = False
+    result: Optional[api_pb2.GenericResult] = None
+    tpu_chip_ids: list[int] = field(default_factory=list)
+    container_address: str = ""
+
+
+@dataclass
+class ClusterState:
+    """A gang: N tasks co-scheduled on one pod slice (TPU-native analogue of
+    the reference's i6pn cluster, _clustered_functions.py)."""
+
+    cluster_id: str
+    function_id: str
+    size: int
+    task_ids: list[str] = field(default_factory=list)  # rank order
+    reported: dict[str, str] = field(default_factory=dict)  # task_id -> container addr
+    coordinator_port: int = 0
+    condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    slice_info: Optional[api_pb2.TPUSliceInfo] = None
+
+
+@dataclass
+class WorkerState:
+    worker_id: str
+    hostname: str = ""
+    tpu_type: str = ""
+    num_chips: int = 0
+    topology: str = ""
+    milli_cpu: int = 0
+    memory_mb: int = 0
+    container_address: str = ""
+    slice_index: int = 0
+    last_heartbeat: float = field(default_factory=time.time)
+    # assignment channel consumed by the worker's WorkerPoll stream
+    events: asyncio.Queue = field(default_factory=asyncio.Queue)
+    active_tasks: set[str] = field(default_factory=set)
+    chips_in_use: dict[int, str] = field(default_factory=dict)  # chip_id -> task_id
+
+    def free_chips(self) -> list[int]:
+        return [c for c in range(self.num_chips) if c not in self.chips_in_use]
+
+
+@dataclass
+class VolumeState:
+    volume_id: str
+    name: str = ""
+    version: int = api_pb2.VOLUME_FS_VERSION_V2
+    created_at: float = field(default_factory=time.time)
+    files: dict[str, api_pb2.VolumeFile] = field(default_factory=dict)
+    committed_version: int = 0
+
+
+@dataclass
+class SecretState:
+    secret_id: str
+    name: str = ""
+    env_dict: dict[str, str] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+    last_used_at: float = 0.0
+
+
+@dataclass
+class DictState:
+    dict_id: str
+    name: str = ""
+    data: dict[bytes, bytes] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class QueuePartition:
+    items: list[tuple[str, bytes]] = field(default_factory=list)  # (entry_id, value)
+    condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    next_entry: int = 0
+
+
+@dataclass
+class QueueState:
+    queue_id: str
+    name: str = ""
+    partitions: dict[str, QueuePartition] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+
+    def partition(self, key: str) -> QueuePartition:
+        return self.partitions.setdefault(key, QueuePartition())
+
+
+@dataclass
+class ImageState:
+    image_id: str
+    definition: api_pb2.Image
+    metadata: api_pb2.ImageMetadata = field(default_factory=api_pb2.ImageMetadata)
+    built: bool = False
+    build_logs: list[api_pb2.TaskLogs] = field(default_factory=list)
+
+
+@dataclass
+class SandboxState_:
+    sandbox_id: str
+    app_id: str
+    definition: api_pb2.Sandbox
+    state: int = api_pb2.SANDBOX_STATE_PENDING
+    task_id: str = ""
+    created_at: float = field(default_factory=time.time)
+    result: Optional[api_pb2.GenericResult] = None
+    condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    stdin_chunks: list[bytes] = field(default_factory=list)
+    stdin_eof: bool = False
+    name: str = ""
+
+
+class ServerState:
+    """All control-plane state + the on-disk stores."""
+
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        self.blob_dir = os.path.join(state_dir, "blobs")
+        self.block_dir = os.path.join(state_dir, "volume_blocks")
+        os.makedirs(self.blob_dir, exist_ok=True)
+        os.makedirs(self.block_dir, exist_ok=True)
+
+        self.apps: dict[str, AppState] = {}
+        self.deployed_apps: dict[tuple[str, str], str] = {}  # (env, name) -> app_id
+        self.functions: dict[str, FunctionState] = {}
+        self.deployed_functions: dict[tuple[str, str, str], str] = {}  # (env, app_name, tag) -> fn_id
+        self.inputs: dict[str, InputState] = {}
+        self.function_calls: dict[str, FunctionCallState] = {}
+        self.tasks: dict[str, TaskState_] = {}
+        self.clusters: dict[str, ClusterState] = {}
+        self.workers: dict[str, WorkerState] = {}
+        self.volumes: dict[str, VolumeState] = {}
+        self.deployed_volumes: dict[tuple[str, str], str] = {}
+        self.secrets: dict[str, SecretState] = {}
+        self.deployed_secrets: dict[tuple[str, str], str] = {}
+        self.dicts: dict[str, DictState] = {}
+        self.deployed_dicts: dict[tuple[str, str], str] = {}
+        self.queues: dict[str, QueueState] = {}
+        self.deployed_queues: dict[tuple[str, str], str] = {}
+        self.images: dict[str, ImageState] = {}
+        self.images_by_hash: dict[str, str] = {}
+        self.sandboxes: dict[str, SandboxState_] = {}
+        self.blob_url_base: str = ""  # set by supervisor once blob server is up
+
+        # scheduling wakeup
+        self.schedule_event = asyncio.Event()
+
+    # -- blob store ---------------------------------------------------------
+
+    def blob_path(self, blob_id: str) -> str:
+        return os.path.join(self.blob_dir, blob_id)
+
+    def block_path(self, sha256_hex: str) -> str:
+        return os.path.join(self.block_dir, sha256_hex)
+
+    def put_block(self, sha256_hex: str, data: bytes) -> None:
+        path = self.block_path(sha256_hex)
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+
+    def has_block(self, sha256_hex: str) -> bool:
+        return os.path.exists(self.block_path(sha256_hex))
+
+    def get_block(self, sha256_hex: str, offset: int = 0, length: int = 0) -> bytes:
+        with open(self.block_path(sha256_hex), "rb") as f:
+            f.seek(offset)
+            return f.read(length) if length else f.read()
+
+    # -- helpers ------------------------------------------------------------
+
+    def app_log(self, app_id: str, data: str, task_id: str = "", fd: int = 1, function_call_id: str = "") -> None:
+        app = self.apps.get(app_id)
+        if app is None:
+            return
+        app.log_entries.append(
+            api_pb2.TaskLogs(
+                data=data, task_id=task_id, file_descriptor=fd, timestamp=time.time(), function_call_id=function_call_id
+            )
+        )
+
+    async def notify_logs(self, app_id: str) -> None:
+        app = self.apps.get(app_id)
+        if app is not None:
+            async with app.log_condition:
+                app.log_condition.notify_all()

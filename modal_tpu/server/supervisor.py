@@ -1,0 +1,94 @@
+"""LocalSupervisor: control plane + blob server + workers in one process.
+
+The single-host orchestrator (SURVEY §7 step 3): an asyncio gRPC server with
+the full servicer, an HTTP blob store, a scheduler, and N in-process worker
+agents that spawn container subprocesses. Scales out later by running
+`python -m modal_tpu.server` (control plane) and `python -m
+modal_tpu.server.worker_main` (per host) separately — same code paths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+import grpc
+
+from ..config import config, logger
+from ..proto.rpc import build_generic_handler
+from .blob_server import BlobServer
+from .scheduler import Scheduler
+from .services import ModalTPUServicer
+from .state import ServerState
+from .worker import WorkerAgent
+
+
+class LocalSupervisor:
+    def __init__(
+        self,
+        num_workers: int = 1,
+        port: int = 0,
+        state_dir: Optional[str] = None,
+        worker_chips: Optional[int] = None,
+        worker_tpu_type: Optional[str] = None,
+    ):
+        self.num_workers = num_workers
+        self.port = port
+        self.state_dir = state_dir or config["state_dir"]
+        self.worker_chips = worker_chips
+        self.worker_tpu_type = worker_tpu_type
+        self.state = ServerState(self.state_dir)
+        self.servicer = ModalTPUServicer(self.state)
+        self.scheduler = Scheduler(self.state, self.servicer)
+        self.blob_server = BlobServer(self.state)
+        self.workers: list[WorkerAgent] = []
+        self._grpc_server: Optional[grpc.aio.Server] = None
+
+    @property
+    def server_url(self) -> str:
+        return f"grpc://127.0.0.1:{self.port}"
+
+    async def start(self) -> None:
+        os.makedirs(self.state_dir, exist_ok=True)
+        self._grpc_server = grpc.aio.server(
+            options=[
+                ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+                ("grpc.max_send_message_length", 128 * 1024 * 1024),
+            ]
+        )
+        self._grpc_server.add_generic_rpc_handlers((build_generic_handler(self.servicer),))
+        self.port = self._grpc_server.add_insecure_port(f"127.0.0.1:{self.port}")
+        await self._grpc_server.start()
+        await self.blob_server.start()
+        self.scheduler.start()
+        for i in range(self.num_workers):
+            worker = WorkerAgent(
+                self.server_url,
+                num_chips=self.worker_chips,
+                tpu_type=self.worker_tpu_type,
+                state_dir=self.state_dir,
+            )
+            await worker.start()
+            self.workers.append(worker)
+        logger.debug(f"local supervisor up at {self.server_url} ({self.num_workers} workers)")
+
+    async def stop(self) -> None:
+        for worker in self.workers:
+            await worker.stop()
+        await self.scheduler.stop()
+        await self.blob_server.stop()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.5)
+
+
+async def serve_forever(
+    port: int = 9900, num_workers: int = 1, state_dir: Optional[str] = None
+) -> None:
+    sup = LocalSupervisor(num_workers=num_workers, port=port, state_dir=state_dir)
+    await sup.start()
+    print(f"modal_tpu control plane listening on {sup.server_url}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await sup.stop()

@@ -1,0 +1,319 @@
+"""Worker agent: the host daemon that runs containers.
+
+Net-new relative to the reference (its worker fleet is closed; the contract it
+must satisfy is visible in the container entrypoint it boots — reference
+_container_entrypoint.py:475-490: write ContainerArguments to a file, point
+the env at it, exec the entrypoint).
+
+The local worker runs containers as subprocesses of this host (the "container
+image" is the worker's own venv in v0). TPU chips are pinned per task via
+TPU_VISIBLE_DEVICES; CPU-only/test runs force JAX_PLATFORMS=cpu.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import sys
+import tempfile
+import time
+from typing import Optional
+
+from ..config import config, logger
+from ..proto import api_pb2
+from .._utils.grpc_utils import create_channel, retry_transient_errors
+from ..proto.rpc import ModalTPUStub
+
+
+def detect_tpu_inventory() -> tuple[str, int, str]:
+    """(tpu_type, num_chips, topology) for this host. Env overrides let tests
+    simulate multi-chip hosts."""
+    env_type = os.environ.get("MODAL_TPU_WORKER_TPU_TYPE")
+    if env_type is not None:
+        return env_type, int(os.environ.get("MODAL_TPU_WORKER_NUM_CHIPS", "0")), os.environ.get(
+            "MODAL_TPU_WORKER_TOPOLOGY", ""
+        )
+    # Probe without initializing jax in this process (jax init pins devices);
+    # the venv worker assumes chips are visible to subprocesses only.
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; d=jax.devices(); print(len(d), d[0].platform)"],
+            capture_output=True,
+            timeout=120,
+            text=True,
+        )
+        if out.returncode == 0:
+            n, platform = out.stdout.split()
+            if platform in ("tpu", "axon"):
+                return f"local-{platform}", int(n), ""
+    except Exception as exc:
+        logger.debug(f"tpu probe failed: {exc}")
+    return "", 0, ""
+
+
+class WorkerAgent:
+    """Registers with the control plane, polls for assignments, runs
+    container subprocesses, reports exits."""
+
+    def __init__(
+        self,
+        server_url: str,
+        worker_id: Optional[str] = None,
+        num_chips: Optional[int] = None,
+        tpu_type: Optional[str] = None,
+        state_dir: Optional[str] = None,
+    ):
+        self.server_url = server_url
+        self.worker_id = worker_id or ""
+        self._override_chips = num_chips
+        self._override_type = tpu_type
+        self.state_dir = state_dir or config["state_dir"]
+        self._procs: dict[str, asyncio.subprocess.Process] = {}
+        self._channel = None
+        self._stub: Optional[ModalTPUStub] = None
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+
+    async def start(self) -> None:
+        os.makedirs(os.path.join(self.state_dir, "tasks"), exist_ok=True)
+        self._channel = create_channel(self.server_url)
+        self._stub = ModalTPUStub(self._channel)
+        tpu_type, num_chips, topology = detect_tpu_inventory()
+        if self._override_chips is not None:
+            num_chips = self._override_chips
+        if self._override_type is not None:
+            tpu_type = self._override_type
+        resp = await retry_transient_errors(
+            self._stub.WorkerRegister,
+            api_pb2.WorkerRegisterRequest(
+                worker_id=self.worker_id,
+                hostname=os.uname().nodename,
+                tpu_type=tpu_type,
+                num_chips=num_chips,
+                topology=topology,
+                milli_cpu=(os.cpu_count() or 1) * 1000,
+                memory_mb=16384,
+                container_address="127.0.0.1",
+            ),
+            max_retries=10,
+            max_delay=2.0,
+        )
+        self.worker_id = resp.worker_id
+        self._tasks.append(asyncio.create_task(self._poll_loop(), name=f"worker-poll-{self.worker_id}"))
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop(), name=f"worker-hb-{self.worker_id}"))
+        logger.debug(f"worker {self.worker_id} registered ({num_chips} chips, type={tpu_type!r})")
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for task in self._tasks:
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        for task_id, proc in list(self._procs.items()):
+            await self._kill_proc(proc)
+        if self._channel is not None:
+            await self._channel.close()
+
+    async def _kill_proc(self, proc: asyncio.subprocess.Process) -> None:
+        if proc.returncode is None:
+            try:
+                proc.terminate()
+                try:
+                    await asyncio.wait_for(proc.wait(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    proc.kill()
+                    await proc.wait()
+            except ProcessLookupError:
+                pass
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._stopped:
+            try:
+                await retry_transient_errors(
+                    self._stub.WorkerHeartbeat,
+                    api_pb2.WorkerHeartbeatRequest(
+                        worker_id=self.worker_id, active_task_ids=list(self._procs.keys())
+                    ),
+                    max_retries=2,
+                )
+            except Exception as exc:
+                logger.warning(f"worker heartbeat failed: {exc}")
+            await asyncio.sleep(5.0)
+
+    async def _poll_loop(self) -> None:
+        while not self._stopped:
+            try:
+                async for event in self._stub.WorkerPoll(
+                    api_pb2.WorkerPollRequest(worker_id=self.worker_id)
+                ):
+                    which = event.WhichOneof("event_oneof")
+                    if which == "assignment":
+                        asyncio.create_task(self._run_task(event.assignment))
+                    elif which == "stop":
+                        await self._stop_task(event.stop)
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                if self._stopped:
+                    return
+                logger.warning(f"worker poll stream broke ({exc}); reconnecting")
+                await asyncio.sleep(0.5)
+
+    async def _stop_task(self, stop: api_pb2.TaskStopEvent) -> None:
+        proc = self._procs.get(stop.task_id)
+        if proc is not None:
+            logger.debug(f"stopping task {stop.task_id}")
+            if stop.force:
+                proc.kill()
+            else:
+                try:
+                    proc.terminate()
+                except ProcessLookupError:
+                    pass
+
+    async def _run_task(self, assignment: api_pb2.TaskAssignment) -> None:
+        task_id = assignment.task_id
+        args = assignment.container_arguments
+        args.server_url = self.server_url
+        task_dir = os.path.join(self.state_dir, "tasks", task_id)
+        os.makedirs(task_dir, exist_ok=True)
+        args_path = os.path.join(task_dir, "container_arguments.pb")
+        with open(args_path, "wb") as f:
+            f.write(args.SerializeToString())
+
+        env = dict(os.environ)
+        env.update(dict(args.env))
+        env["MODAL_TPU_CONTAINER_ARGS_PATH"] = args_path
+        env["MODAL_TPU_SERVER_URL"] = self.server_url
+        env["MODAL_TPU_TASK_ID"] = task_id
+        env["MODAL_TPU_TASK_DIR"] = task_dir
+        # sys.path propagation for "file"-defined functions
+        globals_path = args.function_def.experimental_options.get("globals_path", "")
+        if globals_path:
+            env["PYTHONPATH"] = globals_path + os.pathsep + env.get("PYTHONPATH", "")
+        # repo root so `modal_tpu` imports inside the bare subprocess
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # TPU chip pinning / platform selection
+        jax_platform = config["jax_platform"]
+        tpu_cfg = args.function_def.resources.tpu_config
+        if assignment.tpu_chip_ids and not jax_platform:
+            env["TPU_VISIBLE_DEVICES"] = ",".join(str(c) for c in assignment.tpu_chip_ids)
+            env.setdefault("TPU_PROCESS_BOUNDS", "1,1,1")
+        elif tpu_cfg.tpu_type and jax_platform == "cpu":
+            # tests: simulate the slice's chips on CPU; deactivate the axon
+            # TPU-tunnel plugin (it would prepend itself to jax_platforms)
+            from ..tpu_config import parse_tpu_config
+
+            spec = parse_tpu_config(tpu_cfg.tpu_type)
+            chips = spec.chips_per_host if args.world_size > 1 else spec.chips
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={max(1, chips)} " + env.get("XLA_FLAGS", "")
+            )
+        elif jax_platform:
+            env["JAX_PLATFORMS"] = jax_platform
+            if jax_platform == "cpu":
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+
+        stdout_path = os.path.join(task_dir, "stdout.log")
+        stderr_path = os.path.join(task_dir, "stderr.log")
+        with open(stdout_path, "wb") as out_f, open(stderr_path, "wb") as err_f:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable,
+                "-u",
+                "-m",
+                "modal_tpu.runtime.container_entrypoint",
+                env=env,
+                stdout=out_f,
+                stderr=err_f,
+                cwd=globals_path or None,
+            )
+        self._procs[task_id] = proc
+        logger.debug(f"task {task_id} started pid={proc.pid}")
+        tail_task = asyncio.create_task(self._stream_logs(task_id, stdout_path, stderr_path, proc))
+        returncode = await proc.wait()
+        del self._procs[task_id]
+        tail_task.cancel()
+        try:
+            await tail_task
+        except asyncio.CancelledError:
+            pass
+        if returncode != 0:
+            logger.warning(f"task {task_id} exited rc={returncode}")
+            # report failure for containers that died before TaskResult
+            try:
+                with open(stderr_path, "rb") as f:
+                    f.seek(max(0, os.path.getsize(stderr_path) - 4096))
+                    tail = f.read().decode(errors="replace")
+                await retry_transient_errors(
+                    self._stub.TaskResult,
+                    api_pb2.TaskResultRequest(
+                        task_id=task_id,
+                        result=api_pb2.GenericResult(
+                            status=api_pb2.GENERIC_STATUS_FAILURE,
+                            exception=f"container exited with code {returncode}",
+                            traceback=tail,
+                        ),
+                    ),
+                    max_retries=2,
+                )
+            except Exception as exc:
+                logger.warning(f"failed reporting task result: {exc}")
+        else:
+            try:
+                await retry_transient_errors(
+                    self._stub.TaskResult,
+                    api_pb2.TaskResultRequest(
+                        task_id=task_id,
+                        result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+                    ),
+                    max_retries=2,
+                )
+            except Exception:
+                pass
+
+    async def _stream_logs(
+        self, task_id: str, stdout_path: str, stderr_path: str, proc: asyncio.subprocess.Process
+    ) -> None:
+        """Tail container stdout/stderr into the control plane's app logs
+        (client reads them via AppGetLogs)."""
+        offsets = {stdout_path: 0, stderr_path: 0}
+        fds = {stdout_path: 1, stderr_path: 2}
+        while True:
+            sent_any = False
+            logs = []
+            for path, off in offsets.items():
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                if size > off:
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        data = f.read(64 * 1024)
+                    offsets[path] = off + len(data)
+                    logs.append(
+                        api_pb2.TaskLogs(
+                            data=data.decode(errors="replace"),
+                            task_id=task_id,
+                            file_descriptor=fds[path],
+                            timestamp=time.time(),
+                        )
+                    )
+                    sent_any = True
+            if logs:
+                try:
+                    await retry_transient_errors(
+                        self._stub.ContainerLog,
+                        api_pb2.ContainerLogRequest(task_id=task_id, logs=logs),
+                        max_retries=1,
+                    )
+                except Exception:
+                    pass
+            if proc.returncode is not None and not sent_any:
+                return
+            await asyncio.sleep(0.2 if not sent_any else 0.05)

@@ -1,0 +1,159 @@
+"""TPU slice specification — the TPU-native replacement for GPU config.
+
+The reference resolves free-form `gpu="H100:8"` strings into a `GPUConfig`
+proto (reference: py/modal/gpu.py + api.proto:2506). Here `tpu="v5p-64"`
+resolves into a `TPUConfig` proto carrying slice topology and mesh hints,
+which the scheduler uses for gang placement and the runtime uses to build the
+default `jax.sharding.Mesh`.
+
+Naming follows public TPU slice naming:
+  - v5p-N / v4-N: N TensorCores; chips = N/2; 4 chips per host.
+  - v5e-N / v6e-N: N chips; up to 4 chips per host (v5e-1/-2/-4 share one
+    host, larger slices are multiples of 4-chip hosts).
+ICI topology is a 2D torus for v5e/v6e and a 3D torus for v4/v5p.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Optional, Union
+
+from .exception import InvalidError
+from .proto import api_pb2
+
+_GENERATIONS = {
+    # name -> (cores_per_chip, chips_per_host, torus_dims)
+    "v4": (2, 4, 3),
+    "v5p": (2, 4, 3),
+    "v5e": (1, 4, 2),
+    "v6e": (1, 4, 2),
+    "v5lite": (1, 4, 2),
+}
+
+
+@dataclass(frozen=True)
+class TPUSliceSpec:
+    tpu_type: str         # canonical "v5p-64"
+    generation: str       # "v5p"
+    chips: int            # total chips in the slice
+    hosts: int            # number of hosts (== gang size for multi-host)
+    chips_per_host: int
+    topology: str         # e.g. "4x4x4" (chips per torus dimension)
+    mesh: dict[str, int]  # user-provided logical mesh hints (may be empty)
+
+    @property
+    def cores(self) -> int:
+        return self.chips * _GENERATIONS[self.generation][0]
+
+    def default_mesh(self) -> dict[str, int]:
+        """Default logical mesh when the user gave no hints: pure data/fsdp
+        split — fsdp within a host's ICI block, data across hosts."""
+        if self.mesh:
+            return dict(self.mesh)
+        if self.hosts == 1:
+            return {"data": 1, "fsdp": self.chips}
+        return {"data": self.hosts, "fsdp": self.chips_per_host}
+
+    def to_proto(self) -> api_pb2.TPUConfig:
+        cfg = api_pb2.TPUConfig(
+            tpu_type=self.tpu_type,
+            count=self.chips,
+            topology=self.topology,
+        )
+        for k, v in self.mesh.items():
+            cfg.mesh[k] = v
+        return cfg
+
+
+def _default_topology(generation: str, chips: int) -> str:
+    """Pick a near-square/cube torus for the chip count."""
+    _, _, ndims = _GENERATIONS[generation]
+    if chips == 1:
+        return "1x1" if ndims == 2 else "1x1x1"
+    dims = [1] * ndims
+    remaining = chips
+    # Greedy: repeatedly double the smallest dimension.
+    while remaining > 1:
+        i = dims.index(min(dims))
+        dims[i] *= 2
+        remaining //= 2
+        if remaining * math.prod(dims) // math.prod(dims) < 1:
+            break
+    if math.prod(dims) != chips:
+        # Non-power-of-two: fall back to 1D chain.
+        dims = [chips] + [1] * (ndims - 1)
+    return "x".join(str(d) for d in sorted(dims, reverse=True))
+
+
+def parse_tpu_config(
+    value: Union[str, "TPUSliceSpec", api_pb2.TPUConfig, None],
+    mesh: Optional[dict[str, int]] = None,
+) -> Optional[TPUSliceSpec]:
+    """Parse `tpu=` argument: "v5p-64", "v5e-4", "v5e-4:2x2", or a spec."""
+    if value is None:
+        return None
+    if isinstance(value, TPUSliceSpec):
+        return value
+    if isinstance(value, api_pb2.TPUConfig):
+        return from_proto(value)
+    if not isinstance(value, str):
+        raise InvalidError(f"tpu= must be a string like 'v5p-8', got {type(value).__name__}")
+
+    topology = None
+    if ":" in value:
+        value, topology = value.split(":", 1)
+    m = re.fullmatch(r"(v\d+[a-z]*)-(\d+)", value.strip().lower())
+    if not m:
+        raise InvalidError(
+            f"invalid TPU type {value!r}: expected '<generation>-<size>' like 'v5p-64' or 'v5e-4'"
+        )
+    generation, size = m.group(1), int(m.group(2))
+    if generation not in _GENERATIONS:
+        raise InvalidError(
+            f"unknown TPU generation {generation!r}; known: {sorted(_GENERATIONS)}"
+        )
+    cores_per_chip, chips_per_host, _ = _GENERATIONS[generation]
+    # v5p-N counts cores; v5e-N counts chips.
+    chips = size // cores_per_chip if cores_per_chip > 1 else size
+    if chips < 1:
+        raise InvalidError(f"TPU slice {value!r} resolves to zero chips")
+    hosts = max(1, math.ceil(chips / chips_per_host))
+    actual_chips_per_host = min(chips, chips_per_host)
+    if topology is None:
+        topology = _default_topology(generation, chips)
+    spec = TPUSliceSpec(
+        tpu_type=f"{generation}-{size}",
+        generation=generation,
+        chips=chips,
+        hosts=hosts,
+        chips_per_host=actual_chips_per_host,
+        topology=topology,
+        mesh=dict(mesh or {}),
+    )
+    if mesh:
+        mesh_size = math.prod(mesh.values())
+        if mesh_size != chips:
+            raise InvalidError(
+                f"mesh axes {mesh} multiply to {mesh_size}, but {spec.tpu_type} has {chips} chips"
+            )
+    return spec
+
+
+def from_proto(cfg: api_pb2.TPUConfig) -> Optional[TPUSliceSpec]:
+    if not cfg.tpu_type:
+        return None
+    return parse_tpu_config(cfg.tpu_type, dict(cfg.mesh) or None)
+
+
+def slice_info_proto(spec: TPUSliceSpec) -> api_pb2.TPUSliceInfo:
+    info = api_pb2.TPUSliceInfo(
+        tpu_type=spec.tpu_type,
+        topology=spec.topology,
+        num_hosts=spec.hosts,
+        chips_per_host=spec.chips_per_host,
+    )
+    for k, v in spec.default_mesh().items():
+        info.default_mesh[k] = v
+    return info

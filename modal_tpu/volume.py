@@ -1,0 +1,314 @@
+"""Volumes: shared versioned filesystems with commit/reload semantics.
+
+Reference: py/modal/volume.py — `_Volume` (volume.py:351), commit/reload
+(volume.py:739,757), batch upload with content-addressed blocks
+(`_VolumeUploadContextManager2`, volume.py:1108), parallel block GET
+(volume.py:881-948).
+
+TPU-first: volumes are the checkpoint spine. Block-level content addressing
+(8 MiB sha256 blocks) gives dedup across checkpoint steps and parallel
+striped reads, and `read_file_into` streams blocks straight into
+caller-provided buffers so restore paths can feed `jax.device_put` per-shard
+without host-RAM spikes (SURVEY §7 hard part 6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass
+from pathlib import Path, PurePosixPath
+from typing import AsyncGenerator, BinaryIO, Optional, Union
+
+from ._utils.async_utils import TaskContext, synchronize_api
+from ._utils.grpc_utils import retry_transient_errors
+from ._utils.hash_utils import BLOCK_SIZE, get_sha256_hex
+from .client import _Client
+from .exception import InvalidError, NotFoundError
+from .object import LoadContext, Resolver, _Object, live_method, live_method_gen
+from .proto import api_pb2
+
+# Parallelism for block upload/download (reference multipart concurrency,
+# blob_utils.py:46).
+BLOCK_PARALLELISM = 16
+
+
+@dataclass
+class FileEntry:
+    path: str
+    size: int
+    mode: int
+    mtime: float
+
+    @classmethod
+    def _from_proto(cls, p: api_pb2.VolumeFile) -> "FileEntry":
+        return cls(path=p.path, size=p.size, mode=p.mode, mtime=p.mtime)
+
+
+class _Volume(_Object, type_prefix="vo"):
+    _metadata: Optional[api_pb2.VolumeMetadata] = None
+
+    def _initialize_from_empty(self) -> None:
+        self._metadata = None
+
+    def _hydrate_metadata(self, metadata: Optional[api_pb2.VolumeMetadata]) -> None:
+        self._metadata = metadata
+
+    def _get_metadata(self) -> Optional[bytes]:
+        return self._metadata.SerializeToString() if self._metadata else b""
+
+    @classmethod
+    def _deserialize_metadata(cls, metadata_bytes: bytes) -> Optional[api_pb2.VolumeMetadata]:
+        return api_pb2.VolumeMetadata.FromString(metadata_bytes) if metadata_bytes else None
+
+    @staticmethod
+    def from_name(
+        name: str,
+        *,
+        environment_name: Optional[str] = None,
+        create_if_missing: bool = False,
+        version: int = api_pb2.VOLUME_FS_VERSION_V2,
+    ) -> "_Volume":
+        async def _load(self: "_Volume", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+            req = api_pb2.VolumeGetOrCreateRequest(
+                deployment_name=name,
+                environment_name=environment_name or context.environment_name,
+                object_creation_type=(
+                    api_pb2.OBJECT_CREATION_TYPE_CREATE_IF_MISSING
+                    if create_if_missing
+                    else api_pb2.OBJECT_CREATION_TYPE_UNSPECIFIED
+                ),
+                version=version,
+            )
+            resp = await retry_transient_errors(context.client.stub.VolumeGetOrCreate, req)
+            self._hydrate(resp.volume_id, context.client, resp.metadata)
+
+        return _Volume._from_loader(_load, f"Volume.from_name({name!r})", hydrate_lazily=True)
+
+    @classmethod
+    async def ephemeral(
+        cls,
+        client: Optional[_Client] = None,
+        environment_name: Optional[str] = None,
+    ) -> "_Volume":
+        if client is None:
+            client = await _Client.from_env()
+        req = api_pb2.VolumeGetOrCreateRequest(
+            object_creation_type=api_pb2.OBJECT_CREATION_TYPE_EPHEMERAL,
+            environment_name=environment_name or "",
+            version=api_pb2.VOLUME_FS_VERSION_V2,
+        )
+        resp = await retry_transient_errors(client.stub.VolumeGetOrCreate, req)
+        return cls._new_hydrated(resp.volume_id, client, resp.metadata)
+
+    @staticmethod
+    async def lookup(name: str, *, client: Optional[_Client] = None, create_if_missing: bool = False) -> "_Volume":
+        obj = _Volume.from_name(name, create_if_missing=create_if_missing)
+        await obj.hydrate(client)
+        return obj
+
+    @staticmethod
+    async def create_deployed(name: str, *, client: Optional[_Client] = None) -> str:
+        obj = _Volume.from_name(name, create_if_missing=True)
+        await obj.hydrate(client)
+        return obj.object_id
+
+    # -- data plane ---------------------------------------------------------
+
+    @live_method
+    async def commit(self) -> None:
+        """Persist changes made in this container (reference volume.py:739)."""
+        await retry_transient_errors(self.client.stub.VolumeCommit, api_pb2.VolumeCommitRequest(volume_id=self.object_id))
+
+    @live_method
+    async def reload(self) -> None:
+        """See changes committed elsewhere (reference volume.py:757)."""
+        await retry_transient_errors(self.client.stub.VolumeReload, api_pb2.VolumeReloadRequest(volume_id=self.object_id))
+
+    @live_method_gen
+    async def iterdir(self, path: str = "/", recursive: bool = True) -> AsyncGenerator[FileEntry, None]:
+        resp = await retry_transient_errors(
+            self.client.stub.VolumeListFiles,
+            api_pb2.VolumeListFilesRequest(volume_id=self.object_id, path=path, recursive=recursive),
+        )
+        for f in resp.files:
+            yield FileEntry._from_proto(f)
+
+    @live_method
+    async def listdir(self, path: str = "/", recursive: bool = False) -> list[FileEntry]:
+        resp = await retry_transient_errors(
+            self.client.stub.VolumeListFiles,
+            api_pb2.VolumeListFilesRequest(volume_id=self.object_id, path=path, recursive=recursive),
+        )
+        return [FileEntry._from_proto(f) for f in resp.files]
+
+    @live_method_gen
+    async def read_file(self, path: str) -> AsyncGenerator[bytes, None]:
+        """Stream a file's content block-by-block with parallel prefetch."""
+        resp = await retry_transient_errors(
+            self.client.stub.VolumeGetFile2,
+            api_pb2.VolumeGetFile2Request(volume_id=self.object_id, path=path),
+        )
+        if not resp.file.path:
+            raise NotFoundError(f"file {path!r} not found in volume")
+        blocks = list(resp.file.block_sha256_hex)
+
+        async def _get(sha: str) -> bytes:
+            r = await retry_transient_errors(
+                self.client.stub.VolumeBlockGet, api_pb2.VolumeBlockGetRequest(sha256_hex=sha)
+            )
+            return r.data
+
+        # Pipeline: fetch up to BLOCK_PARALLELISM blocks ahead, yield in order.
+        pending: list[asyncio.Task] = []
+        idx = 0
+        while idx < len(blocks) or pending:
+            while len(pending) < BLOCK_PARALLELISM and idx < len(blocks):
+                pending.append(asyncio.ensure_future(_get(blocks[idx])))
+                idx += 1
+            data = await pending.pop(0)
+            yield data
+
+    @live_method
+    async def read_file_into(self, path: str, fileobj: BinaryIO) -> int:
+        """Stream a file into a caller-provided buffer/file object."""
+        total = 0
+        async for chunk in self.read_file(path):
+            fileobj.write(chunk)
+            total += len(chunk)
+        return total
+
+    @live_method
+    async def remove_file(self, path: str, recursive: bool = False) -> None:
+        await retry_transient_errors(
+            self.client.stub.VolumeRemoveFile,
+            api_pb2.VolumeRemoveFileRequest(volume_id=self.object_id, path=path, recursive=recursive),
+        )
+
+    @live_method
+    async def copy_files(self, src_paths: list[str], dst_path: str) -> None:
+        await retry_transient_errors(
+            self.client.stub.VolumeCopyFiles,
+            api_pb2.VolumeCopyFilesRequest(volume_id=self.object_id, src_paths=src_paths, dst_path=dst_path),
+        )
+
+    def batch_upload(self, force: bool = False) -> "_VolumeUploadContextManager":
+        """Batched, block-deduplicated parallel upload (reference
+        volume.py:1012 `batch_upload` → `_VolumeUploadContextManager2`)."""
+        return _VolumeUploadContextManager(self, force=force)
+
+    @staticmethod
+    async def delete(name: str, *, client: Optional[_Client] = None, environment_name: Optional[str] = None) -> None:
+        obj = await _Volume.lookup(name, client=client)
+        await retry_transient_errors(obj.client.stub.VolumeDelete, api_pb2.VolumeDeleteRequest(volume_id=obj.object_id))
+
+    @staticmethod
+    async def rename(old_name: str, new_name: str, *, client: Optional[_Client] = None) -> None:
+        obj = await _Volume.lookup(old_name, client=client)
+        await retry_transient_errors(
+            obj.client.stub.VolumeRename, api_pb2.VolumeRenameRequest(volume_id=obj.object_id, name=new_name)
+        )
+
+
+class _VolumeUploadContextManager:
+    """Collects upload specs, then pushes missing blocks in parallel on exit
+    (reference _VolumeUploadContextManager2, volume.py:1108: put files → server
+    returns missing block hashes → parallel block PUT → re-put)."""
+
+    def __init__(self, volume: _Volume, force: bool = False):
+        self._volume = volume
+        self._force = force
+        self._entries: list[tuple[str, Union[str, Path, bytes]]] = []
+
+    async def __aenter__(self) -> "_VolumeUploadContextManager":
+        return self
+
+    def put_file(self, local_file: Union[str, Path, BinaryIO], remote_path: str) -> None:
+        self._entries.append((remote_path, local_file))  # type: ignore[arg-type]
+
+    def put_data(self, data: bytes, remote_path: str) -> None:
+        self._entries.append((remote_path, data))
+
+    def put_directory(self, local_path: Union[str, Path], remote_path: str, recursive: bool = True) -> None:
+        local_path = Path(local_path)
+        for p in local_path.rglob("*") if recursive else local_path.glob("*"):
+            if p.is_file():
+                rel = p.relative_to(local_path)
+                self._entries.append((str(PurePosixPath(remote_path) / PurePosixPath(*rel.parts)), p))
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return
+        client = self._volume.client
+        files: list[api_pb2.VolumeFile] = []
+        block_data: dict[str, tuple] = {}  # sha -> (source, offset, length)
+
+        for remote_path, src in self._entries:
+            if isinstance(src, bytes):
+                size = len(src)
+                mode = 0o644
+                reader = lambda off, ln, s=src: s[off : off + ln]
+            else:
+                path = Path(src) if isinstance(src, (str, Path)) else None
+                if path is not None:
+                    size = path.stat().st_size
+                    mode = path.stat().st_mode & 0o7777
+                    reader = lambda off, ln, p=path: _read_range(p, off, ln)
+                else:  # file object
+                    src.seek(0, os.SEEK_END)
+                    size = src.tell()
+                    src.seek(0)
+                    mode = 0o644
+                    reader = lambda off, ln, f=src: _read_fileobj_range(f, off, ln)
+            shas = []
+            off = 0
+            while off < size or (size == 0 and off == 0):
+                ln = min(BLOCK_SIZE, size - off)
+                data = reader(off, ln)
+                sha = get_sha256_hex(data)
+                shas.append(sha)
+                block_data[sha] = (reader, off, ln)
+                off += BLOCK_SIZE
+                if size == 0:
+                    break
+            files.append(
+                api_pb2.VolumeFile(
+                    path=remote_path.lstrip("/"), size=size, mode=mode, block_sha256_hex=shas
+                )
+            )
+
+        put_req = api_pb2.VolumePutFiles2Request(
+            volume_id=self._volume.object_id, files=files, disallow_overwrite_existing_files=not self._force
+        )
+        resp = await retry_transient_errors(client.stub.VolumePutFiles2, put_req)
+        missing = list(resp.missing_blocks)
+        if missing:
+            sem = asyncio.Semaphore(BLOCK_PARALLELISM)
+
+            async def _put(sha: str) -> None:
+                reader, off, ln = block_data[sha]
+                async with sem:
+                    await retry_transient_errors(
+                        client.stub.VolumeBlockPut,
+                        api_pb2.VolumeBlockPutRequest(sha256_hex=sha, data=reader(off, ln)),
+                    )
+
+            await asyncio.gather(*[_put(sha) for sha in missing])
+            resp = await retry_transient_errors(client.stub.VolumePutFiles2, put_req)
+            if resp.missing_blocks:
+                raise InvalidError(f"blocks still missing after upload: {resp.missing_blocks[:3]}...")
+
+
+def _read_range(path: Path, offset: int, length: int) -> bytes:
+    with open(path, "rb") as f:
+        f.seek(offset)
+        return f.read(length)
+
+
+def _read_fileobj_range(f: BinaryIO, offset: int, length: int) -> bytes:
+    f.seek(offset)
+    return f.read(length)
+
+
+Volume = synchronize_api(_Volume)
+VolumeUploadContextManager = synchronize_api(_VolumeUploadContextManager)

@@ -18,9 +18,18 @@ import sys
 
 # Force JAX onto a virtual 8-device CPU platform BEFORE jax initializes
 # (tests never touch the real TPU chip; the driver benches separately).
+# The axon TPU-tunnel plugin registers from sitecustomize at interpreter
+# startup (keyed on PALLAS_AXON_POOL_IPS) and forces jax_platforms to
+# "axon,cpu" — env vars alone can't undo that in THIS process, so override
+# jax.config directly; subprocesses get a scrubbed env.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("MODAL_TPU_JAX_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MODAL_TPU_JAX_PLATFORM"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -59,12 +68,19 @@ def supervisor(tmp_path, monkeypatch):
     from modal_tpu.server.supervisor import LocalSupervisor
 
     monkeypatch.setenv("MODAL_TPU_STATE_DIR", str(tmp_path / "state"))
-    sup = LocalSupervisor(num_workers=1, state_dir=str(tmp_path / "state"))
+    # worker_chips skips the slow jax-probe subprocess and simulates an
+    # 8-chip host; containers run CPU jax with forced device counts.
+    sup = LocalSupervisor(
+        num_workers=1, state_dir=str(tmp_path / "state"), worker_chips=8, worker_tpu_type="local-sim"
+    )
     synchronizer.run(sup.start())
     monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{sup.port}")
     _Client.set_env_client(None)  # force fresh client pointed at this server
     try:
         yield sup
     finally:
+        env_client = _Client._client_from_env
+        if env_client is not None and not env_client._closed:
+            env_client._close()
         _Client.set_env_client(None)
         synchronizer.run(sup.stop())

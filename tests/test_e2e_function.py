@@ -1,0 +1,79 @@
+"""End-to-end slice: @app.function() through the real control plane, worker,
+and container subprocess (SURVEY §7 step 5 — the 'one model running'
+milestone, config 1: numpy matmul)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def _matmul(n: int):
+    import numpy as np
+
+    a = np.ones((n, n), dtype=np.float32)
+    return float((a @ a).sum())
+
+
+def test_function_remote_roundtrip(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("e2e-test")
+    f = app.function(serialized=True)(_matmul)
+
+    with app.run():
+        result = f.remote(8)
+        assert result == 8 * 8 * 8.0
+
+
+def test_function_exception_propagates(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("e2e-exc")
+
+    def boom(x):
+        raise ValueError(f"bad {x}")
+
+    f = app.function(serialized=True)(boom)
+    with app.run():
+        with pytest.raises(ValueError, match="bad 7") as exc_info:
+            f.remote(7)
+        # remote traceback is attached as cause
+        assert exc_info.value.__cause__ is not None
+
+
+def test_function_spawn_and_get(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("e2e-spawn")
+
+    def double(x):
+        return x * 2
+
+    f = app.function(serialized=True)(double)
+    with app.run():
+        call = f.spawn(21)
+        assert call.get() == 42
+
+
+def test_container_reuse_across_inputs(supervisor):
+    """One warm container should serve sequential inputs (no per-input boot)."""
+    import modal_tpu
+
+    app = modal_tpu.App("e2e-warm")
+
+    def pid_of(x):
+        import os
+
+        return os.getpid()
+
+    f = app.function(serialized=True)(pid_of)
+    with app.run():
+        t0 = time.monotonic()
+        pid1 = f.remote(1)
+        first_latency = time.monotonic() - t0
+        t0 = time.monotonic()
+        pid2 = f.remote(2)
+        warm_latency = time.monotonic() - t0
+        assert pid1 == pid2, "second input should hit the warm container"
+        assert warm_latency < first_latency, "warm path should skip container boot"
