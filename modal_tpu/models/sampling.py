@@ -1,0 +1,103 @@
+"""Decode loops: prefill + single-token steps with a static KV cache.
+
+TPU-first: the decode step is one fixed-shape jitted function (cache donated,
+so XLA updates HBM in place); the python loop only feeds tokens. Greedy and
+temperature sampling.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .llama import KVCache, LlamaConfig, forward
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def prefill(params: dict, cfg: LlamaConfig, tokens: jax.Array, cache: KVCache):
+    """Run the prompt through the model, filling the cache.
+    Returns (last_token_logits [B, V], cache)."""
+    logits, cache = forward(params, cfg, tokens, cache=cache)
+    return logits[:, -1, :], cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def decode_step(params: dict, cfg: LlamaConfig, token: jax.Array, cache: KVCache):
+    """One token in, one distribution out. token: [B, 1]."""
+    logits, cache = forward(params, cfg, token, cache=cache)
+    return logits[:, -1, :], cache
+
+
+def greedy_generate(
+    params: dict,
+    cfg: LlamaConfig,
+    prompt: jax.Array,  # [B, S] int32
+    max_new_tokens: int,
+    cache_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy decode. Returns [B, S + max_new_tokens]."""
+    b, s = prompt.shape
+    cache = KVCache.create(cfg, b, cache_len or cfg.max_seq_len)
+    logits, cache = prefill(params, cfg, prompt, cache)
+    tokens = [prompt]
+    next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
+    for _ in range(max_new_tokens):
+        tokens.append(next_tok)
+        logits, cache = decode_step(params, cfg, next_tok, cache)
+        next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
+    return jnp.concatenate(tokens, axis=1)
+
+
+def benchmark_decode(
+    params: dict,
+    cfg: LlamaConfig,
+    batch: int = 1,
+    prompt_len: int = 128,
+    gen_len: int = 128,
+    cache_len: int = 1024,
+) -> dict:
+    """Measure prefill + decode throughput. Returns timing dict (seconds,
+    tokens/sec)."""
+    prompt = jnp.ones((batch, prompt_len), jnp.int32)
+    cache = KVCache.create(cfg, batch, cache_len)
+
+    t0 = time.perf_counter()
+    logits, cache = prefill(params, cfg, prompt, cache)
+    logits.block_until_ready()
+    prefill_compile_s = time.perf_counter() - t0
+
+    next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
+    t0 = time.perf_counter()
+    logits, cache = decode_step(params, cfg, next_tok, cache)
+    logits.block_until_ready()
+    decode_compile_s = time.perf_counter() - t0
+
+    # timed decode loop (steady state)
+    next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
+    t0 = time.perf_counter()
+    for _ in range(gen_len):
+        logits, cache = decode_step(params, cfg, next_tok, cache)
+        next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
+    next_tok.block_until_ready()
+    decode_s = time.perf_counter() - t0
+
+    # timed prefill (warm)
+    cache2 = KVCache.create(cfg, batch, cache_len)
+    t0 = time.perf_counter()
+    logits2, cache2 = prefill(params, cfg, prompt, cache2)
+    logits2.block_until_ready()
+    prefill_s = time.perf_counter() - t0
+
+    return {
+        "prefill_compile_s": prefill_compile_s,
+        "decode_compile_s": decode_compile_s,
+        "prefill_s": prefill_s,
+        "prefill_tokens_per_s": batch * prompt_len / prefill_s,
+        "decode_s": decode_s,
+        "decode_tokens_per_s": batch * gen_len / decode_s,
+        "ms_per_token": decode_s / gen_len * 1000,
+    }

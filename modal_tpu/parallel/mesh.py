@@ -1,0 +1,66 @@
+"""Device mesh construction from TPU slice topology.
+
+The runtime builds the `jax.sharding.Mesh` from the slice the scheduler
+placed the gang on (TPUSliceInfo → mesh axes), honoring user mesh hints
+(`@app.function(tpu="v5p-64", mesh={"data": 2, "fsdp": 16, "model": 2})`).
+Axis convention (scaling-book style):
+
+  data  — pure data parallel (params replicated)
+  fsdp  — data parallel with sharded params/optimizer (ZeRO-3)
+  model — tensor parallel (heads/ffn sharded; activations all-reduced)
+  seq   — sequence/context parallel (ring attention; M6)
+
+On a pod slice, [fsdp, model] map to intra-slice ICI dimensions and [data]
+to the cross-slice/DCN dimension, so collectives ride the fastest links
+(reference contrast: gang networking is NCCL over i6pn,
+_clustered_functions.py:44-68).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_ORDER = ("data", "fsdp", "seq", "model")
+
+
+def build_mesh(
+    axes: Optional[dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with named axes. Missing axes default to 1; axis sizes
+    must multiply to the device count (a trailing unnamed remainder goes to
+    fsdp)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {})
+    sized = {k: v for k, v in axes.items() if v and v > 1}
+    prod = math.prod(sized.values()) if sized else 1
+    if prod > n or n % prod != 0:
+        raise ValueError(f"mesh axes {axes} need {prod} devices, have {n}")
+    if prod < n:
+        # absorb the remainder into fsdp (the default shard axis)
+        sized["fsdp"] = sized.get("fsdp", 1) * (n // prod)
+    shape = [sized.get(name, 1) for name in AXIS_ORDER]
+    mesh_devices = np.asarray(devices).reshape(shape)
+    return Mesh(mesh_devices, AXIS_ORDER)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh({"fsdp": 1}, devices=jax.devices()[:1])
+
+
+def mesh_from_slice_info(num_hosts: int, chips_per_host: int, hints: Optional[dict[str, int]] = None) -> Mesh:
+    """Default mapping for a pod slice: fsdp within hosts' ICI block ×
+    data across hosts, unless hints say otherwise."""
+    if hints:
+        return build_mesh(hints)
+    return build_mesh({"data": num_hosts, "fsdp": chips_per_host})
+
+
+def named(mesh: Mesh, *spec: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,75 @@
+"""Partition specs for the Llama parameter tree (FSDP + TP).
+
+Rules follow the scaling-book recipe: annotate weights with PartitionSpecs
+over the mesh and let XLA insert the collectives. Layer params are stacked
+[n_layers, ...] so axis 0 is never sharded (it's scanned).
+
+FSDP ("fsdp" axis): shard the *largest* weight dim — all-gather happens per
+layer under the scan, overlapping with compute.
+TP ("model" axis): Megatron-style — qkv/gate/up column-parallel, o/down
+row-parallel, so each layer needs exactly two all-reduces (inserted by XLA
+from the specs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig
+
+# param path (under "layers") -> spec WITHOUT the stacked layer axis
+_LAYER_RULES: dict[str, P] = {
+    "attn_norm": P(None),
+    "wq": P("fsdp", "model"),
+    "wk": P("fsdp", "model"),
+    "wv": P("fsdp", "model"),
+    "wo": P("model", "fsdp"),
+    "mlp_norm": P(None),
+    "w_gate": P("fsdp", "model"),
+    "w_up": P("fsdp", "model"),
+    "w_down": P("model", "fsdp"),
+}
+
+_TOP_RULES: dict[str, P] = {
+    "embed": P("model", "fsdp"),     # vocab sharded over model, dim over fsdp
+    "final_norm": P(None),
+    "lm_head": P("fsdp", "model"),
+}
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpec pytree matching init_params' structure."""
+    layers = {k: P(None, *spec) for k, spec in _LAYER_RULES.items()}
+    return {
+        "embed": _TOP_RULES["embed"],
+        "layers": layers,
+        "final_norm": _TOP_RULES["final_norm"],
+        "lm_head": _TOP_RULES["lm_head"],
+    }
+
+
+def param_shardings(mesh: Mesh, cfg: LlamaConfig) -> dict:
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec), param_specs(cfg), is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_spec() -> P:
+    """Tokens [B, S]: batch over (data, fsdp), sequence over seq (ring
+    attention shards S in M6's sequence-parallel path)."""
+    return P(("data", "fsdp"), None)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def logical_batch_size(mesh: Mesh, per_device_batch: int) -> int:
+    return per_device_batch * mesh.shape["data"] * mesh.shape["fsdp"]
+
+
+def shard_params(mesh: Mesh, cfg: LlamaConfig, params: dict) -> dict:
+    """Place an (unsharded) param tree onto the mesh."""
+    shardings = param_shardings(mesh, cfg)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), params, shardings)

@@ -1,0 +1,131 @@
+"""Distributed training step: FSDP/TP pjit over the mesh.
+
+The judged configs (BASELINE.json 4-5) are Llama-3 8B/70B pretrain on
+v5p slices. The step is a standard jit-of-grad with NamedSharding
+constraints — XLA turns the FSDP specs into per-layer all-gathers under the
+layer scan (overlapped with compute) and reduce-scatters on the grads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, causal_lm_loss, init_params
+from .mesh import build_mesh
+from .sharding import batch_sharding, param_shardings, shard_params
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: Any
+    step: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    warmup_steps: int = 2000
+    total_steps: int = 100_000
+    remat: bool = True  # jax.checkpoint the layer body: memory for FLOPs
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=tc.learning_rate,
+        warmup_steps=tc.warmup_steps,
+        decay_steps=tc.total_steps,
+        end_value=tc.learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(schedule, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay),
+    )
+
+
+def loss_fn(params: dict, cfg: LlamaConfig, tokens: jax.Array, remat: bool) -> jax.Array:
+    if remat:
+        # rematerialize the whole forward under grad — with the layer scan,
+        # this is effectively per-layer checkpointing
+        return jax.checkpoint(lambda p, t: causal_lm_loss(p, cfg, t))(params, tokens)
+    return causal_lm_loss(params, cfg, tokens)
+
+
+def make_train_step(
+    cfg: LlamaConfig, tc: TrainConfig, optimizer: optax.GradientTransformation
+) -> Callable:
+    """Returns train_step(state, tokens) -> (state, metrics) — jit with
+    donated state."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens, tc.remat)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(new_params, new_opt_state, state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": gnorm, "step": new_state.step}
+
+    return train_step
+
+
+def create_sharded_state(
+    mesh: Mesh, cfg: LlamaConfig, tc: TrainConfig, seed: int = 0
+) -> tuple[TrainState, Callable, NamedSharding]:
+    """Initialize params DIRECTLY sharded on the mesh (jit with out_shardings
+    — no host-memory spike for 70B-scale trees) and build the step function.
+
+    Returns (state, train_step, token_sharding).
+    """
+    optimizer = make_optimizer(tc)
+    p_shardings = param_shardings(mesh, cfg)
+
+    @partial(jax.jit, out_shardings=p_shardings)
+    def _init(key):
+        return init_params(cfg, key)
+
+    params = _init(jax.random.PRNGKey(seed))
+    # optimizer state mirrors the params, inheriting their shardings through
+    # jit's sharding propagation
+    opt_state = jax.jit(optimizer.init)(params)
+    state = TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+    step_fn = make_train_step(cfg, tc, optimizer)
+    return state, step_fn, batch_sharding(mesh)
+
+
+def train_demo(
+    cfg_name: str = "tiny",
+    mesh_axes: Optional[dict] = None,
+    steps: int = 2,
+    per_device_batch: int = 1,
+    seq_len: int = 128,
+) -> dict:
+    """Tiny end-to-end pretrain demo (used by dryrun + tests): build mesh,
+    shard state, run a few steps on synthetic data."""
+    from ..models.llama import get_config
+
+    cfg = get_config(cfg_name)
+    mesh = build_mesh(mesh_axes)
+    tc = TrainConfig(warmup_steps=10, total_steps=100)
+    with mesh:
+        state, step_fn, token_sharding = create_sharded_state(mesh, cfg, tc)
+        n_batch = mesh.shape["data"] * mesh.shape["fsdp"] * per_device_batch
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (n_batch, seq_len), 0, cfg.vocab_size, jnp.int32),
+            token_sharding,
+        )
+        metrics = {}
+        for _ in range(steps):
+            state, metrics = step_fn(state, tokens)
+        return {k: float(v) for k, v in metrics.items()}

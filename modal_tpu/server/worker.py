@@ -211,8 +211,15 @@ class WorkerAgent:
             chips = spec.chips_per_host if args.world_size > 1 else spec.chips
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["XLA_FLAGS"] = (
-                f"--xla_force_host_platform_device_count={max(1, chips)} " + env.get("XLA_FLAGS", "")
+            # replace (not append) any inherited device-count flag — XLA
+            # honors the last occurrence
+            inherited = [
+                f
+                for f in env.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform_device_count")
+            ]
+            env["XLA_FLAGS"] = " ".join(
+                inherited + [f"--xla_force_host_platform_device_count={max(1, chips)}"]
             )
         elif jax_platform:
             env["JAX_PLATFORMS"] = jax_platform
