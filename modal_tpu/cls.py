@@ -180,6 +180,8 @@ class _Cls(_Object, type_prefix="cs"):
 
         # Build the service function through the app.function machinery to
         # share parameter validation, then adjust class-specific fields.
+        function_kwargs.pop("serialized", None)  # classes always serialize
+        function_kwargs.pop("name", None)
         service_function = app.function(
             serialized=True, name=user_cls.__name__, **function_kwargs
         )(_class_service_stub(user_cls))
@@ -273,3 +275,4 @@ def _mark_function_as_class(
 
 Cls = synchronize_api(_Cls)
 Obj = synchronize_api(_Obj)
+BoundMethod = synchronize_api(_BoundMethod)

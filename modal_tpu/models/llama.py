@@ -211,6 +211,7 @@ def _layer_forward(
     inv_freq: jax.Array,
     cache_kv: Optional[tuple[jax.Array, jax.Array]],  # ([B, max, n_kv, hd], ...)
     cache_offset: Optional[jax.Array],
+    attn_impl: Optional[Any] = None,  # custom attention (ring/pallas); (q,k,v,mask)->out
 ) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
     b, s, d = x.shape
     hd = cfg.head_dim
@@ -232,7 +233,8 @@ def _layer_forward(
         new_cache = None
 
     n_rep = cfg.n_heads // cfg.n_kv_heads
-    attn_out = attention(q, repeat_kv(k_att, n_rep), repeat_kv(v_att, n_rep), mask)
+    attn_fn = attn_impl or attention
+    attn_out = attn_fn(q, repeat_kv(k_att, n_rep), repeat_kv(v_att, n_rep), mask)
     x = x + attn_out.reshape(b, s, cfg.n_heads * hd) @ layer["wo"]
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -247,6 +249,7 @@ def forward(
     tokens: jax.Array,  # [B, S] int32
     positions: Optional[jax.Array] = None,  # [B, S]
     cache: Optional[KVCache] = None,
+    attn_impl: Optional[Any] = None,  # e.g. ring attention for seq-parallel training
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Full forward pass. Without cache: causal training/prefill forward.
     With cache: writes K/V at cache.length and attends over the cache
@@ -264,7 +267,9 @@ def forward(
         mask = jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None, :, :]
 
         def body(x_carry, layer):
-            x_out, _ = _layer_forward(cfg, x_carry, layer, positions, mask, inv_freq, None, None)
+            x_out, _ = _layer_forward(
+                cfg, x_carry, layer, positions, mask, inv_freq, None, None, attn_impl
+            )
             return x_out, None
 
         x, _ = lax.scan(body, x, params["layers"])
@@ -298,9 +303,11 @@ def forward(
 # ---------------------------------------------------------------------------
 
 
-def causal_lm_loss(params: dict, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
+def causal_lm_loss(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, attn_impl: Optional[Any] = None
+) -> jax.Array:
     """Next-token cross-entropy, mean over all positions."""
-    logits, _ = forward(params, cfg, tokens[:, :-1])
+    logits, _ = forward(params, cfg, tokens[:, :-1], attn_impl=attn_impl)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,149 @@
+"""Ring attention: sequence/context parallelism over the `seq` mesh axis.
+
+The reference has no sequence parallelism (SURVEY §2d: absent — gang
+networking only); this is the workload-layer capability the TPU build adds
+for long-context runs (SURVEY §5 "Long-context / sequence parallelism").
+
+Mechanics (Liu et al. ring attention, flash-style accumulation):
+- Q stays resident on its sequence shard; K/V blocks rotate around the ring
+  via `lax.ppermute` (one ICI hop per step, overlapping with the block
+  matmul).
+- Online softmax: running (max, sum, output) per query row merges each
+  incoming block — numerically identical to full softmax attention.
+- Causal masking uses *global* positions, so block pairs that are entirely
+  future are skipped-by-masking (compute is uniform per step — XLA-friendly
+  static shapes).
+
+Wrapped with `shard_map` over a Mesh axis; on a pod slice the ring rides ICI
+neighbors. Used for sequences too long for one chip's HBM (the KV for 1M
+tokens at 8B is ~130 GB — must shard S).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attend(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, H, D]
+    v: jax.Array,  # [B, Sk, H, D]
+    q_pos: jax.Array,  # [Sq] global positions
+    kv_pos: jax.Array,  # [Sk] global positions
+    m: jax.Array,  # [B, H, Sq] running max
+    l: jax.Array,  # [B, H, Sq] running sum
+    o: jax.Array,  # [B, Sq, H, D] running (unnormalized) output
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One flash-attention accumulation step against a K/V block."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    causal = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    s = jnp.where(causal, s, -jnp.inf)
+
+    m_block = jnp.max(s, axis=-1)  # [B, H, Sq]
+    m_new = jnp.maximum(m, m_block)
+    # guard fully-masked rows (max = -inf): exp(-inf - -inf) -> use 0 correction
+    correction = jnp.where(jnp.isinf(m) & (m < 0), 0.0, jnp.exp(m - m_new))
+    p = jnp.exp(s - m_new[..., None])  # [B, H, Sq, Sk]; rows fully masked -> 0
+    p = jnp.where(causal, p, 0.0)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [B, S_local, H, D] — this shard's queries
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Body run inside shard_map: rotate K/V around the ring, accumulate."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+
+    q_pos = my_idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, o, k_cur, v_cur = carry
+        kv_idx = (my_idx - i) % axis_size
+        kv_pos = kv_idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+        m, l, o = _block_attend(q, k_cur, v_cur, q_pos, kv_pos, m, l, o)
+        # rotate: shard p hands its K/V block to p+1 (ring over ICI neighbors)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    m, l, o, _, _ = lax.fori_loop(0, axis_size, body, (m0, l0, o0, k, v))
+    # normalize; fully-masked rows (l == 0) -> zeros
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] — S sharded over `axis_name`
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    batch_axes: Optional[tuple] = None,  # mesh axes sharding the batch dim
+    head_axis: Optional[str] = None,  # mesh axis sharding heads (tensor parallel)
+) -> jax.Array:
+    """Causal self-attention with the sequence dimension sharded over
+    `axis_name`. Output has the same sharding as q. With `head_axis` set
+    (tensor parallelism), each model shard ring-attends only its own heads —
+    attention is embarrassingly parallel over heads, so no cross-head
+    collectives are needed."""
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def make_ring_attention_impl(
+    mesh: Mesh,
+    axis_name: str = "seq",
+    batch_axes: Optional[tuple] = None,
+    head_axis: Optional[str] = "model",
+):
+    """Adapter with the model's attention signature (q, k, v, mask) — the
+    causal mask is computed internally from global positions, so `mask` is
+    ignored (training/prefill only)."""
+    if head_axis is not None and mesh.shape.get(head_axis, 1) <= 1:
+        head_axis = None
+
+    def _impl(q, k, v, mask):
+        return ring_attention(
+            q, k, v, mesh, axis_name=axis_name, batch_axes=batch_axes, head_axis=head_axis
+        )
+
+    return _impl
+
+
+def full_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Reference single-device causal attention for testing equivalence."""
+    s = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    logits = jnp.where(causal[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

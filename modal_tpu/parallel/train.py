@@ -17,9 +17,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.llama import LlamaConfig, causal_lm_loss, init_params
+from ..models.llama import LlamaConfig, init_params
 from .mesh import build_mesh
-from .sharding import batch_sharding, param_shardings, shard_params
+from .sharding import param_shardings
 
 
 class TrainState(NamedTuple):
@@ -54,23 +54,38 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(params: dict, cfg: LlamaConfig, tokens: jax.Array, remat: bool) -> jax.Array:
+def loss_fn(
+    params: dict, cfg: LlamaConfig, tokens: jax.Array, remat: bool, attn_impl: Optional[Callable] = None
+) -> jax.Array:
+    def _loss(p, t):
+        # forward over the full (evenly sharded) sequence, then shift for
+        # next-token loss — keeps S divisible for sequence parallelism
+        from ..models.llama import forward
+
+        logits, _ = forward(p, cfg, t, attn_impl=attn_impl)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        nll = -jnp.take_along_axis(logp, t[:, 1:, None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
     if remat:
         # rematerialize the whole forward under grad — with the layer scan,
         # this is effectively per-layer checkpointing
-        return jax.checkpoint(lambda p, t: causal_lm_loss(p, cfg, t))(params, tokens)
-    return causal_lm_loss(params, cfg, tokens)
+        return jax.checkpoint(_loss)(params, tokens)
+    return _loss(params, tokens)
 
 
 def make_train_step(
-    cfg: LlamaConfig, tc: TrainConfig, optimizer: optax.GradientTransformation
+    cfg: LlamaConfig,
+    tc: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    attn_impl: Optional[Callable] = None,
 ) -> Callable:
     """Returns train_step(state, tokens) -> (state, metrics) — jit with
     donated state."""
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, tokens: jax.Array):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens, tc.remat)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens, tc.remat, attn_impl)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
@@ -85,11 +100,18 @@ def create_sharded_state(
 ) -> tuple[TrainState, Callable, NamedSharding]:
     """Initialize params DIRECTLY sharded on the mesh (jit with out_shardings
     — no host-memory spike for 70B-scale trees) and build the step function.
+    When the mesh has a seq axis > 1, attention runs as ring attention with
+    the sequence sharded (context parallelism).
 
     Returns (state, train_step, token_sharding).
     """
     optimizer = make_optimizer(tc)
     p_shardings = param_shardings(mesh, cfg)
+    attn_impl = None
+    if mesh.shape.get("seq", 1) > 1:
+        from .ring_attention import make_ring_attention_impl
+
+        attn_impl = make_ring_attention_impl(mesh, "seq", batch_axes=("data", "fsdp"))
 
     @partial(jax.jit, out_shardings=p_shardings)
     def _init(key):
@@ -100,8 +122,9 @@ def create_sharded_state(
     # jit's sharding propagation
     opt_state = jax.jit(optimizer.init)(params)
     state = TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
-    step_fn = make_train_step(cfg, tc, optimizer)
-    return state, step_fn, batch_sharding(mesh)
+    step_fn = make_train_step(cfg, tc, optimizer, attn_impl=attn_impl)
+    token_spec = P(("data", "fsdp"), "seq" if mesh.shape.get("seq", 1) > 1 else None)
+    return state, step_fn, NamedSharding(mesh, token_spec)
 
 
 def train_demo(

@@ -226,7 +226,9 @@ class ContainerIOManager:
         for start in range(0, len(items), MAX_OUTPUT_BATCH_SIZE):
             await retry_transient_errors(
                 self.stub.FunctionPutOutputs,
-                api_pb2.FunctionPutOutputsRequest(outputs=items[start : start + MAX_OUTPUT_BATCH_SIZE]),
+                api_pb2.FunctionPutOutputsRequest(
+                    outputs=items[start : start + MAX_OUTPUT_BATCH_SIZE], task_id=self.task_id
+                ),
                 max_retries=None,
                 additional_status_codes=[],
             )

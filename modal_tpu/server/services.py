@@ -615,8 +615,9 @@ class ModalTPUServicer:
                 # Gang broadcast: every gang member receives a copy of each
                 # input (reference broadcast semantics,
                 # _partial_function.py:780 `broadcast`); the input leaves the
-                # queue once all ranks have it. Outputs are deduped first-win
-                # in FunctionPutOutputs.
+                # queue once all ranks have it. FunctionPutOutputs keeps
+                # rank 0's SUCCESS as canonical and accepts FAILURE from any
+                # rank (fail fast).
                 for input_id in list(fn.pending):
                     if len(items) >= batch_size:
                         break
@@ -639,23 +640,40 @@ class ModalTPUServicer:
                         )
                     )
             else:
-                while fn.pending and len(items) < batch_size:
-                    input_id = fn.pending.pop(0)
-                    inp = self.s.inputs[input_id]
-                    if inp.status != "pending":
-                        continue
-                    inp.status = "claimed"
-                    inp.claimed_by = task.task_id
-                    inp.claimed_at = time.time()
-                    items.append(
-                        api_pb2.FunctionGetInputsItem(
-                            input_id=inp.input_id,
-                            input=inp.input,
-                            function_call_id=inp.function_call_id,
-                            idx=inp.idx,
-                            retry_count=inp.retry_count,
+                # Batching linger: once the first input of a batch is seen,
+                # wait up to batch_linger_ms for the batch to fill (reference
+                # @batched wait_ms semantics).
+                linger_deadline = None
+                while True:
+                    while fn.pending and len(items) < batch_size:
+                        input_id = fn.pending.pop(0)
+                        inp = self.s.inputs[input_id]
+                        if inp.status != "pending":
+                            continue
+                        inp.status = "claimed"
+                        inp.claimed_by = task.task_id
+                        inp.claimed_at = time.time()
+                        items.append(
+                            api_pb2.FunctionGetInputsItem(
+                                input_id=inp.input_id,
+                                input=inp.input,
+                                function_call_id=inp.function_call_id,
+                                idx=inp.idx,
+                                retry_count=inp.retry_count,
+                            )
                         )
-                    )
+                    if not items or len(items) >= batch_size or not request.batch_linger_ms:
+                        break
+                    if linger_deadline is None:
+                        linger_deadline = time.monotonic() + request.batch_linger_ms / 1000.0
+                    remaining = linger_deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    async with fn.input_condition:
+                        try:
+                            await asyncio.wait_for(fn.input_condition.wait(), timeout=remaining)
+                        except asyncio.TimeoutError:
+                            break
             if items:
                 return api_pb2.FunctionGetInputsResponse(inputs=items)
             if time.monotonic() >= deadline:
@@ -670,6 +688,7 @@ class ModalTPUServicer:
 
     async def FunctionPutOutputs(self, request: api_pb2.FunctionPutOutputsRequest, context) -> api_pb2.FunctionPutOutputsResponse:
         touched: set[str] = set()
+        pushing_task = self.s.tasks.get(request.task_id) if request.task_id else None
         for item in request.outputs:
             call = self.s.function_calls.get(item.function_call_id)
             if call is None:
@@ -678,6 +697,18 @@ class ModalTPUServicer:
             if inp is not None:
                 if inp.status == "done":
                     continue  # duplicate (e.g. gang peer)
+                # Broadcast gangs: every rank computes; rank 0's SUCCESS is
+                # the canonical output. FAILURE from any rank is accepted
+                # immediately (fail fast — a crashed peer would otherwise
+                # stall rank 0 in a collective until heartbeat timeout).
+                if (
+                    pushing_task is not None
+                    and pushing_task.cluster_id
+                    and pushing_task.rank != 0
+                    and inp.delivered_to
+                    and item.result.status == api_pb2.GENERIC_STATUS_SUCCESS
+                ):
+                    continue
                 inp.status = "done"
             call.outputs.append(
                 api_pb2.FunctionGetOutputsItem(
@@ -734,9 +765,29 @@ class ModalTPUServicer:
 
     async def _fail_claimed_inputs(self, task: TaskState_, result: api_pb2.GenericResult) -> None:
         """Inputs claimed by a dead container either retry or fail
-        (reference: server-driven FunctionRetryInputs semantics)."""
+        (reference: server-driven FunctionRetryInputs semantics).
+
+        Gangs fail as a unit: a dead member fails every input delivered to
+        the gang (claimed_by may be any rank for broadcast inputs) and tears
+        down the surviving peers."""
+        gang_tasks: set[str] = set()
+        if task.cluster_id and task.cluster_id in self.s.clusters:
+            cluster = self.s.clusters[task.cluster_id]
+            gang_tasks = set(cluster.task_ids)
+            for peer_id in cluster.task_ids:
+                peer = self.s.tasks.get(peer_id)
+                if peer is not None and peer_id != task.task_id and not peer.terminate:
+                    peer.terminate = True
+                    worker = self.s.workers.get(peer.worker_id)
+                    if worker is not None:
+                        await worker.events.put(
+                            api_pb2.WorkerPollResponse(stop=api_pb2.TaskStopEvent(task_id=peer_id))
+                        )
         for inp in self.s.inputs.values():
-            if inp.claimed_by == task.task_id and inp.status == "claimed":
+            claimed_by_gang = inp.claimed_by == task.task_id or (
+                gang_tasks and (inp.claimed_by in gang_tasks or task.task_id in inp.delivered_to)
+            )
+            if claimed_by_gang and inp.status == "claimed":
                 call = self.s.function_calls.get(inp.function_call_id)
                 fn = self.s.functions.get(task.function_id)
                 if call is None or fn is None:

@@ -1,0 +1,110 @@
+"""Gang scheduling e2e: @clustered(size=N) rendezvous, rank assignment,
+broadcast inputs, jax.distributed bootstrap (config 4 in miniature).
+
+Contract-level assertions follow the reference's pattern
+(i6pn_clustered_test.py: group_size lands on FunctionCreate; canned
+TaskClusterHello), but here the rendezvous is real — N containers report in
+and the control plane blocks until the gang is complete.
+"""
+
+import os
+
+import pytest
+
+
+def test_clustered_function_create_contract(supervisor):
+    """group_size/broadcast/fabric land on the Function proto."""
+    import modal_tpu
+    from modal_tpu.proto import api_pb2
+
+    app = modal_tpu.App("gang-contract")
+
+    @app.function(serialized=True, tpu="v5p-8")
+    @modal_tpu.clustered(size=2, fabric_size=8)
+    def train():
+        return "ok"
+
+    with app.run():
+        fn_state = list(supervisor.state.functions.values())[-1]
+        assert fn_state.definition.group_size == 2
+        assert fn_state.definition.broadcast_inputs is True
+        assert fn_state.definition.fabric_size == 8
+        assert fn_state.definition.resources.tpu_config.tpu_type == "v5p-8"
+
+
+def test_clustered_gang_rendezvous(supervisor):
+    """Both ranks run the input, get distinct ranks, shared cluster info."""
+    import modal_tpu
+
+    app = modal_tpu.App("gang-e2e")
+
+    @app.function(serialized=True)
+    @modal_tpu.clustered(size=2)
+    def rank_report(tag):
+        import os
+
+        from modal_tpu import get_cluster_info
+
+        info = get_cluster_info()
+        return {
+            "tag": tag,
+            "rank": info.rank,
+            "world": info.world_size,
+            "peers": len(info.container_ips),
+            "coordinator": info.coordinator_address,
+            "pid": os.getpid(),
+        }
+
+    # containers skip jax.distributed (tested separately) but do rendezvous
+    os.environ["MODAL_TPU_SKIP_JAX_DISTRIBUTED"] = "1"
+    try:
+        with app.run():
+            out = rank_report.remote("x")
+            assert out["tag"] == "x"
+            assert out["world"] == 2
+            assert out["peers"] == 2
+            assert out["coordinator"].count(":") == 1
+            # both gang tasks exist and have distinct ranks
+            cluster = list(supervisor.state.clusters.values())[-1]
+            assert len(cluster.task_ids) == 2
+            ranks = sorted(supervisor.state.tasks[t].rank for t in cluster.task_ids)
+            assert ranks == [0, 1]
+    finally:
+        os.environ.pop("MODAL_TPU_SKIP_JAX_DISTRIBUTED", None)
+
+
+def test_clustered_jax_distributed_psum(supervisor):
+    """The real thing: 2 gang containers call jax.distributed.initialize via
+    the rendezvous coordinator and run a cross-process psum over DCN."""
+    import modal_tpu
+
+    app = modal_tpu.App("gang-jaxdist")
+
+    @app.function(serialized=True, timeout=120)
+    @modal_tpu.clustered(size=2)
+    def allreduce(base):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from modal_tpu import get_cluster_info
+
+        info = get_cluster_info()
+        devices = jax.devices()  # global across both processes
+        mesh = Mesh(np.asarray(devices).reshape(len(devices)), ("dp",))
+        x = jnp.full((len(devices),), base, jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, PartitionSpec("dp")))
+        total = jax.jit(lambda a: jnp.sum(a))(x)
+        return {
+            "rank": info.rank,
+            "process_count": jax.process_count(),
+            "global_devices": len(devices),
+            "sum": float(total),
+        }
+
+    with app.run():
+        out = allreduce.remote(3.0)
+        assert out["process_count"] == 2, out
+        assert out["global_devices"] >= 2
+        assert out["sum"] == 3.0 * out["global_devices"]

@@ -1,0 +1,75 @@
+"""Parallelism layer: ring attention equivalence, seq-parallel training,
+mesh construction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from modal_tpu.parallel.mesh import build_mesh
+from modal_tpu.parallel.ring_attention import full_causal_attention, ring_attention
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_ring_attention_matches_full(n_shards):
+    B, S, H, D = 2, 32, 4, 16
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in jax.random.split(key, 3))
+    ref = full_causal_attention(q, k, v)
+
+    mesh = Mesh(np.asarray(jax.devices()[:n_shards]).reshape(n_shards), ("seq",))
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+    out = ring_attention(*(jax.device_put(x, spec) for x in (q, k, v)), mesh)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_grad_matches_full():
+    B, S, H, D = 1, 16, 2, 8
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in jax.random.split(key, 3))
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("seq",))
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_causal_attention(q, k, v) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+    g_full = jax.grad(loss_full)(q, k, v)
+    g_ring = jax.grad(loss_ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_full), np.asarray(g_ring), rtol=1e-3, atol=1e-3)
+
+
+def test_seq_parallel_training_step():
+    from modal_tpu.parallel.train import train_demo
+
+    m = train_demo("debug-1l", {"fsdp": 2, "seq": 4}, steps=2, seq_len=64)
+    assert m["loss"] > 0 and m["step"] == 2
+
+
+def test_seq_parallel_loss_matches_plain():
+    """Ring-attention loss == plain-attention loss on identical data."""
+    import jax
+
+    from modal_tpu.models.llama import get_config, init_params
+    from modal_tpu.parallel.ring_attention import make_ring_attention_impl
+    from modal_tpu.parallel.train import loss_fn
+
+    cfg = get_config("debug-1l")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size, jnp.int32)
+
+    plain = float(loss_fn(params, cfg, tokens, remat=False))
+    mesh = build_mesh({"seq": 4})
+    ring_impl = make_ring_attention_impl(mesh, "seq", batch_axes=("data", "fsdp"))
+    tok_sharded = jax.device_put(tokens, NamedSharding(mesh, P(("data", "fsdp"), "seq")))
+    ring = float(loss_fn(params, cfg, tok_sharded, remat=False, attn_impl=ring_impl))
+    assert abs(plain - ring) < 1e-2, (plain, ring)
+
+
+def test_build_mesh_remainder_absorbed():
+    mesh = build_mesh({"model": 2})
+    assert mesh.shape["model"] == 2 and mesh.shape["fsdp"] == len(jax.devices()) // 2
+    with pytest.raises(ValueError):
+        build_mesh({"model": 3})  # doesn't divide 8
