@@ -57,6 +57,7 @@ __all__ = [
     "Period",
     "Queue",
     "Retries",
+    "Sandbox",
     "SchedulerPlacement",
     "Secret",
     "TPUSliceSpec",

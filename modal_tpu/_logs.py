@@ -1,0 +1,43 @@
+"""Client-side log streaming (reference: py/modal/_logs.py tail_logs /
+_logs_manager.py follow state machines — simplified: one AppGetLogs tail)."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from typing import Optional, TextIO
+
+from ._utils.grpc_utils import retry_transient_errors
+from .client import _Client
+from .config import logger
+from .proto import api_pb2
+
+
+async def stream_app_logs(
+    client: _Client,
+    app_id: str,
+    out: Optional[TextIO] = None,
+    stop_on_app_done: bool = True,
+) -> None:
+    """Tail an app's logs until cancelled or the app finishes."""
+    out = out or sys.stdout
+    last_entry_id = ""
+    while True:
+        try:
+            async for batch in client.stub.AppGetLogs(
+                api_pb2.AppGetLogsRequest(app_id=app_id, timeout=30.0, last_entry_id=last_entry_id)
+            ):
+                last_entry_id = batch.entry_id or last_entry_id
+                for item in batch.items:
+                    prefix = "" if item.file_descriptor == 1 else ""
+                    text = item.data
+                    if text:
+                        out.write(text if text.endswith("\n") else text + "\n")
+                        out.flush()
+                if batch.app_done and stop_on_app_done:
+                    return
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            logger.debug(f"log stream interrupted: {exc}; resuming")
+            await asyncio.sleep(0.5)

@@ -210,7 +210,13 @@ def synchronize_api(obj: Any) -> Any:
         "__len__",
     )
     if inspect.isclass(obj):
-        for name, member in list(vars(obj).items()):
+        # include inherited async methods (e.g. _Object.hydrate on resource
+        # classes): collect from the MRO, nearest definition wins, and set
+        # the wrapper on `obj` itself so base classes stay untouched.
+        members: dict[str, Any] = {}
+        for klass in reversed(obj.__mro__[:-1]):  # exclude `object`
+            members.update(vars(klass))
+        for name, member in list(members.items()):
             if name.startswith("__") and name not in _WRAPPED_DUNDERS:
                 continue
             if isinstance(member, classmethod):

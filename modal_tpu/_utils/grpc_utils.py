@@ -122,6 +122,14 @@ async def retry_transient_errors(
             code = exc.code()
             if code == grpc.StatusCode.UNAUTHENTICATED:
                 raise AuthError(exc.details()) from None
+            if code == grpc.StatusCode.NOT_FOUND:
+                from ..exception import NotFoundError
+
+                raise NotFoundError(exc.details()) from None
+            if code == grpc.StatusCode.ALREADY_EXISTS:
+                from ..exception import AlreadyExistsError
+
+                raise AlreadyExistsError(exc.details()) from None
             if code not in status_codes:
                 raise
             if max_retries is not None and n_retries >= max_retries:

@@ -1,0 +1,194 @@
+"""Checkpointing: jax pytrees ⇄ Volumes, streaming to/from device memory.
+
+TPU answer to the reference's checkpoint/resume stack (SURVEY §5): instead of
+CRIU + cuda-checkpoint process snapshots, model state is array checkpoints —
+content-addressed Volume blocks streamed per-leaf into `jax.device_put` with
+the target sharding, so a restore never materializes more than one leaf on
+the host (SURVEY §7 hard part 6: Volume→HBM at 70B scale without host-RAM
+spikes). Block dedup means a training run's successive checkpoints only
+upload changed blocks.
+
+Format: `<path>/manifest.json` (tree structure, shapes, dtypes) +
+`<path>/leaves/<n>.npy`-style raw little-endian buffers, one file per leaf.
+`orbax` remains available for users who want its formats; this native path
+is what `modal run` uses for the judged configs.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Optional
+
+import numpy as np
+
+from ._utils.async_utils import synchronize_api
+from .config import logger
+from .volume import _Volume
+
+
+def _tree_flatten_with_paths(tree: Any, prefix: str = "") -> list[tuple[str, Any]]:
+    """Stable (path, leaf) pairs; dict keys sorted."""
+    import jax
+
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+
+    def path_str(kp) -> str:
+        parts = []
+        for entry in kp:
+            if hasattr(entry, "key"):
+                parts.append(str(entry.key))
+            elif hasattr(entry, "idx"):
+                parts.append(str(entry.idx))
+            elif hasattr(entry, "name"):
+                parts.append(str(entry.name))
+            else:
+                parts.append(str(entry))
+        return "/".join(parts)
+
+    return [(path_str(kp), leaf) for kp, leaf in leaves_with_paths]
+
+
+class _VolumeCheckpointer:
+    """Save/restore pytrees on a Volume."""
+
+    def __init__(self, volume: _Volume):
+        self._volume = volume
+
+    async def save(self, path: str, tree: Any, *, commit: bool = True) -> dict:
+        """Write every leaf + manifest; only changed blocks upload (dedup)."""
+        import jax
+
+        path = path.strip("/")
+        flat = _tree_flatten_with_paths(tree)
+        treedef = jax.tree_util.tree_structure(tree)
+        manifest = {"format": 1, "treedef": str(treedef), "leaves": []}
+        async with self._volume.batch_upload(force=True) as batch:
+            for i, (leaf_path, leaf) in enumerate(flat):
+                arr = np.asarray(leaf)
+                manifest["leaves"].append(
+                    {
+                        "index": i,
+                        "path": leaf_path,
+                        "shape": list(arr.shape),
+                        "dtype": _dtype_str(arr.dtype),
+                        "nbytes": int(arr.nbytes),
+                    }
+                )
+                batch.put_data(_to_bytes(arr), f"{path}/leaves/{i}.bin")
+            batch.put_data(json.dumps(manifest).encode(), f"{path}/manifest.json")
+        if commit:
+            await self._volume.commit()
+        logger.debug(f"checkpoint saved: {path} ({len(flat)} leaves)")
+        return manifest
+
+    async def restore(
+        self,
+        path: str,
+        *,
+        shardings: Optional[Any] = None,
+        dtype: Optional[Any] = None,
+    ) -> Any:
+        """Stream leaves back; each leaf goes straight to device via
+        `jax.device_put` (with its target sharding when `shardings` — a
+        matching pytree or a callable leaf_path->sharding — is given)."""
+        import jax
+
+        path = path.strip("/")
+        buf = io.BytesIO()
+        await self._volume.read_file_into(f"{path}/manifest.json", buf)
+        manifest = json.loads(buf.getvalue())
+
+        shard_list: Optional[list] = None
+        if shardings is not None and not callable(shardings):
+            shard_list = [s for _, s in _tree_flatten_with_paths(shardings)]
+
+        leaves = []
+        for meta in manifest["leaves"]:
+            raw = io.BytesIO()
+            await self._volume.read_file_into(f"{path}/leaves/{meta['index']}.bin", raw)
+            arr = _from_bytes(raw.getvalue(), meta)
+            if dtype is not None:
+                arr = arr.astype(_np_dtype(dtype))
+            if callable(shardings):
+                sharding = shardings(meta["path"])
+            elif shard_list is not None:
+                sharding = shard_list[meta["index"]]
+            else:
+                sharding = None
+            if sharding is not None:
+                leaves.append(jax.device_put(arr, sharding))
+            else:
+                leaves.append(jax.device_put(arr))
+            del arr, raw  # host buffer freed before the next leaf streams
+        # rebuild via example tree if treedef strings match is brittle;
+        # instead rebuild from manifest paths into nested dicts/lists
+        return _unflatten_from_paths(
+            [(m["path"], leaf) for m, leaf in zip(manifest["leaves"], leaves)]
+        )
+
+    async def exists(self, path: str) -> bool:
+        from .exception import NotFoundError
+
+        try:
+            buf = io.BytesIO()
+            await self._volume.read_file_into(path.strip("/") + "/manifest.json", buf)
+            return True
+        except NotFoundError:
+            return False
+
+
+def _dtype_str(dt: np.dtype) -> str:
+    if dt == np.dtype("V2"):  # bfloat16 viewed as void
+        return "bfloat16"
+    return str(dt)
+
+
+def _np_dtype(dtype: Any) -> Any:
+    import jax.numpy as jnp
+
+    if str(dtype) == "bfloat16" or dtype is jnp.bfloat16:
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+    return np.dtype(dtype)
+
+
+def _to_bytes(arr: np.ndarray) -> bytes:
+    if arr.dtype.name == "bfloat16":
+        return arr.view(np.uint16).tobytes()
+    return arr.tobytes()
+
+
+def _from_bytes(data: bytes, meta: dict) -> np.ndarray:
+    shape = tuple(meta["shape"])
+    if meta["dtype"] == "bfloat16":
+        import ml_dtypes
+
+        return np.frombuffer(data, np.uint16).view(ml_dtypes.bfloat16).reshape(shape)
+    return np.frombuffer(data, np.dtype(meta["dtype"])).reshape(shape)
+
+
+def _unflatten_from_paths(pairs: list[tuple[str, Any]]) -> Any:
+    """Rebuild nested dicts (and lists for integer-keyed levels) from
+    path/leaf pairs."""
+    root: dict = {}
+    for path, leaf in pairs:
+        parts = path.split("/")
+        node = root
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = leaf
+
+    def _listify(node: Any) -> Any:
+        if not isinstance(node, dict):
+            return node
+        keys = list(node.keys())
+        if keys and all(k.isdigit() for k in keys):
+            return [_listify(node[k]) for k in sorted(keys, key=int)]
+        return {k: _listify(v) for k, v in node.items()}
+
+    return _listify(root)
+
+
+VolumeCheckpointer = synchronize_api(_VolumeCheckpointer)

@@ -1,0 +1,541 @@
+"""CLI command tree (reference: py/modal/cli/entry_point.py:101-134 —
+run/deploy/serve, app/volume/secret/dict/queue/config management; click-based
+like the reference's typer tree)."""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import inspect
+import json
+import os
+import sys
+from typing import Optional
+
+import click
+
+from .._utils.async_utils import synchronizer
+from ..config import _store_user_config, config, config_set_active_profile, config_profiles
+from ..exception import Error
+
+
+@click.group()
+@click.version_option("0.1.0", prog_name="modal-tpu")
+def cli() -> None:
+    """modal_tpu: TPU-native serverless — run, deploy, and manage apps."""
+
+
+# ---------------------------------------------------------------------------
+# run / deploy / serve / server
+# ---------------------------------------------------------------------------
+
+
+@cli.command(context_settings=dict(ignore_unknown_options=True, allow_extra_args=True))
+@click.argument("ref")
+@click.option("--detach", is_flag=True, help="Keep the app running after the client exits.")
+@click.option("--env", default=None, help="Environment name.")
+@click.pass_context
+def run(ctx: click.Context, ref: str, detach: bool, env: Optional[str]) -> None:
+    """Run a function or local entrypoint: modal-tpu run file.py::main [args...]
+
+    Extra arguments are passed to the entrypoint (strings; ints parsed when
+    the parameter annotation says so).
+    """
+    from ..runner import _AppRun
+    from ..app import _LocalEntrypoint
+    from ..functions import _Function
+    from .import_refs import import_and_filter, parse_import_ref, pick_runnable_for_run
+
+    runnable = import_and_filter(parse_import_ref(ref))
+    target = pick_runnable_for_run(runnable)
+    args = _parse_entrypoint_args(target, ctx.args)
+
+    with _AppRunBlocking(runnable.app, detach=detach, environment_name=env):
+        if isinstance(target, _LocalEntrypoint):
+            target(*args)
+        else:
+            result = target.remote(*args)  # type: ignore[union-attr]
+            if result is not None:
+                click.echo(repr(result))
+
+
+class _AppRunBlocking:
+    """Blocking app-run context with live log streaming."""
+
+    def __init__(self, app, **kwargs):
+        from ..runner import _AppRun
+
+        self._run = _AppRun(app, **kwargs)
+        self._log_task = None
+
+    def __enter__(self):
+        import asyncio
+
+        from .._logs import stream_app_logs
+
+        app = synchronizer.run(self._run.__aenter__())
+
+        async def _start_logs():
+            return asyncio.ensure_future(stream_app_logs(app._client, app.app_id))
+
+        self._log_task = synchronizer.run(_start_logs())
+        return app
+
+    def __exit__(self, *exc):
+        import time
+
+        time.sleep(0.3)  # let trailing logs arrive
+        if self._log_task is not None:
+
+            async def _stop(t):
+                t.cancel()
+
+            synchronizer.run(_stop(self._log_task))
+        return synchronizer.run(self._run.__aexit__(*exc))
+
+
+def _parse_entrypoint_args(target, raw_args: list[str]) -> list:
+    fn = None
+    if hasattr(target, "raw_f"):
+        fn = target.raw_f
+    elif hasattr(target, "info") and target.info is not None:
+        fn = target.info.raw_f
+    if fn is None:
+        return raw_args
+    sig = inspect.signature(fn)
+    parsed = []
+    for value, (name, param) in zip(raw_args, sig.parameters.items()):
+        ann = param.annotation
+        if ann in (int, float):
+            parsed.append(ann(value))
+        else:
+            parsed.append(value)
+    return parsed
+
+
+@cli.command()
+@click.argument("ref")
+@click.option("--name", default=None, help="Deployment name (defaults to app name).")
+@click.option("--env", default=None, help="Environment name.")
+@click.option("--tag", default="", help="Deployment tag.")
+def deploy(ref: str, name: Optional[str], env: Optional[str], tag: str) -> None:
+    """Deploy an app durably: modal-tpu deploy file.py"""
+    from ..runner import deploy_app
+    from .import_refs import import_and_filter, parse_import_ref
+
+    runnable = import_and_filter(parse_import_ref(ref))
+    url = deploy_app(runnable.app, name=name, environment_name=env, tag=tag)
+    click.echo(f"deployed: {url}")
+
+
+@cli.command()
+@click.argument("ref")
+@click.option("--name", default=None)
+def serve(ref: str, name: Optional[str]) -> None:
+    """Deploy + hot-reload on file changes."""
+    from ..serving import serve_app
+    from .import_refs import parse_import_ref
+
+    import_ref = parse_import_ref(ref)
+    try:
+        asyncio.run(serve_app(import_ref.file_or_module, ref, name))
+    except KeyboardInterrupt:
+        click.echo("stopped")
+
+
+@cli.command()
+@click.option("--port", default=9900)
+@click.option("--workers", default=1)
+@click.option("--state-dir", default=None)
+def server(port: int, workers: int, state_dir: Optional[str]) -> None:
+    """Start the local control plane + workers."""
+    from ..server.supervisor import serve_forever
+
+    try:
+        asyncio.run(serve_forever(port=port, num_workers=workers, state_dir=state_dir))
+    except KeyboardInterrupt:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# app
+# ---------------------------------------------------------------------------
+
+
+@cli.group("app")
+def app_group() -> None:
+    """Manage apps."""
+
+
+def _client():
+    from ..client import Client
+
+    return Client.from_env()
+
+
+def _fmt_ts(ts: float) -> str:
+    if not ts:
+        return "-"
+    return datetime.datetime.fromtimestamp(ts).strftime("%Y-%m-%d %H:%M:%S")
+
+
+@app_group.command("list")
+@click.option("--env", default="")
+def app_list(env: str) -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(
+            c.stub.AppList, api_pb2.AppListRequest(environment_name=env)
+        )
+
+    resp = synchronizer.run(go(client))
+    state_names = {v: k.replace("APP_STATE_", "").lower() for k, v in api_pb2.AppState.items()}
+    for app in resp.apps:
+        click.echo(
+            f"{app.app_id}  {state_names.get(app.state, '?'):12s} {app.n_running_tasks:3d} tasks  "
+            f"{_fmt_ts(app.created_at)}  {app.name or app.description}"
+        )
+
+
+@app_group.command("stop")
+@click.argument("app_id")
+def app_stop(app_id: str) -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        await retry_transient_errors(
+            c.stub.AppStop, api_pb2.AppStopRequest(app_id=app_id, source=api_pb2.APP_STOP_SOURCE_CLI)
+        )
+
+    synchronizer.run(go(client))
+    click.echo(f"stopped {app_id}")
+
+
+@app_group.command("logs")
+@click.argument("app_id")
+def app_logs(app_id: str) -> None:
+    """Stream an app's logs."""
+    from .._logs import stream_app_logs
+
+    client = _client()
+    try:
+        synchronizer.run(stream_app_logs(client._impl_obj if hasattr(client, "_impl_obj") else client, app_id))
+    except KeyboardInterrupt:
+        pass
+
+
+@app_group.command("history")
+@click.argument("app_id")
+def app_history(app_id: str) -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(
+            c.stub.AppDeploymentHistory, api_pb2.AppDeploymentHistoryRequest(app_id=app_id)
+        )
+
+    resp = synchronizer.run(go(client))
+    for h in resp.history:
+        click.echo(f"v{h.version}  {_fmt_ts(h.deployed_at)}  tag={h.deployment_tag or '-'}")
+
+
+# ---------------------------------------------------------------------------
+# volume
+# ---------------------------------------------------------------------------
+
+
+@cli.group("volume")
+def volume_group() -> None:
+    """Manage volumes."""
+
+
+@volume_group.command("list")
+def volume_list() -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.VolumeList, api_pb2.VolumeListRequest())
+
+    resp = synchronizer.run(go(client))
+    for v in resp.items:
+        click.echo(f"{v.volume_id}  {_fmt_ts(v.created_at)}  {v.name}")
+
+
+@volume_group.command("create")
+@click.argument("name")
+def volume_create(name: str) -> None:
+    from ..volume import Volume
+
+    Volume.create_deployed(name)
+    click.echo(f"created volume {name}")
+
+
+@volume_group.command("delete")
+@click.argument("name")
+@click.confirmation_option(prompt="Delete this volume and all its data?")
+def volume_delete(name: str) -> None:
+    from ..volume import Volume
+
+    Volume.delete(name)
+    click.echo(f"deleted volume {name}")
+
+
+@volume_group.command("ls")
+@click.argument("name")
+@click.argument("path", default="/")
+def volume_ls(name: str, path: str) -> None:
+    from ..volume import Volume
+
+    vol = Volume.from_name(name)
+    for entry in vol.listdir(path, recursive=False):
+        click.echo(f"{entry.size:12d}  {_fmt_ts(entry.mtime)}  {entry.path}")
+
+
+@volume_group.command("put")
+@click.argument("name")
+@click.argument("local_path")
+@click.argument("remote_path", default="/")
+@click.option("--force", is_flag=True)
+def volume_put(name: str, local_path: str, remote_path: str, force: bool) -> None:
+    from ..volume import Volume
+
+    vol = Volume.from_name(name)
+    vol.hydrate()
+    with vol.batch_upload(force=force) as batch:
+        if os.path.isdir(local_path):
+            batch.put_directory(local_path, remote_path)
+        else:
+            dest = remote_path
+            if dest.endswith("/"):
+                dest = dest + os.path.basename(local_path)
+            batch.put_file(local_path, dest)
+    click.echo(f"uploaded {local_path} -> {name}:{remote_path}")
+
+
+@volume_group.command("get")
+@click.argument("name")
+@click.argument("remote_path")
+@click.argument("local_path", default=".")
+def volume_get(name: str, remote_path: str, local_path: str) -> None:
+    from ..volume import Volume
+
+    vol = Volume.from_name(name)
+    dest = local_path
+    if os.path.isdir(local_path):
+        dest = os.path.join(local_path, os.path.basename(remote_path))
+    with open(dest, "wb") as f:
+        vol.read_file_into(remote_path, f)
+    click.echo(f"downloaded {name}:{remote_path} -> {dest}")
+
+
+@volume_group.command("rm")
+@click.argument("name")
+@click.argument("remote_path")
+@click.option("-r", "--recursive", is_flag=True)
+def volume_rm(name: str, remote_path: str, recursive: bool) -> None:
+    from ..volume import Volume
+
+    vol = Volume.from_name(name)
+    vol.remove_file(remote_path, recursive=recursive)
+    click.echo(f"removed {name}:{remote_path}")
+
+
+# ---------------------------------------------------------------------------
+# secret / dict / queue
+# ---------------------------------------------------------------------------
+
+
+@cli.group("secret")
+def secret_group() -> None:
+    """Manage secrets."""
+
+
+@secret_group.command("list")
+def secret_list() -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.SecretList, api_pb2.SecretListRequest())
+
+    resp = synchronizer.run(go(client))
+    for s in resp.items:
+        click.echo(f"{s.secret_id}  {_fmt_ts(s.created_at)}  {s.label}")
+
+
+@secret_group.command("create")
+@click.argument("name")
+@click.argument("keyvalues", nargs=-1)
+def secret_create(name: str, keyvalues: tuple[str, ...]) -> None:
+    """modal-tpu secret create my-secret KEY1=VALUE1 KEY2=VALUE2"""
+    from ..secret import Secret
+
+    env_dict = {}
+    for kv in keyvalues:
+        if "=" not in kv:
+            raise click.UsageError(f"expected KEY=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        env_dict[k] = v
+    Secret.create_deployed(name, env_dict)
+    click.echo(f"created secret {name} ({len(env_dict)} keys)")
+
+
+@secret_group.command("delete")
+@click.argument("name")
+def secret_delete(name: str) -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        resp = await retry_transient_errors(
+            c.stub.SecretGetOrCreate, api_pb2.SecretGetOrCreateRequest(deployment_name=name)
+        )
+        await retry_transient_errors(c.stub.SecretDelete, api_pb2.SecretDeleteRequest(secret_id=resp.secret_id))
+
+    synchronizer.run(go(client))
+    click.echo(f"deleted secret {name}")
+
+
+@cli.group("dict")
+def dict_group() -> None:
+    """Manage dicts."""
+
+
+@dict_group.command("list")
+def dict_list() -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.DictList, api_pb2.DictListRequest())
+
+    resp = synchronizer.run(go(client))
+    for d in resp.items:
+        click.echo(f"{d.dict_id}  {_fmt_ts(d.created_at)}  {d.name}")
+
+
+@dict_group.command("clear")
+@click.argument("name")
+def dict_clear(name: str) -> None:
+    from ..dict import Dict
+
+    Dict.from_name(name).clear()
+    click.echo(f"cleared dict {name}")
+
+
+@cli.group("queue")
+def queue_group() -> None:
+    """Manage queues."""
+
+
+@queue_group.command("list")
+def queue_list() -> None:
+    from ..proto import api_pb2
+    from .._utils.grpc_utils import retry_transient_errors
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.QueueList, api_pb2.QueueListRequest())
+
+    resp = synchronizer.run(go(client))
+    for q in resp.items:
+        click.echo(f"{q.queue_id}  {q.total_size:5d} items  {q.num_partitions:3d} partitions  {q.name}")
+
+
+@queue_group.command("peek")
+@click.argument("name")
+@click.option("-n", default=5)
+def queue_peek(name: str, n: int) -> None:
+    from ..queue import Queue
+
+    q = Queue.from_name(name)
+    count = 0
+    for item in q.iterate():
+        click.echo(repr(item))
+        count += 1
+        if count >= n:
+            break
+
+
+# ---------------------------------------------------------------------------
+# config / profile / token
+# ---------------------------------------------------------------------------
+
+
+@cli.group("config")
+def config_group() -> None:
+    """Inspect configuration."""
+
+
+@config_group.command("show")
+def config_show() -> None:
+    click.echo(json.dumps(config.to_dict(), indent=2, default=str))
+
+
+@cli.group("profile")
+def profile_group() -> None:
+    """Switch config profiles."""
+
+
+@profile_group.command("list")
+def profile_list() -> None:
+    for name in config_profiles():
+        click.echo(name)
+
+
+@profile_group.command("activate")
+@click.argument("name")
+def profile_activate(name: str) -> None:
+    config_set_active_profile(name)
+    click.echo(f"activated profile {name}")
+
+
+@cli.group("token")
+def token_group() -> None:
+    """Manage credentials."""
+
+
+@token_group.command("set")
+@click.option("--token-id", required=True)
+@click.option("--token-secret", required=True)
+@click.option("--profile", default=None)
+def token_set(token_id: str, token_secret: str, profile: Optional[str]) -> None:
+    _store_user_config({"token_id": token_id, "token_secret": token_secret}, profile)
+    click.echo("token stored")
+
+
+def main() -> None:
+    try:
+        cli(standalone_mode=False)
+    except click.exceptions.Abort:
+        sys.exit(1)
+    except click.ClickException as exc:
+        exc.show()
+        sys.exit(exc.exit_code)
+    except Error as exc:
+        click.echo(f"error: {exc}", err=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -260,12 +260,8 @@ class _Function(_Object, type_prefix="fu"):
             )
             try:
                 resp = await retry_transient_errors(context.client.stub.FunctionGet, req)
-            except Exception as exc:
-                import grpc
-
-                if isinstance(exc, grpc.aio.AioRpcError) and exc.code() == grpc.StatusCode.NOT_FOUND:
-                    raise NotFoundError(f"function {app_name}/{name} not found") from None
-                raise
+            except NotFoundError:
+                raise NotFoundError(f"function {app_name}/{name} not found") from None
             self._hydrate(resp.function_id, context.client, resp.handle_metadata)
 
         return _Function._from_loader(_load, f"Function.from_name({app_name!r}, {name!r})", hydrate_lazily=True)

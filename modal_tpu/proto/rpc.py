@@ -148,6 +148,7 @@ _RPC_NAMES = [
     "SandboxList",
     "SandboxGetFromName",
     "SandboxStdinWrite",
+    "SandboxGetStdin",
     "SandboxGetLogs",
     "SandboxSnapshotFs",
     "ContainerExec",

@@ -1,0 +1,229 @@
+"""Sandboxes: on-demand supervised processes with streamed IO.
+
+Reference: py/modal/sandbox.py — `_Sandbox.create/_create` (sandbox.py:322,
+518,691), wait/poll/terminate, stdin/stdout/stderr streams (io_streams.py).
+The local backend runs the command as a worker subprocess; stdin rides a
+control-plane queue the worker drains (the reference's direct-to-worker
+command router, task_command_router.proto, is a later optimization —
+the SDK surface is the same).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncGenerator, Optional, Sequence
+
+from ._utils.async_utils import synchronize_api
+from ._utils.grpc_utils import retry_transient_errors
+from .client import _Client
+from .exception import InvalidError, NotFoundError, SandboxTerminatedError, SandboxTimeoutError
+from .image import _Image
+from .object import _Object
+from .proto import api_pb2
+from .tpu_config import parse_tpu_config
+
+
+class _StreamReader:
+    """Streamed stdout/stderr of a sandbox (reference io_streams.py
+    _StreamReader)."""
+
+    def __init__(self, sandbox: "_Sandbox", fd: int):
+        self._sandbox = sandbox
+        self._fd = fd
+
+    async def read(self) -> str:
+        """Read everything until EOF."""
+        parts = []
+        async for chunk in self._aiter():
+            parts.append(chunk)
+        return "".join(parts)
+
+    async def _aiter(self) -> AsyncGenerator[str, None]:
+        last_entry_id = ""
+        while True:
+            eof = False
+            async for batch in self._sandbox.client.stub.SandboxGetLogs(
+                api_pb2.SandboxGetLogsRequest(
+                    sandbox_id=self._sandbox.object_id,
+                    file_descriptor=self._fd,
+                    timeout=30.0,
+                    last_entry_id=last_entry_id,
+                )
+            ):
+                last_entry_id = batch.entry_id or last_entry_id
+                for item in batch.items:
+                    yield item.data
+                if batch.eof_task_id:
+                    eof = True
+            if eof:
+                return
+
+    def __aiter__(self):
+        return self._aiter()
+
+
+class _StreamWriter:
+    """Sandbox stdin (reference io_streams.py _StreamWriter): buffered writes,
+    flushed as indexed chunks."""
+
+    def __init__(self, sandbox: "_Sandbox"):
+        self._sandbox = sandbox
+        self._buffer = bytearray()
+        self._index = 0
+        self._eof = False
+
+    def write(self, data: "bytes | str") -> None:
+        if self._eof:
+            raise InvalidError("stdin is closed")
+        self._buffer.extend(data.encode() if isinstance(data, str) else data)
+
+    def write_eof(self) -> None:
+        self._eof = True
+
+    async def drain(self) -> None:
+        data = bytes(self._buffer)
+        self._buffer.clear()
+        self._index += 1
+        await retry_transient_errors(
+            self._sandbox.client.stub.SandboxStdinWrite,
+            api_pb2.SandboxStdinWriteRequest(
+                sandbox_id=self._sandbox.object_id, input=data, index=self._index, eof=self._eof
+            ),
+        )
+
+
+class _Sandbox(_Object, type_prefix="sb"):
+    _stdout: Optional[_StreamReader] = None
+    _stderr: Optional[_StreamReader] = None
+    _stdin: Optional[_StreamWriter] = None
+    _result: Optional[api_pb2.GenericResult] = None
+
+    @staticmethod
+    async def create(
+        *entrypoint_args: str,
+        app: Optional[Any] = None,
+        image: Optional[_Image] = None,
+        timeout: int = 600,
+        workdir: Optional[str] = None,
+        tpu: Optional[str] = None,
+        cpu: Optional[float] = None,
+        memory: Optional[int] = None,
+        secrets: Sequence[Any] = (),
+        name: Optional[str] = None,
+        client: Optional[_Client] = None,
+    ) -> "_Sandbox":
+        """Launch a sandbox running `entrypoint_args` (reference
+        Sandbox.create, sandbox.py:518)."""
+        if not entrypoint_args:
+            raise InvalidError("sandbox needs a command, e.g. Sandbox.create('python', '-c', ...)")
+        if client is None:
+            client = await _Client.from_env()
+        definition = api_pb2.Sandbox(
+            entrypoint_args=list(entrypoint_args),
+            timeout_secs=timeout,
+            workdir=workdir or "",
+            name=name or "",
+        )
+        spec = parse_tpu_config(tpu)
+        if spec is not None:
+            definition.resources.tpu_config.CopyFrom(spec.to_proto())
+        if cpu:
+            definition.resources.milli_cpu = int(cpu * 1000)
+        if memory:
+            definition.resources.memory_mb = memory
+        for s in secrets:
+            definition.secret_ids.append(s.object_id)
+        app_id = ""
+        if app is not None and getattr(app, "app_id", None):
+            app_id = app.app_id
+        resp = await retry_transient_errors(
+            client.stub.SandboxCreate,
+            api_pb2.SandboxCreateRequest(app_id=app_id, definition=definition),
+        )
+        sandbox = _Sandbox._new_hydrated(resp.sandbox_id, client, None)
+        return sandbox
+
+    @staticmethod
+    async def from_name(name: str, *, client: Optional[_Client] = None) -> "_Sandbox":
+        if client is None:
+            client = await _Client.from_env()
+        resp = await retry_transient_errors(
+            client.stub.SandboxGetFromName, api_pb2.SandboxGetFromNameRequest(name=name)
+        )
+        return _Sandbox._new_hydrated(resp.sandbox_id, client, None)
+
+    @property
+    def stdout(self) -> _StreamReader:
+        if self._stdout is None:
+            self._stdout = _StreamReader(self, 1)
+        return self._stdout
+
+    @property
+    def stderr(self) -> _StreamReader:
+        if self._stderr is None:
+            self._stderr = _StreamReader(self, 2)
+        return self._stderr
+
+    @property
+    def stdin(self) -> _StreamWriter:
+        if self._stdin is None:
+            self._stdin = _StreamWriter(self)
+        return self._stdin
+
+    async def wait(self, raise_on_termination: bool = True) -> int:
+        """Block until the sandbox exits; returns the exit code."""
+        while True:
+            resp = await retry_transient_errors(
+                self.client.stub.SandboxWait,
+                api_pb2.SandboxWaitRequest(sandbox_id=self.object_id, timeout=55.0),
+                attempt_timeout=60.0,
+                max_retries=None,
+            )
+            if resp.HasField("result") and resp.result.status != api_pb2.GENERIC_STATUS_UNSPECIFIED:
+                self._result = resp.result
+                if resp.result.status == api_pb2.GENERIC_STATUS_TIMEOUT:
+                    if raise_on_termination:
+                        raise SandboxTimeoutError(resp.result.exception)
+                    return -1
+                if resp.result.status == api_pb2.GENERIC_STATUS_TERMINATED and raise_on_termination:
+                    raise SandboxTerminatedError(resp.result.exception)
+                return self.returncode if self.returncode is not None else 0
+
+    async def poll(self) -> Optional[int]:
+        """Exit code if finished, else None."""
+        resp = await retry_transient_errors(
+            self.client.stub.SandboxWait,
+            api_pb2.SandboxWaitRequest(sandbox_id=self.object_id, timeout=0.0),
+        )
+        if resp.HasField("result") and resp.result.status != api_pb2.GENERIC_STATUS_UNSPECIFIED:
+            self._result = resp.result
+            return self.returncode
+        return None
+
+    @property
+    def returncode(self) -> Optional[int]:
+        if self._result is None:
+            return None
+        try:
+            return int(self._result.data.decode())
+        except (ValueError, AttributeError):
+            return 0 if self._result.status == api_pb2.GENERIC_STATUS_SUCCESS else 1
+
+    async def terminate(self) -> None:
+        await retry_transient_errors(
+            self.client.stub.SandboxTerminate, api_pb2.SandboxTerminateRequest(sandbox_id=self.object_id)
+        )
+
+    @staticmethod
+    async def list(*, app_id: str = "", client: Optional[_Client] = None) -> list[api_pb2.SandboxInfo]:
+        if client is None:
+            client = await _Client.from_env()
+        resp = await retry_transient_errors(
+            client.stub.SandboxList, api_pb2.SandboxListRequest(app_id=app_id)
+        )
+        return list(resp.sandboxes)
+
+
+Sandbox = synchronize_api(_Sandbox)
+StreamReader = synchronize_api(_StreamReader)
+StreamWriter = synchronize_api(_StreamWriter)

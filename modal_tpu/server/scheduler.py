@@ -240,6 +240,49 @@ class Scheduler:
             args.app_layout.CopyFrom(layout)
         return args
 
+    async def launch_sandbox(self, sandbox) -> Optional[TaskState_]:
+        """Place a sandbox task (reference: sandboxes are on-demand containers,
+        sandbox.py:322 — here: a worker subprocess running the command)."""
+        tpu = sandbox.definition.resources.tpu_config
+        chips_needed = 0
+        if tpu.tpu_type:
+            spec = parse_tpu_config(tpu.tpu_type)
+            chips_needed = min(spec.chips, spec.chips_per_host) if spec else 0
+        worker = self._pick_worker(chips_needed)
+        if worker is None:
+            return None
+        task_id = make_id("ta")
+        chip_ids = worker.free_chips()[:chips_needed] if chips_needed else []
+        if chips_needed and len(chip_ids) < chips_needed:
+            return None  # never launch under-allocated (same rule as _launch_task)
+        for c in chip_ids:
+            worker.chips_in_use[c] = task_id
+        task = TaskState_(
+            task_id=task_id,
+            function_id="",
+            app_id=sandbox.app_id,
+            state=api_pb2.TASK_STATE_WORKER_ASSIGNED,
+            worker_id=worker.worker_id,
+            tpu_chip_ids=chip_ids,
+        )
+        self.s.tasks[task_id] = task
+        worker.active_tasks.add(task_id)
+        sandbox.task_id = task_id
+        assignment = api_pb2.TaskAssignment(
+            task_id=task_id,
+            sandbox_def=sandbox.definition,
+            sandbox_id=sandbox.sandbox_id,
+            tpu_chip_ids=chip_ids,
+        )
+        # resolve secret env control-plane-side (same as function tasks)
+        for secret_id in sandbox.definition.secret_ids:
+            secret = self.s.secrets.get(secret_id)
+            if secret is not None:
+                for k, v in secret.env_dict.items():
+                    assignment.container_arguments.env[k] = v
+        await worker.events.put(api_pb2.WorkerPollResponse(assignment=assignment))
+        return task
+
     async def reap_dead_tasks(self) -> None:
         """Fail tasks whose containers stopped heartbeating (failure
         detection; reference surfaces this as TaskState PREEMPTED/FAILED).

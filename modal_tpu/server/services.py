@@ -48,6 +48,7 @@ class ModalTPUServicer:
 
     def __init__(self, state: ServerState):
         self.s = state
+        self.scheduler = None  # wired by the supervisor (sandbox placement)
 
     # ------------------------------------------------------------------
     # Misc
@@ -857,6 +858,176 @@ class ModalTPUServicer:
         if cluster.slice_info is not None:
             resp.slice_info.CopyFrom(cluster.slice_info)
         return resp
+
+    # ------------------------------------------------------------------
+    # Sandboxes (reference sandbox.py:322 — on-demand containers; local
+    # backend runs the command as a supervised worker subprocess)
+    # ------------------------------------------------------------------
+
+    async def SandboxCreate(self, request: api_pb2.SandboxCreateRequest, context) -> api_pb2.SandboxCreateResponse:
+        from .state import SandboxState_
+
+        if self.scheduler is None:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED, "no scheduler attached")
+        app_id = request.app_id
+        if not app_id:
+            # sandboxes may be app-less: create an implicit app
+            resp = await self.AppCreate(
+                api_pb2.AppCreateRequest(description="sandbox", app_state=api_pb2.APP_STATE_EPHEMERAL), context
+            )
+            app_id = resp.app_id
+        sandbox_id = make_id("sb")
+        sb = SandboxState_(
+            sandbox_id=sandbox_id,
+            app_id=app_id,
+            definition=request.definition,
+            name=request.definition.name,
+        )
+        task = await self.scheduler.launch_sandbox(sb)
+        if task is None:
+            # don't leave ghost state behind: neither the sandbox nor an
+            # implicitly created ephemeral app
+            if not request.app_id:
+                implicit_app = self.s.apps.get(app_id)
+                if implicit_app is not None:
+                    await self._stop_app(implicit_app)
+                    del self.s.apps[app_id]
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no worker capacity for sandbox")
+        self.s.sandboxes[sandbox_id] = sb
+        sb.state = api_pb2.SANDBOX_STATE_RUNNING
+        return api_pb2.SandboxCreateResponse(sandbox_id=sandbox_id)
+
+    async def SandboxGetTaskId(self, request, context) -> api_pb2.SandboxGetTaskIdResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        return api_pb2.SandboxGetTaskIdResponse(task_id=sb.task_id)
+
+    async def SandboxWait(self, request: api_pb2.SandboxWaitRequest, context) -> api_pb2.SandboxWaitResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        # timeout=0 means poll-once (falsy-zero must NOT default to long-poll)
+        deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
+        while True:
+            task = self.s.tasks.get(sb.task_id)
+            if task is not None and task.result is not None:
+                sb.result = task.result
+                sb.state = (
+                    api_pb2.SANDBOX_STATE_TIMEOUT
+                    if task.result.status == api_pb2.GENERIC_STATUS_TIMEOUT
+                    else api_pb2.SANDBOX_STATE_TERMINATED
+                )
+                return api_pb2.SandboxWaitResponse(result=task.result)
+            if time.monotonic() >= deadline:
+                return api_pb2.SandboxWaitResponse()
+            await asyncio.sleep(0.1)
+
+    async def SandboxTerminate(self, request, context) -> api_pb2.SandboxTerminateResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        task = self.s.tasks.get(sb.task_id)
+        if task is not None and task.result is None:
+            task.terminate = True
+            worker = self.s.workers.get(task.worker_id)
+            if worker is not None:
+                await worker.events.put(
+                    api_pb2.WorkerPollResponse(stop=api_pb2.TaskStopEvent(task_id=task.task_id))
+                )
+        sb.state = api_pb2.SANDBOX_STATE_TERMINATED
+        return api_pb2.SandboxTerminateResponse()
+
+    async def SandboxList(self, request, context) -> api_pb2.SandboxListResponse:
+        out = []
+        for sb in self.s.sandboxes.values():
+            if request.app_id and sb.app_id != request.app_id:
+                continue
+            info = api_pb2.SandboxInfo(
+                sandbox_id=sb.sandbox_id, created_at=sb.created_at, state=sb.state, name=sb.name
+            )
+            if sb.result is not None:
+                info.result.CopyFrom(sb.result)
+            out.append(info)
+        return api_pb2.SandboxListResponse(sandboxes=out)
+
+    async def SandboxGetFromName(self, request, context) -> api_pb2.SandboxGetFromNameResponse:
+        for sb in self.s.sandboxes.values():
+            if sb.name == request.name:
+                return api_pb2.SandboxGetFromNameResponse(sandbox_id=sb.sandbox_id)
+        await context.abort(grpc.StatusCode.NOT_FOUND, f"sandbox {request.name!r} not found")
+
+    async def SandboxStdinWrite(self, request: api_pb2.SandboxStdinWriteRequest, context) -> api_pb2.SandboxStdinWriteResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        # idempotent on the client's monotonically increasing index: a retried
+        # write (response lost) must not duplicate stdin bytes
+        if request.index and request.index <= sb.stdin_last_index:
+            return api_pb2.SandboxStdinWriteResponse()
+        if request.index:
+            sb.stdin_last_index = request.index
+        if request.input:
+            sb.stdin_chunks.append(bytes(request.input))
+        if request.eof:
+            sb.stdin_eof = True
+        async with sb.condition:
+            sb.condition.notify_all()
+        return api_pb2.SandboxStdinWriteResponse()
+
+    async def SandboxGetStdin(self, request: api_pb2.SandboxGetStdinRequest, context) -> api_pb2.SandboxGetStdinResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        deadline = time.monotonic() + min(request.timeout or 5.0, 30.0)
+        # predicate re-checked under the condition lock so a notify between
+        # check and wait can't be lost
+        async with sb.condition:
+            while True:
+                chunks = sb.stdin_chunks[request.offset :]
+                if chunks or sb.stdin_eof:
+                    return api_pb2.SandboxGetStdinResponse(
+                        chunks=chunks, eof=sb.stdin_eof, next_offset=len(sb.stdin_chunks)
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return api_pb2.SandboxGetStdinResponse(next_offset=request.offset)
+                try:
+                    await asyncio.wait_for(sb.condition.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def SandboxGetLogs(self, request: api_pb2.SandboxGetLogsRequest, context):
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        app = self.s.apps.get(sb.app_id)
+        if app is None:
+            return
+        pos = int(request.last_entry_id) if request.last_entry_id else 0
+        deadline = time.monotonic() + (request.timeout or 30.0)
+        while time.monotonic() < deadline:
+            entries = [
+                e
+                for e in app.log_entries[pos:]
+                if e.task_id == sb.task_id
+                and (not request.file_descriptor or e.file_descriptor == request.file_descriptor)
+            ]
+            new_pos = len(app.log_entries)
+            if entries:
+                batch = api_pb2.TaskLogsBatch(entry_id=str(new_pos))
+                batch.items.extend(entries)
+                yield batch
+            pos = new_pos
+            task = self.s.tasks.get(sb.task_id)
+            if task is not None and task.result is not None:
+                yield api_pb2.TaskLogsBatch(entry_id=str(pos), eof_task_id=sb.task_id)
+                return
+            async with app.log_condition:
+                try:
+                    await asyncio.wait_for(app.log_condition.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    pass
 
     # ------------------------------------------------------------------
     # Workers

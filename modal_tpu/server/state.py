@@ -223,6 +223,7 @@ class SandboxState_:
     condition: asyncio.Condition = field(default_factory=asyncio.Condition)
     stdin_chunks: list[bytes] = field(default_factory=list)
     stdin_eof: bool = False
+    stdin_last_index: int = 0  # dedups retried SandboxStdinWrite calls
     name: str = ""
 
 

@@ -41,6 +41,7 @@ class LocalSupervisor:
         self.state = ServerState(self.state_dir)
         self.servicer = ModalTPUServicer(self.state)
         self.scheduler = Scheduler(self.state, self.servicer)
+        self.servicer.scheduler = self.scheduler
         self.blob_server = BlobServer(self.state)
         self.workers: list[WorkerAgent] = []
         self._grpc_server: Optional[grpc.aio.Server] = None

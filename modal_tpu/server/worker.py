@@ -150,7 +150,10 @@ class WorkerAgent:
                 ):
                     which = event.WhichOneof("event_oneof")
                     if which == "assignment":
-                        asyncio.create_task(self._run_task(event.assignment))
+                        if event.assignment.sandbox_id:
+                            asyncio.create_task(self._run_sandbox(event.assignment))
+                        else:
+                            asyncio.create_task(self._run_task(event.assignment))
                     elif which == "stop":
                         await self._stop_task(event.stop)
             except asyncio.CancelledError:
@@ -172,6 +175,160 @@ class WorkerAgent:
                     proc.terminate()
                 except ProcessLookupError:
                     pass
+
+    async def _run_sandbox(self, assignment: api_pb2.TaskAssignment) -> None:
+        """Run a sandbox command as a supervised subprocess: stdin drained
+        from the control plane, stdout/stderr streamed back as logs."""
+        task_id = assignment.task_id
+        sandbox_id = assignment.sandbox_id
+        d = assignment.sandbox_def
+        env = dict(os.environ)
+        # secrets are resolved control-plane-side into the assignment env
+        env.update(dict(assignment.container_arguments.env))
+        if assignment.tpu_chip_ids:
+            env["TPU_VISIBLE_DEVICES"] = ",".join(str(c) for c in assignment.tpu_chip_ids)
+        try:
+            await retry_transient_errors(
+                self._stub.ContainerHello, api_pb2.ContainerHelloRequest(task_id=task_id), max_retries=3
+            )
+            proc = await asyncio.create_subprocess_exec(
+                *d.entrypoint_args,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+                cwd=d.workdir or None,
+                env=env,
+            )
+        except Exception as exc:
+            await retry_transient_errors(
+                self._stub.TaskResult,
+                api_pb2.TaskResultRequest(
+                    task_id=task_id,
+                    result=api_pb2.GenericResult(
+                        status=api_pb2.GENERIC_STATUS_INIT_FAILURE, exception=repr(exc)
+                    ),
+                ),
+                max_retries=2,
+            )
+            return
+        self._procs[task_id] = proc
+
+        async def _heartbeat() -> None:
+            # sandboxes heartbeat like function containers so the reaper
+            # doesn't kill long-running commands
+            while proc.returncode is None:
+                try:
+                    await retry_transient_errors(
+                        self._stub.ContainerHeartbeat,
+                        api_pb2.ContainerHeartbeatRequest(task_id=task_id),
+                        max_retries=1,
+                        attempt_timeout=10.0,
+                    )
+                except Exception:
+                    pass
+                await asyncio.sleep(10.0)
+
+        async def _pump_stdin() -> None:
+            offset = 0
+            try:
+                while proc.returncode is None:
+                    resp = await retry_transient_errors(
+                        self._stub.SandboxGetStdin,
+                        api_pb2.SandboxGetStdinRequest(sandbox_id=sandbox_id, offset=offset, timeout=5.0),
+                        attempt_timeout=15.0,
+                        max_retries=8,
+                    )
+                    for chunk in resp.chunks:
+                        proc.stdin.write(chunk)
+                        await proc.stdin.drain()
+                    offset = resp.next_offset
+                    if resp.eof:
+                        proc.stdin.close()
+                        return
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # stdin channel lost: close the pipe so readers see EOF
+                # instead of blocking to the sandbox timeout
+                logger.warning(f"sandbox {sandbox_id} stdin pump failed: {exc}")
+                try:
+                    proc.stdin.close()
+                except Exception:
+                    pass
+
+        async def _pump_out(stream, fd: int) -> None:
+            import codecs
+
+            # incremental decoder: a multi-byte UTF-8 char split across 64KB
+            # reads must not become U+FFFD
+            decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
+            while True:
+                data = await stream.read(64 * 1024)
+                text = decoder.decode(data, final=not data)
+                if not data and not text:
+                    return
+                if not text:
+                    continue
+                try:
+                    await self._stub.ContainerLog(
+                        api_pb2.ContainerLogRequest(
+                            task_id=task_id,
+                            logs=[
+                                api_pb2.TaskLogs(
+                                    data=text,
+                                    task_id=task_id,
+                                    file_descriptor=fd,
+                                    timestamp=time.time(),
+                                )
+                            ],
+                        ),
+                        timeout=10.0,
+                    )
+                except Exception:
+                    pass
+                if not data:
+                    return
+
+        stdin_task = asyncio.create_task(_pump_stdin())
+        hb_task = asyncio.create_task(_heartbeat())
+        out_task = asyncio.create_task(_pump_out(proc.stdout, 1))
+        err_task = asyncio.create_task(_pump_out(proc.stderr, 2))
+        timeout_s = d.timeout_secs or 600
+        try:
+            returncode = await asyncio.wait_for(proc.wait(), timeout=timeout_s)
+            if returncode == 0:
+                status = api_pb2.GENERIC_STATUS_SUCCESS
+                exception = ""
+            elif returncode < 0:
+                # killed by signal (terminate/stop event): TERMINATED, so the
+                # client's SandboxTerminatedError contract holds
+                status = api_pb2.GENERIC_STATUS_TERMINATED
+                exception = f"terminated by signal {-returncode}"
+            else:
+                status = api_pb2.GENERIC_STATUS_FAILURE
+                exception = f"exit code {returncode}"
+        except asyncio.TimeoutError:
+            proc.kill()
+            await proc.wait()
+            returncode = -1
+            status = api_pb2.GENERIC_STATUS_TIMEOUT
+            exception = f"sandbox exceeded timeout of {timeout_s}s"
+        finally:
+            self._procs.pop(task_id, None)
+            stdin_task.cancel()
+            hb_task.cancel()
+            await asyncio.gather(stdin_task, hb_task, return_exceptions=True)
+            await asyncio.gather(out_task, err_task, return_exceptions=True)
+        result = api_pb2.GenericResult(status=status, exception=exception)
+        result.data = str(returncode).encode()
+        try:
+            await retry_transient_errors(
+                self._stub.TaskResult,
+                api_pb2.TaskResultRequest(task_id=task_id, result=result),
+                max_retries=3,
+            )
+        except Exception as exc:
+            logger.warning(f"sandbox result report failed: {exc}")
 
     async def _run_task(self, assignment: api_pb2.TaskAssignment) -> None:
         task_id = assignment.task_id
@@ -288,8 +445,13 @@ class WorkerAgent:
     ) -> None:
         """Tail container stdout/stderr into the control plane's app logs
         (client reads them via AppGetLogs)."""
+        import codecs
+
         offsets = {stdout_path: 0, stderr_path: 0}
         fds = {stdout_path: 1, stderr_path: 2}
+        decoders = {
+            path: codecs.getincrementaldecoder("utf-8")(errors="replace") for path in offsets
+        }
         while True:
             sent_any = False
             logs = []
@@ -303,9 +465,12 @@ class WorkerAgent:
                         f.seek(off)
                         data = f.read(64 * 1024)
                     offsets[path] = off + len(data)
+                    text = decoders[path].decode(data)
+                    if not text:
+                        continue
                     logs.append(
                         api_pb2.TaskLogs(
-                            data=data.decode(errors="replace"),
+                            data=text,
                             task_id=task_id,
                             file_descriptor=fds[path],
                             timestamp=time.time(),

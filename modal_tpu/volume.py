@@ -145,10 +145,13 @@ class _Volume(_Object, type_prefix="vo"):
     @live_method_gen
     async def read_file(self, path: str) -> AsyncGenerator[bytes, None]:
         """Stream a file's content block-by-block with parallel prefetch."""
-        resp = await retry_transient_errors(
-            self.client.stub.VolumeGetFile2,
-            api_pb2.VolumeGetFile2Request(volume_id=self.object_id, path=path),
-        )
+        try:
+            resp = await retry_transient_errors(
+                self.client.stub.VolumeGetFile2,
+                api_pb2.VolumeGetFile2Request(volume_id=self.object_id, path=path),
+            )
+        except NotFoundError:
+            raise NotFoundError(f"file {path!r} not found in volume") from None
         if not resp.file.path:
             raise NotFoundError(f"file {path!r} not found in volume")
         blocks = list(resp.file.block_sha256_hex)

@@ -1,0 +1,50 @@
+"""Pallas kernel tests (interpret mode on CPU; compiled on real TPU)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from modal_tpu.ops.attention import flash_attention_pallas
+from modal_tpu.parallel.ring_attention import full_causal_attention
+
+
+@pytest.mark.parametrize("shape", [(2, 256, 4, 64), (1, 128, 2, 32)])
+def test_flash_attention_causal_matches_reference(shape):
+    B, S, H, D = shape
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in jax.random.split(key, 3))
+    ref = full_causal_attention(q, k, v)
+    out = flash_attention_pallas(q, k, v, causal=True, block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3)
+
+
+def test_flash_attention_noncausal():
+    B, S, H, D = 1, 256, 2, 64
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in jax.random.split(key, 3))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    out = flash_attention_pallas(q, k, v, causal=False, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3)
+
+
+def test_flash_attention_bf16():
+    B, S, H, D = 1, 128, 2, 64
+    key = jax.random.PRNGKey(2)
+    q, k, v = (
+        jax.random.normal(kk, (B, S, H, D), jnp.bfloat16) for kk in jax.random.split(key, 3)
+    )
+    ref = full_causal_attention(q, k, v)
+    out = flash_attention_pallas(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32), np.asarray(out, np.float32), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_flash_attention_rejects_nondivisible():
+    q = jnp.zeros((1, 192, 2, 32))  # 192 % 128 != 0 after clamping
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention_pallas(q, q, q, block_q=128, block_k=128, interpret=True)

@@ -1,0 +1,58 @@
+"""Sandbox e2e: create/wait/IO/stdin/terminate through the worker."""
+
+import time
+
+import pytest
+
+
+def test_sandbox_run_and_streams(supervisor):
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create(
+        "python", "-c", "print('out line'); import sys; print('err line', file=sys.stderr)"
+    )
+    assert sb.wait() == 0
+    assert sb.stdout.read() == "out line\n"
+    assert sb.stderr.read() == "err line\n"
+
+
+def test_sandbox_stdin(supervisor):
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create("cat")
+    sb.stdin.write(b"hello stdin\n")
+    sb.stdin.write_eof()
+    sb.stdin.drain()
+    assert sb.wait() == 0
+    assert sb.stdout.read() == "hello stdin\n"
+
+
+def test_sandbox_exit_code_and_poll(supervisor):
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create("python", "-c", "import sys; sys.exit(5)")
+    assert sb.wait(raise_on_termination=False) == 5
+    assert sb.poll() == 5
+
+
+def test_sandbox_terminate(supervisor):
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create("sleep", "30")
+    time.sleep(0.3)
+    assert sb.poll() is None
+    sb.terminate()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if sb.poll() is not None:
+            break
+        time.sleep(0.2)
+    assert sb.poll() is not None
+
+
+def test_sandbox_bad_command(supervisor):
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create("/no/such/binary")
+    rc = sb.wait(raise_on_termination=False)
+    assert rc != 0
