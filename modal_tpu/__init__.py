@@ -37,6 +37,9 @@ from .retries import Retries
 from .runtime.clustered import ClusterInfo, get_cluster_info, get_fabric_peers
 from .runtime.execution_context import current_function_call_id, current_input_id, is_local
 from .schedule import Cron, Period, SchedulerPlacement
+from .mount import Mount, _Mount
+from .network_file_system import NetworkFileSystem
+from .cloud_bucket_mount import CloudBucketMount
 from .secret import Secret, _Secret
 from .tpu_config import TPUSliceSpec, parse_tpu_config
 from .volume import Volume, _Volume
@@ -54,6 +57,9 @@ __all__ = [
     "Function",
     "FunctionCall",
     "Image",
+    "Mount",
+    "NetworkFileSystem",
+    "CloudBucketMount",
     "Period",
     "Queue",
     "Retries",

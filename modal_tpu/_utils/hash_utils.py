@@ -82,3 +82,16 @@ def iter_file_blocks(data: BinaryIO, block_size: int = BLOCK_SIZE):
             return
         yield idx, offset, block
         idx += 1
+
+
+def get_blocks_sha256(data: bytes, block_size: int = BLOCK_SIZE) -> list[str]:
+    """Per-block sha256 hex digests. Uses the native multithreaded hasher
+    when MODAL_TPU_NATIVE_HASH=1 (useful on many-core workers); defaults to
+    hashlib, which wins single-threaded via OpenSSL SHA extensions."""
+    import os
+
+    from .._native import hash_blocks, hashlib_blocks, native_available
+
+    if os.environ.get("MODAL_TPU_NATIVE_HASH") == "1" and native_available():
+        return hash_blocks(data, block_size)
+    return hashlib_blocks(data, block_size)

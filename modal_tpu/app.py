@@ -162,7 +162,8 @@ class _App:
         image: Optional[_Image] = None,
         schedule: Optional[Schedule] = None,
         secrets: Sequence[_Secret] = (),
-        volumes: dict[str, _Volume] = {},
+        volumes: dict[str, Any] = {},
+        mounts: Sequence[Any] = (),
         tpu: Optional[str] = None,
         mesh: Optional[dict[str, int]] = None,
         cpu: Optional[float] = None,
@@ -212,6 +213,7 @@ class _App:
                 image=image or self._image or _get_default_image(),
                 secrets=[*self._secrets, *secrets],
                 volumes={**self._volumes, **volumes},
+                mounts=list(mounts),
                 tpu=parse_tpu_config(params.tpu_slice or tpu, mesh),
                 cpu=cpu,
                 memory=memory,

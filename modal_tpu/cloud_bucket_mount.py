@@ -1,0 +1,46 @@
+"""CloudBucketMount: mount an S3/R2/GCS bucket into containers.
+
+Reference: py/modal/cloud_bucket_mount.py `_CloudBucketMount` (a descriptor —
+the worker performs the actual mount). The TPU build's north star streams
+bucket checkpoints to HBM the same way Volume blocks stream; the local
+backend treats the mount as a descriptor and surfaces a clear error if a
+container actually dereferences it (no bucket credentials in this
+environment)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .secret import _Secret
+
+
+@dataclass
+class CloudBucketMount:
+    """Descriptor for mounting a cloud bucket at a container path."""
+
+    bucket_name: str
+    bucket_endpoint_url: Optional[str] = None  # None = AWS S3; set for R2/GCS interop
+    key_prefix: Optional[str] = None
+    secret: Optional[_Secret] = None
+    oidc_auth_role_arn: Optional[str] = None
+    read_only: bool = False
+    requester_pays: bool = False
+
+    def __post_init__(self) -> None:
+        if self.key_prefix and not self.key_prefix.endswith("/"):
+            raise ValueError("key_prefix must end with '/'")
+        if self.requester_pays and self.secret is None:
+            raise ValueError("requester_pays requires a secret with credentials")
+
+    def serialize(self) -> str:
+        return json.dumps(
+            {
+                "bucket_name": self.bucket_name,
+                "bucket_endpoint_url": self.bucket_endpoint_url,
+                "key_prefix": self.key_prefix,
+                "read_only": self.read_only,
+                "requester_pays": self.requester_pays,
+            }
+        )

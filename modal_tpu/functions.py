@@ -56,7 +56,9 @@ class _FunctionSpec:
 
     image: Optional["_Image"] = None
     secrets: Sequence["_Secret"] = field(default_factory=list)
-    volumes: dict[str, "_Volume"] = field(default_factory=dict)
+    # values: _Volume or CloudBucketMount descriptors
+    volumes: dict[str, Any] = field(default_factory=dict)
+    mounts: Sequence[Any] = field(default_factory=list)
     tpu: Optional[TPUSliceSpec] = None
     cpu: Optional[float] = None
     memory: Optional[int] = None
@@ -156,7 +158,8 @@ class _Function(_Object, type_prefix="fu"):
             if spec.image is not None:
                 deps.append(spec.image)
             deps.extend(spec.secrets)
-            deps.extend(spec.volumes.values())
+            deps.extend(v for v in spec.volumes.values() if isinstance(v, _Object))
+            deps.extend(m for m in spec.mounts if isinstance(m, _Object))
             return deps
 
         async def _load(self: "_Function", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
@@ -221,8 +224,14 @@ class _Function(_Object, type_prefix="fu"):
             if spec.image is not None:
                 f_def.image_id = spec.image.object_id
             f_def.secret_ids.extend([s.object_id for s in spec.secrets])
+            f_def.mount_ids.extend([m.object_id for m in spec.mounts if isinstance(m, _Object)])
+            from .cloud_bucket_mount import CloudBucketMount
+
             for path, vol in spec.volumes.items():
-                f_def.volume_mounts[path] = vol.object_id
+                if isinstance(vol, CloudBucketMount):
+                    f_def.cloud_bucket_mounts[path] = vol.serialize()
+                else:
+                    f_def.volume_mounts[path] = vol.object_id
 
             req = api_pb2.FunctionCreateRequest(
                 app_id=context.app_id or "",

@@ -89,7 +89,10 @@ class _Object:
         super().__init_subclass__()
         if type_prefix is not None:
             cls._type_prefix = type_prefix
-            cls._prefix_to_type[type_prefix] = cls
+            # first registration wins: alias subclasses (e.g. NetworkFileSystem
+            # over Volume's "vo" prefix) must not hijack deserialization of
+            # the base type
+            cls._prefix_to_type.setdefault(type_prefix, cls)
 
     def __init__(self, *args: Any, **kwargs: Any):
         raise InvalidError(f"Class {type(self).__name__} has no constructor. Use class constructor methods instead.")

@@ -213,9 +213,12 @@ async def main_async() -> int:
 
         await run_input_loop(service, io)
     except BaseException as exc:
-        if isinstance(exc, (KeyboardInterrupt,)):
+        if isinstance(exc, (KeyboardInterrupt, asyncio.CancelledError)):
+            # SIGTERM from the worker (app stop / drain): graceful shutdown —
+            # fall through so @exit hooks + TaskResult still run before the
+            # worker escalates to SIGKILL.
             exit_status = api_pb2.GENERIC_STATUS_TERMINATED
-            exit_exception = "interrupted"
+            exit_exception = "terminated"
         else:
             exit_status = api_pb2.GENERIC_STATUS_FAILURE
             exit_exception = f"{type(exc).__name__}: {exc}"
@@ -253,16 +256,47 @@ async def main_async() -> int:
         except asyncio.CancelledError:
             pass
         await client._close()
-    return 0 if exit_status == api_pb2.GENERIC_STATUS_SUCCESS else 1
+    # graceful drain (TERMINATED) is an expected shutdown: exit 0 so the
+    # worker doesn't classify it as a container failure
+    return 0 if exit_status in (api_pb2.GENERIC_STATUS_SUCCESS, api_pb2.GENERIC_STATUS_TERMINATED) else 1
 
 
 def main() -> None:
     # Run the entrypoint's async main on the synchronizer loop: all SDK
     # coroutines (which the dual-surface wrappers pin to that loop) then run
     # natively, and grpc channels stay loop-affine.
+    #
+    # SIGTERM (worker stop event) cancels the main task instead of killing
+    # the process, so @exit hooks, volume auto-commit, and TaskResult run
+    # before the worker's SIGKILL escalation.
+    import signal
+
     from .._utils.async_utils import synchronizer
 
-    sys.exit(synchronizer.run(main_async()))
+    loop = synchronizer._ensure_loop()
+    task_holder: dict = {}
+    term_requested = {"flag": False}
+
+    def _handle_term(signum, frame):
+        term_requested["flag"] = True
+        task = task_holder.get("task")
+        if task is not None:
+            loop.call_soon_threadsafe(task.cancel)
+
+    signal.signal(signal.SIGTERM, _handle_term)
+
+    async def _runner() -> int:
+        task = asyncio.ensure_future(main_async())
+        task_holder["task"] = task
+        if term_requested["flag"]:
+            # SIGTERM landed before the task was registered: honor it now
+            task.cancel()
+        try:
+            return await task
+        except asyncio.CancelledError:
+            return 0  # graceful termination already reported via TaskResult
+
+    sys.exit(synchronizer.run(_runner()))
 
 
 if __name__ == "__main__":

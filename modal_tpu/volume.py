@@ -246,11 +246,21 @@ class _VolumeUploadContextManager:
         files: list[api_pb2.VolumeFile] = []
         block_data: dict[str, tuple] = {}  # sha -> (source, offset, length)
 
+        from ._utils.hash_utils import get_blocks_sha256
+
         for remote_path, src in self._entries:
             if isinstance(src, bytes):
                 size = len(src)
                 mode = 0o644
                 reader = lambda off, ln, s=src: s[off : off + ln]
+                # hot path (checkpoint put_data): hash all blocks in one call
+                shas = get_blocks_sha256(src, BLOCK_SIZE)
+                for i, sha in enumerate(shas):
+                    block_data[sha] = (reader, i * BLOCK_SIZE, min(BLOCK_SIZE, max(0, size - i * BLOCK_SIZE)))
+                files.append(
+                    api_pb2.VolumeFile(path=remote_path.lstrip("/"), size=size, mode=mode, block_sha256_hex=shas)
+                )
+                continue
             else:
                 path = Path(src) if isinstance(src, (str, Path)) else None
                 if path is not None:

@@ -1,0 +1,144 @@
+// Native block hasher for the modal_tpu content-addressed store.
+//
+// Volume/blob uploads hash every 8 MiB block (volume v2 block dedup); at
+// 70B-checkpoint scale that is hundreds of GiB of SHA-256. This library
+// hashes a buffer's blocks in parallel with std::thread and exposes a flat C
+// ABI consumed via ctypes (no pybind11 in the image). SHA-256 implemented
+// from the FIPS 180-4 spec.
+//
+// Build: g++ -O3 -shared -fPIC -pthread -o _blockhash.so blockhash.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+struct Sha256Ctx {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint64_t total = 0;
+  uint8_t buf[64];
+  size_t buflen = 0;
+
+  void compress(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    if (buflen) {
+      size_t need = 64 - buflen;
+      size_t take = std::min(need, len);
+      std::memcpy(buf + buflen, data, take);
+      buflen += take; data += take; len -= take;
+      if (buflen == 64) { compress(buf); buflen = 0; }
+    }
+    while (len >= 64) { compress(data); data += 64; len -= 64; }
+    if (len) { std::memcpy(buf, data, len); buflen = len; }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buflen != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  Sha256Ctx ctx;
+  ctx.update(data, len);
+  ctx.final(out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Hash `len` bytes as consecutive `block_size` blocks; writes 32 bytes per
+// block into `out` (ceil(len/block_size) * 32 bytes; len==0 -> one hash of
+// the empty block). Parallel across `n_threads` (0 = hardware concurrency).
+void mtpu_hash_blocks(const uint8_t* data, uint64_t len, uint64_t block_size,
+                      uint8_t* out, int n_threads) {
+  if (block_size == 0) return;
+  uint64_t n_blocks = len == 0 ? 1 : (len + block_size - 1) / block_size;
+  if (n_threads <= 0) n_threads = (int)std::thread::hardware_concurrency();
+  n_threads = std::max(1, std::min<int>(n_threads, (int)n_blocks));
+
+  auto worker = [&](uint64_t start, uint64_t end) {
+    for (uint64_t b = start; b < end; b++) {
+      uint64_t off = b * block_size;
+      uint64_t blen = (off >= len) ? 0 : std::min<uint64_t>(block_size, len - off);
+      sha256(data + off, blen, out + b * 32);
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n_blocks);
+    return;
+  }
+  std::vector<std::thread> threads;
+  uint64_t per = (n_blocks + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    uint64_t start = t * per;
+    uint64_t end = std::min(n_blocks, start + per);
+    if (start >= end) break;
+    threads.emplace_back(worker, start, end);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Single-shot sha256 (for parity checks).
+void mtpu_sha256(const uint8_t* data, uint64_t len, uint8_t* out) {
+  sha256(data, len, out);
+}
+}

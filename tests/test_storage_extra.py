@@ -1,0 +1,67 @@
+"""Mount, NFS, CloudBucketMount, native hasher."""
+
+import hashlib
+import os
+
+import pytest
+
+
+def test_mount_dedup_and_create(supervisor, tmp_path):
+    import modal_tpu
+
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_text("A")
+    (src / "sub" / "b.txt").write_text("B")
+
+    m = modal_tpu.Mount.from_local_dir(str(src), remote_path="/app")
+    m.hydrate()
+    assert m.object_id.startswith("mo-")
+    # same content → second create reuses stored blocks (no error, new id)
+    m2 = modal_tpu.Mount.from_local_file(str(src / "a.txt"))
+    m2.hydrate()
+    assert m2.object_id.startswith("mo-")
+
+
+def test_network_file_system(supervisor):
+    import modal_tpu
+
+    nfs = modal_tpu.NetworkFileSystem.from_name("shared", create_if_missing=True)
+    nfs.hydrate()
+    with nfs.batch_upload() as b:
+        b.put_data(b"legacy data", "f.txt")
+    files = nfs.listdir("/")
+    assert [f.path for f in files] == ["f.txt"]
+
+
+def test_cloud_bucket_mount_validation():
+    import modal_tpu
+
+    cbm = modal_tpu.CloudBucketMount("bucket", key_prefix="p/")
+    assert "bucket" in cbm.serialize()
+    with pytest.raises(ValueError, match="end with"):
+        modal_tpu.CloudBucketMount("bucket", key_prefix="nope")
+    with pytest.raises(ValueError, match="requester_pays"):
+        modal_tpu.CloudBucketMount("bucket", requester_pays=True)
+
+
+def test_native_hasher_parity():
+    from modal_tpu._native import hash_blocks, native_available, sha256_hex
+
+    data = os.urandom(1024 * 1024 + 7)
+    bs = 256 * 1024
+    expected = [
+        hashlib.sha256(data[i : i + bs]).hexdigest() for i in range(0, len(data), bs)
+    ]
+    assert hash_blocks(data, bs) == expected
+    assert sha256_hex(b"hello") == hashlib.sha256(b"hello").hexdigest()
+    assert hash_blocks(b"", bs) == [hashlib.sha256(b"").hexdigest()]
+
+
+def test_get_blocks_sha256_flag(monkeypatch):
+    from modal_tpu._utils.hash_utils import get_blocks_sha256
+
+    data = os.urandom(100_000)
+    base = get_blocks_sha256(data, 32768)
+    monkeypatch.setenv("MODAL_TPU_NATIVE_HASH", "1")
+    assert get_blocks_sha256(data, 32768) == base
