@@ -16,6 +16,7 @@ is what `modal run` uses for the judged configs.
 
 from __future__ import annotations
 
+import asyncio
 import io
 import json
 from typing import Any, Optional
@@ -55,29 +56,79 @@ class _VolumeCheckpointer:
     def __init__(self, volume: _Volume):
         self._volume = volume
 
-    async def save(self, path: str, tree: Any, *, commit: bool = True) -> dict:
-        """Write every leaf + manifest; only changed blocks upload (dedup)."""
+    async def save(
+        self, path: str, tree: Any, *, commit: bool = True, shard_leaves_over: Optional[int] = None
+    ) -> dict:
+        """Write every leaf + manifest; only changed blocks upload (dedup).
+
+        Multihost-safe: every process writes only the shards it owns
+        (process-spanning leaves take the per-shard format), then all
+        processes barrier BEFORE process 0 publishes manifest.json — so a
+        visible manifest always implies every shard file has landed (no torn
+        checkpoints)."""
         import jax
 
         path = path.strip("/")
         flat = _tree_flatten_with_paths(tree)
         treedef = jax.tree_util.tree_structure(tree)
+        is_writer = jax.process_count() == 1 or jax.process_index() == 0
         manifest = {"format": 1, "treedef": str(treedef), "leaves": []}
         async with self._volume.batch_upload(force=True) as batch:
             for i, (leaf_path, leaf) in enumerate(flat):
-                arr = np.asarray(leaf)
-                manifest["leaves"].append(
-                    {
-                        "index": i,
-                        "path": leaf_path,
-                        "shape": list(arr.shape),
-                        "dtype": _dtype_str(arr.dtype),
-                        "nbytes": int(arr.nbytes),
+                if _use_shard_format(leaf, shard_leaves_over):
+                    # Sharded across processes: every process writes ONLY the
+                    # shards whose replica-0 copy it holds — no host ever
+                    # materializes the global array (SURVEY §7 hard part 6).
+                    # The shard table is derived from the sharding, which is
+                    # identical on every process, so rank 0's manifest covers
+                    # shards written by all ranks.
+                    table = _shard_table(leaf.sharding, leaf.shape)
+                    written: set = set()
+                    for sh in leaf.addressable_shards:
+                        if sh.replica_id != 0:
+                            continue
+                        start = tuple(int(sl.start or 0) for sl in sh.index)
+                        if start in written:
+                            continue
+                        written.add(start)
+                        arr = np.asarray(sh.data)
+                        batch.put_data(_to_bytes(arr), f"{path}/{_shard_file(i, start)}")
+                    np_dt = np.dtype(leaf.dtype)
+                    meta = {
+                        "shape": list(leaf.shape),
+                        "dtype": _dtype_str(np_dt),
+                        "nbytes": int(np.prod(leaf.shape or (1,))) * np_dt.itemsize,
+                        "shards": [
+                            {"file": _shard_file(i, start), "start": list(start), "shape": list(shp)}
+                            for start, shp in table
+                        ],
                     }
-                )
-                batch.put_data(_to_bytes(arr), f"{path}/leaves/{i}.bin")
-            batch.put_data(json.dumps(manifest).encode(), f"{path}/manifest.json")
-        if commit:
+                    manifest["leaves"].append({"index": i, "path": leaf_path, **meta})
+                    continue
+                if is_writer:
+                    arr = np.asarray(leaf)
+                    meta = {"shape": list(arr.shape), "dtype": _dtype_str(arr.dtype), "nbytes": int(arr.nbytes)}
+                    batch.put_data(_to_bytes(arr), f"{path}/leaves/{i}.bin")
+                else:
+                    # non-writers skip the device→host copy
+                    a = leaf if hasattr(leaf, "shape") else np.asarray(leaf)
+                    np_dt = np.dtype(a.dtype)
+                    meta = {
+                        "shape": list(a.shape),
+                        "dtype": _dtype_str(np_dt),
+                        "nbytes": int(np.prod(a.shape or (1,))) * np_dt.itemsize,
+                    }
+                manifest["leaves"].append({"index": i, "path": leaf_path, **meta})
+        # barrier: every process's shard uploads must be flushed (the batch
+        # context above awaits them) before the manifest becomes visible
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"modal_tpu_ckpt_save:{path}")
+        if is_writer:
+            async with self._volume.batch_upload(force=True) as batch:
+                batch.put_data(json.dumps(manifest).encode(), f"{path}/manifest.json")
+        if commit and is_writer:
             await self._volume.commit()
         logger.debug(f"checkpoint saved: {path} ({len(flat)} leaves)")
         return manifest
@@ -86,12 +137,23 @@ class _VolumeCheckpointer:
         self,
         path: str,
         *,
+        example_tree: Optional[Any] = None,
         shardings: Optional[Any] = None,
         dtype: Optional[Any] = None,
     ) -> Any:
         """Stream leaves back; each leaf goes straight to device via
         `jax.device_put` (with its target sharding when `shardings` — a
-        matching pytree or a callable leaf_path->sharding — is given)."""
+        matching pytree or a callable leaf_path->sharding — is given).
+
+        With `example_tree` (an abstract or concrete pytree of the saved
+        structure, e.g. `jax.eval_shape` of TrainState), the result is
+        rebuilt with the ORIGINAL treedef — NamedTuples (TrainState, KVCache)
+        and optax opt_state round-trip exactly. Without it, the tree comes
+        back as nested dicts/lists keyed by path.
+
+        Multihost-safe: shardings spanning processes go through
+        `jax.make_array_from_callback` (each process materializes only its
+        addressable shards)."""
         import jax
 
         path = path.strip("/")
@@ -105,27 +167,90 @@ class _VolumeCheckpointer:
 
         leaves = []
         for meta in manifest["leaves"]:
-            raw = io.BytesIO()
-            await self._volume.read_file_into(f"{path}/leaves/{meta['index']}.bin", raw)
-            arr = _from_bytes(raw.getvalue(), meta)
-            if dtype is not None:
-                arr = arr.astype(_np_dtype(dtype))
             if callable(shardings):
                 sharding = shardings(meta["path"])
             elif shard_list is not None:
                 sharding = shard_list[meta["index"]]
             else:
                 sharding = None
-            if sharding is not None:
+            if "shards" in meta:
+                leaves.append(await self._restore_sharded_leaf(path, meta, sharding, dtype))
+                continue
+            raw = io.BytesIO()
+            await self._volume.read_file_into(f"{path}/leaves/{meta['index']}.bin", raw)
+            arr = _from_bytes(raw.getvalue(), meta)
+            if dtype is not None:
+                arr = arr.astype(_np_dtype(dtype))
+            if sharding is None:
+                leaves.append(jax.device_put(arr))
+            elif getattr(sharding, "is_fully_addressable", True):
                 leaves.append(jax.device_put(arr, sharding))
             else:
-                leaves.append(jax.device_put(arr))
+                leaves.append(
+                    jax.make_array_from_callback(arr.shape, sharding, lambda idx, a=arr: a[idx])
+                )
             del arr, raw  # host buffer freed before the next leaf streams
-        # rebuild via example tree if treedef strings match is brittle;
-        # instead rebuild from manifest paths into nested dicts/lists
-        return _unflatten_from_paths(
-            [(m["path"], leaf) for m, leaf in zip(manifest["leaves"], leaves)]
-        )
+        pairs = [(m["path"], leaf) for m, leaf in zip(manifest["leaves"], leaves)]
+        if example_tree is not None:
+            treedef = jax.tree_util.tree_structure(example_tree)
+            expected_paths = [p for p, _ in _tree_flatten_with_paths(example_tree)]
+            by_path = dict(pairs)
+            try:
+                ordered = [by_path[p] for p in expected_paths]
+            except KeyError as exc:
+                raise ValueError(
+                    f"checkpoint at {path!r} has no leaf {exc.args[0]!r} required "
+                    f"by example_tree (saved leaves: {sorted(by_path)[:5]}...)"
+                ) from None
+            return jax.tree_util.tree_unflatten(treedef, ordered)
+        return _unflatten_from_paths(pairs)
+
+    async def _restore_sharded_leaf(
+        self, path: str, meta: dict, sharding: Optional[Any], dtype: Optional[Any]
+    ) -> Any:
+        """Restore a leaf saved in per-shard format: read (in parallel) only
+        the shard files overlapping the indices THIS process needs for the
+        target sharding, then assemble per-device pieces — no host ever holds
+        the global array unless restoring unsharded."""
+        import jax
+
+        shape = tuple(meta["shape"])
+        if sharding is not None:
+            needed = list(sharding.addressable_devices_indices_map(shape).values())
+        else:
+            needed = [tuple(slice(0, d) for d in shape)]
+        pieces = await self._read_leaf_shards(path, meta, needed)
+        np_dt = _np_dtype(dtype) if dtype is not None else None
+
+        def assemble(idx):
+            arr = _assemble_index(idx, pieces, shape, _np_dtype(meta["dtype"]))
+            return arr.astype(np_dt) if np_dt is not None else arr
+
+        if sharding is None:
+            return jax.device_put(assemble(needed[0]))
+        return jax.make_array_from_callback(shape, sharding, assemble)
+
+    async def _read_leaf_shards(
+        self, path: str, meta: dict, needed: list
+    ) -> list[tuple[tuple, np.ndarray]]:
+        """Fetch shard files overlapping any needed index, 8-way parallel
+        (VERDICT r1: restore must not stream one read at a time)."""
+        shape = tuple(meta["shape"])
+        to_read = [
+            entry
+            for entry in meta["shards"]
+            if any(_overlaps(tuple(entry["start"]), tuple(entry["shape"]), idx, shape) for idx in needed)
+        ]
+        sem = asyncio.Semaphore(8)
+
+        async def _read(entry: dict) -> tuple[tuple, np.ndarray]:
+            async with sem:
+                raw = io.BytesIO()
+                await self._volume.read_file_into(f"{path}/{entry['file']}", raw)
+                arr = _from_bytes(raw.getvalue(), {"shape": entry["shape"], "dtype": meta["dtype"]})
+                return tuple(entry["start"]), arr
+
+        return list(await asyncio.gather(*[_read(e) for e in to_read]))
 
     async def exists(self, path: str) -> bool:
         from .exception import NotFoundError
@@ -136,6 +261,78 @@ class _VolumeCheckpointer:
             return True
         except NotFoundError:
             return False
+
+
+def _use_shard_format(leaf: Any, shard_leaves_over: Optional[int]) -> bool:
+    """Per-shard format for (a) process-spanning arrays — mandatory, no host
+    can hold the global value — and (b) optionally, large single-host sharded
+    arrays (skips the full device→host gather on save)."""
+    import jax
+
+    if not isinstance(leaf, jax.Array) or not hasattr(leaf, "sharding"):
+        return False
+    if not leaf.is_fully_addressable:
+        return True
+    if shard_leaves_over is None or leaf.nbytes <= shard_leaves_over:
+        return False
+    try:
+        return len(_shard_table(leaf.sharding, leaf.shape)) > 1
+    except Exception:
+        return False
+
+
+def _shard_file(leaf_index: int, start: tuple) -> str:
+    return f"leaves/{leaf_index}.s{'_'.join(map(str, start)) or 'scalar'}.bin"
+
+
+def _shard_table(sharding: Any, shape: tuple) -> list[tuple[tuple, tuple]]:
+    """Unique (start, shard_shape) pairs covering the global array — derived
+    from the sharding alone, so every process computes the identical table."""
+    table: dict[tuple, tuple] = {}
+    for idx in sharding.devices_indices_map(shape).values():
+        start = tuple(int(sl.start or 0) for sl in idx)
+        shard_shape = tuple(
+            int((sl.stop if sl.stop is not None else dim) - (sl.start or 0))
+            for sl, dim in zip(idx, shape)
+        )
+        table[start] = shard_shape
+    return sorted(table.items())
+
+
+def _norm_index(idx: tuple, shape: tuple) -> list[tuple[int, int]]:
+    """Index tuple of slices → [(start, stop)] per dim."""
+    return [
+        (int(sl.start or 0), int(sl.stop if sl.stop is not None else dim))
+        for sl, dim in zip(idx, shape)
+    ]
+
+
+def _overlaps(s_start: tuple, s_shape: tuple, idx: tuple, shape: tuple) -> bool:
+    bounds = _norm_index(idx, shape)
+    for (a0, alen), (b0, b1) in zip(zip(s_start, s_shape), bounds):
+        if a0 + alen <= b0 or b1 <= a0:
+            return False
+    return True
+
+
+def _assemble_index(
+    idx: tuple, pieces: list[tuple[tuple, np.ndarray]], shape: tuple, np_dt: Any
+) -> np.ndarray:
+    """Build the sub-array for `idx` (tuple of slices into the global shape)
+    by copying the overlapping regions out of the saved shards."""
+    bounds = _norm_index(idx, shape)
+    out_shape = tuple(b1 - b0 for b0, b1 in bounds)
+    out = np.empty(out_shape, np_dt)
+    for start, arr in pieces:
+        if not _overlaps(start, arr.shape, idx, shape):
+            continue
+        src_sel, dst_sel = [], []
+        for (a0, alen), (b0, b1) in zip(zip(start, arr.shape), bounds):
+            lo, hi = max(a0, b0), min(a0 + alen, b1)
+            src_sel.append(slice(lo - a0, hi - a0))
+            dst_sel.append(slice(lo - b0, hi - b0))
+        out[tuple(dst_sel)] = arr[tuple(src_sel)]
+    return out
 
 
 def _dtype_str(dt: np.dtype) -> str:

@@ -171,13 +171,24 @@ def attention(
     q: jax.Array,  # [B, Sq, H, hd]
     k: jax.Array,  # [B, Sk, H, hd]
     v: jax.Array,  # [B, Sk, H, hd]
-    mask: jax.Array,  # [B, 1, Sq, Sk] additive (0 / -inf)
+    mask: Optional[jax.Array] = None,  # [B, 1, Sq, Sk] additive (0 / -inf)
 ) -> jax.Array:
     """Reference attention: einsum QK^T → softmax(fp32) → V. The pallas
     flash-attention kernel in ops/attention.py replaces this on TPU for long
-    sequences (same signature)."""
+    sequences (same signature).
+
+    attn_impl contract (shared by flash/ring implementations): `mask=None`
+    means pure causal attention with q and k aligned at position 0 — only
+    valid when Sq == Sk; KV-cache calls must pass an explicit mask."""
     hd = q.shape[-1]
     scale = 1.0 / math.sqrt(hd)
+    if mask is None:
+        if q.shape[1] != k.shape[1]:
+            raise ValueError(
+                f"mask=None implies aligned causal attention but Sq={q.shape[1]} != Sk={k.shape[1]}"
+            )
+        causal = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), jnp.bool_))
+        mask = jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None, :, :]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
@@ -207,7 +218,7 @@ def _layer_forward(
     x: jax.Array,  # [B, S, D]
     layer: dict,
     positions: jax.Array,  # [B, S]
-    mask: jax.Array,  # [B, 1, S, Sk]
+    mask: Optional[jax.Array],  # [B, 1, S, Sk] additive, or None = causal
     inv_freq: jax.Array,
     cache_kv: Optional[tuple[jax.Array, jax.Array]],  # ([B, max, n_kv, hd], ...)
     cache_offset: Optional[jax.Array],
@@ -250,6 +261,7 @@ def forward(
     positions: Optional[jax.Array] = None,  # [B, S]
     cache: Optional[KVCache] = None,
     attn_impl: Optional[Any] = None,  # e.g. ring attention for seq-parallel training
+    remat: bool = False,  # checkpoint the layer scan body (per-layer remat)
 ) -> tuple[jax.Array, Optional[KVCache]]:
     """Full forward pass. Without cache: causal training/prefill forward.
     With cache: writes K/V at cache.length and attends over the cache
@@ -263,15 +275,21 @@ def forward(
     inv_freq = rope_frequencies(cfg)
 
     if cache is None:
-        causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
-        mask = jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None, :, :]
-
+        # mask=None = "pure causal, 0-aligned" per the attn_impl contract:
+        # lets flash/ring impls use their internal causal masking (the pallas
+        # kernel never materializes the [S, S] mask in HBM)
         def body(x_carry, layer):
             x_out, _ = _layer_forward(
-                cfg, x_carry, layer, positions, mask, inv_freq, None, None, attn_impl
+                cfg, x_carry, layer, positions, None, inv_freq, None, None, attn_impl
             )
             return x_out, None
 
+        if remat:
+            # Checkpoint the scan BODY, not the whole forward: the backward
+            # pass then recomputes one layer at a time from the inter-layer
+            # carries, so peak residency is one layer's activations instead of
+            # all n_layers at once.
+            body = jax.checkpoint(body, prevent_cse=False)
         x, _ = lax.scan(body, x, params["layers"])
         new_cache = None
     else:

@@ -120,16 +120,24 @@ def flash_attention_pallas(
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
-    """Drop-in for models.llama.attention: pallas on TPU, einsum elsewhere.
-    `mask` is ignored — causal masking is built into the kernel (use only for
-    training/prefill paths)."""
+    """Drop-in for models.llama.attention (same attn_impl contract:
+    `mask=None` = pure causal, q/k aligned at position 0, requires Sq == Sk).
+    Pallas kernel on TPU for block-aligned causal calls; einsum elsewhere.
+    KV-cache/chunked-prefill calls must pass an explicit mask and take the
+    einsum path — the kernel assumes 0-aligned positions."""
+    if mask is None and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"mask=None implies aligned causal attention but Sq={q.shape[1]} != Sk={k.shape[1]}; "
+            "pass the cache visibility mask for cached/chunked calls"
+        )
     platform = q.devices().pop().platform if hasattr(q, "devices") else jax.default_backend()
-    if platform == "tpu" and q.shape[1] >= DEFAULT_BLOCK_Q and q.shape[1] % DEFAULT_BLOCK_Q == 0:
+    if (
+        platform == "tpu"
+        and mask is None
+        and q.shape[1] >= DEFAULT_BLOCK_Q
+        and q.shape[1] % DEFAULT_BLOCK_Q == 0
+    ):
         return flash_attention_pallas(q, k, v, causal=True)
     from ..models.llama import attention as einsum_attention
 
-    if mask is None:
-        s = q.shape[1]
-        causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
-        mask = jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None, :, :]
     return einsum_attention(q, k, v, mask)

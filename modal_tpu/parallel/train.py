@@ -57,21 +57,16 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
 def loss_fn(
     params: dict, cfg: LlamaConfig, tokens: jax.Array, remat: bool, attn_impl: Optional[Callable] = None
 ) -> jax.Array:
-    def _loss(p, t):
-        # forward over the full (evenly sharded) sequence, then shift for
-        # next-token loss — keeps S divisible for sequence parallelism
-        from ..models.llama import forward
+    # forward over the full (evenly sharded) sequence, then shift for
+    # next-token loss — keeps S divisible for sequence parallelism.
+    # remat is applied inside forward() to the layer-scan body (true
+    # per-layer checkpointing: one layer's residuals live at a time).
+    from ..models.llama import forward
 
-        logits, _ = forward(p, cfg, t, attn_impl=attn_impl)
-        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-        nll = -jnp.take_along_axis(logp, t[:, 1:, None], axis=-1)[..., 0]
-        return jnp.mean(nll)
-
-    if remat:
-        # rematerialize the whole forward under grad — with the layer scan,
-        # this is effectively per-layer checkpointing
-        return jax.checkpoint(_loss)(params, tokens)
-    return _loss(params, tokens)
+    logits, _ = forward(params, cfg, tokens, attn_impl=attn_impl, remat=remat)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)[..., 0]
+    return jnp.mean(nll)
 
 
 def make_train_step(

@@ -199,10 +199,25 @@ class Scheduler:
         for r, w in enumerate(chosen):
             task = await self._launch_task(fn, cluster=cluster, rank=r, worker=w)
             if task is None:
-                # rollback: tear down partial gang
+                # rollback: tear down partial gang — stop already-launched
+                # containers and release their chips immediately (mirrors
+                # reap_dead_tasks) so capacity isn't stuck until the
+                # TaskClusterHello rendezvous times out
                 for tid in cluster.task_ids:
                     t = self.s.tasks[tid]
                     t.terminate = True
+                    t.state = api_pb2.TASK_STATE_FAILED
+                    t.finished_at = time.time()
+                    w = self.s.workers.get(t.worker_id)
+                    if w is not None:
+                        await w.events.put(
+                            api_pb2.WorkerPollResponse(
+                                stop=api_pb2.TaskStopEvent(task_id=tid, force=True)
+                            )
+                        )
+                    if self.servicer is not None:
+                        self.servicer._release_task(t)
+                del self.s.clusters[cluster.cluster_id]
                 logger.warning(f"gang allocation failed for {fn.tag}; rolled back")
                 return
             cluster.task_ids.append(task.task_id)

@@ -784,33 +784,54 @@ class ModalTPUServicer:
                         await worker.events.put(
                             api_pb2.WorkerPollResponse(stop=api_pb2.TaskStopEvent(task_id=peer_id))
                         )
+        dead_ids = gang_tasks | {task.task_id}
         for inp in self.s.inputs.values():
-            claimed_by_gang = inp.claimed_by == task.task_id or (
-                gang_tasks and (inp.claimed_by in gang_tasks or task.task_id in inp.delivered_to)
+            # A partially-delivered broadcast input (status stays "pending"
+            # until every rank fetches it) counts as touched by the dead gang
+            # the same as a fully-claimed one: both consume a retry, so a
+            # crash-inducing input can't loop forever through redelivery.
+            touched_pending = inp.status == "pending" and bool(
+                inp.delivered_to & dead_ids or (inp.claimed_by and inp.claimed_by in dead_ids)
             )
-            if claimed_by_gang and inp.status == "claimed":
-                call = self.s.function_calls.get(inp.function_call_id)
-                fn = self.s.functions.get(task.function_id)
-                if call is None or fn is None:
-                    continue
-                retries = fn.definition.retry_policy.retries
-                if inp.retry_count < retries:
-                    inp.retry_count += 1
-                    inp.status = "pending"
+            claimed_by_gang = inp.status == "claimed" and (
+                inp.claimed_by == task.task_id
+                or bool(gang_tasks and (inp.claimed_by in gang_tasks or task.task_id in inp.delivered_to))
+            )
+            if not (touched_pending or claimed_by_gang):
+                continue
+            call = self.s.function_calls.get(inp.function_call_id)
+            fn = self.s.functions.get(task.function_id)
+            if call is None or fn is None:
+                continue
+            retries = fn.definition.retry_policy.retries
+            if inp.retry_count < retries:
+                inp.retry_count += 1
+                inp.status = "pending"
+                # Clear delivery bookkeeping from the dead gang: a stale
+                # delivered_to set would otherwise mark the input claimed
+                # after reaching only one rank of the replacement gang.
+                inp.delivered_to -= dead_ids
+                inp.claimed_by = ""
+                inp.claimed_at = 0.0
+                if inp.input_id not in fn.pending:
                     fn.pending.append(inp.input_id)
-                    async with fn.input_condition:
-                        fn.input_condition.notify_all()
-                    self.s.schedule_event.set()
-                else:
-                    inp.status = "done"
-                    call.outputs.append(
-                        api_pb2.FunctionGetOutputsItem(
-                            result=result, idx=inp.idx, input_id=inp.input_id, retry_count=inp.retry_count
-                        )
+                async with fn.input_condition:
+                    fn.input_condition.notify_all()
+                self.s.schedule_event.set()
+            else:
+                inp.status = "done"
+                # partially-delivered broadcast inputs are still queued;
+                # drop them so backlog/delivery scans don't see phantom work
+                if inp.input_id in fn.pending:
+                    fn.pending.remove(inp.input_id)
+                call.outputs.append(
+                    api_pb2.FunctionGetOutputsItem(
+                        result=result, idx=inp.idx, input_id=inp.input_id, retry_count=inp.retry_count
                     )
-                    call.num_done += 1
-                    async with call.output_condition:
-                        call.output_condition.notify_all()
+                )
+                call.num_done += 1
+                async with call.output_condition:
+                    call.output_condition.notify_all()
 
     def _release_task(self, task: TaskState_) -> None:
         worker = self.s.workers.get(task.worker_id)
@@ -831,7 +852,9 @@ class ModalTPUServicer:
         task = self.s.tasks.get(request.task_id)
         if task is None or not task.cluster_id:
             await context.abort(grpc.StatusCode.NOT_FOUND, "task has no cluster")
-        cluster = self.s.clusters[task.cluster_id]
+        cluster = self.s.clusters.get(task.cluster_id)
+        if cluster is None:  # e.g. gang rolled back while this container booted
+            await context.abort(grpc.StatusCode.NOT_FOUND, "cluster torn down")
         task.container_address = request.container_address
         async with cluster.condition:
             cluster.reported[request.task_id] = request.container_address

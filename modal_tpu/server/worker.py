@@ -72,6 +72,12 @@ class WorkerAgent:
         self._override_type = tpu_type
         self.state_dir = state_dir or config["state_dir"]
         self._procs: dict[str, asyncio.subprocess.Process] = {}
+        # stop events that raced ahead of their assignment (e.g. gang
+        # rollback): the task is killed at/before registration instead of
+        # booting on chips the scheduler already released. Bounded: stops for
+        # long-gone tasks (reaper duplicates) would otherwise accumulate.
+        self._early_stops: dict[str, None] = {}  # insertion-ordered set
+        self._early_stops_max = 1024
         self._channel = None
         self._stub: Optional[ModalTPUStub] = None
         self._tasks: list[asyncio.Task] = []
@@ -166,20 +172,53 @@ class WorkerAgent:
 
     async def _stop_task(self, stop: api_pb2.TaskStopEvent) -> None:
         proc = self._procs.get(stop.task_id)
-        if proc is not None:
-            logger.debug(f"stopping task {stop.task_id}")
-            if stop.force:
-                proc.kill()
-            else:
-                try:
-                    proc.terminate()
-                except ProcessLookupError:
-                    pass
+        if proc is None:
+            self._early_stops[stop.task_id] = None
+            while len(self._early_stops) > self._early_stops_max:
+                self._early_stops.pop(next(iter(self._early_stops)))
+            return
+        logger.debug(f"stopping task {stop.task_id}")
+        if stop.force:
+            proc.kill()
+        else:
+            try:
+                proc.terminate()
+            except ProcessLookupError:
+                pass
+
+    def _consume_early_stop(self, task_id: str) -> bool:
+        """True if a stop for this task arrived before it was registered."""
+        if task_id in self._early_stops:
+            self._early_stops.pop(task_id)
+            return True
+        return False
+
+    async def _report_never_started(self, task_id: str) -> None:
+        """TaskResult for a task stopped before launch — the server's result
+        handler releases its chips/bookkeeping (nothing else will: the
+        container never boots, never heartbeats, so the reaper won't see it)."""
+        try:
+            await retry_transient_errors(
+                self._stub.TaskResult,
+                api_pb2.TaskResultRequest(
+                    task_id=task_id,
+                    result=api_pb2.GenericResult(
+                        status=api_pb2.GENERIC_STATUS_TERMINATED,
+                        exception="stopped before container start",
+                    ),
+                ),
+                max_retries=2,
+            )
+        except Exception as exc:
+            logger.warning(f"failed reporting never-started task {task_id}: {exc}")
 
     async def _run_sandbox(self, assignment: api_pb2.TaskAssignment) -> None:
         """Run a sandbox command as a supervised subprocess: stdin drained
         from the control plane, stdout/stderr streamed back as logs."""
         task_id = assignment.task_id
+        if self._consume_early_stop(task_id):
+            await self._report_never_started(task_id)
+            return
         sandbox_id = assignment.sandbox_id
         d = assignment.sandbox_def
         env = dict(os.environ)
@@ -212,6 +251,8 @@ class WorkerAgent:
             )
             return
         self._procs[task_id] = proc
+        if self._consume_early_stop(task_id):  # stop raced in during spawn
+            proc.kill()
 
         async def _heartbeat() -> None:
             # sandboxes heartbeat like function containers so the reaper
@@ -332,6 +373,10 @@ class WorkerAgent:
 
     async def _run_task(self, assignment: api_pb2.TaskAssignment) -> None:
         task_id = assignment.task_id
+        if self._consume_early_stop(task_id):
+            logger.debug(f"task {task_id} stopped before start; not launching")
+            await self._report_never_started(task_id)
+            return
         args = assignment.container_arguments
         args.server_url = self.server_url
         task_dir = os.path.join(self.state_dir, "tasks", task_id)
@@ -398,6 +443,8 @@ class WorkerAgent:
             )
         self._procs[task_id] = proc
         logger.debug(f"task {task_id} started pid={proc.pid}")
+        if self._consume_early_stop(task_id):  # stop raced in during spawn
+            proc.kill()
         tail_task = asyncio.create_task(self._stream_logs(task_id, stdout_path, stderr_path, proc))
         returncode = await proc.wait()
         del self._procs[task_id]

@@ -44,3 +44,79 @@ def test_checkpoint_plain_tree(supervisor):
     assert back["nested"]["b"].dtype == jnp.bfloat16
     assert isinstance(back["l"], list) and len(back["l"]) == 2
     assert ckpt.exists("t/1") and not ckpt.exists("t/nope")
+
+
+def test_checkpoint_sharded_format(supervisor):
+    """Per-shard save format: each shard file holds one device's slice; the
+    manifest's shard table is derived from the sharding (identical on every
+    process, SURVEY §7 hard part 6). Restore assembles only needed shards,
+    reading files in parallel — exercised here on an 8-device CPU mesh."""
+    import modal_tpu
+    from modal_tpu.checkpoint import VolumeCheckpointer
+    from modal_tpu.models.llama import forward, get_config, init_params
+    from modal_tpu.parallel.mesh import build_mesh
+    from modal_tpu.parallel.sharding import param_shardings
+
+    vol = modal_tpu.Volume.from_name("ckpt-shard", create_if_missing=True)
+    vol.hydrate()
+    ckpt = VolumeCheckpointer(vol)
+
+    cfg = get_config("tiny")
+    mesh = build_mesh({"fsdp": 4, "model": 2})
+    shardings = param_shardings(mesh, cfg)
+    params = jax.jit(lambda k: init_params(cfg, k), out_shardings=shardings)(jax.random.PRNGKey(0))
+    manifest = ckpt.save("sh/1", params, shard_leaves_over=0)
+    assert any("shards" in m for m in manifest["leaves"]), "no leaf took the shard format"
+    sharded_meta = next(m for m in manifest["leaves"] if "shards" in m and len(m["shards"]) > 1)
+    assert len(sharded_meta["shards"]) >= 2
+
+    tokens = jnp.ones((1, 8), jnp.int32)
+    l_ref, _ = forward(params, cfg, tokens)
+
+    # restore with the same shardings
+    r1 = ckpt.restore("sh/1", shardings=shardings)
+    l1, _ = forward(r1, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(l_ref), np.asarray(l1), rtol=1e-2, atol=1e-2)
+
+    # restore with a DIFFERENT mesh shape (shard regridding)
+    mesh2 = build_mesh({"fsdp": 2, "model": 4})
+    r2 = ckpt.restore("sh/1", shardings=param_shardings(mesh2, cfg))
+    l2, _ = forward(r2, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(l_ref), np.asarray(l2), rtol=1e-2, atol=1e-2)
+
+    # restore unsharded (full assembly)
+    r3 = ckpt.restore("sh/1")
+    l3, _ = forward(r3, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(l_ref), np.asarray(l3), rtol=1e-2, atol=1e-2)
+
+
+def test_checkpoint_trainstate_roundtrip(supervisor):
+    """TrainState (NamedTuple + optax opt_state) must round-trip with its
+    original treedef via example_tree so restore feeds straight back into
+    train_step (ADVICE r1: path-based rebuild returned plain dicts/lists)."""
+    import modal_tpu
+    from modal_tpu.checkpoint import VolumeCheckpointer
+    from modal_tpu.models.llama import get_config, init_params
+    from modal_tpu.parallel.train import TrainConfig, TrainState, make_optimizer, make_train_step
+
+    vol = modal_tpu.Volume.from_name("ckpt-test3", create_if_missing=True)
+    vol.hydrate()
+    ckpt = VolumeCheckpointer(vol)
+
+    cfg = get_config("debug-1l")
+    tc = TrainConfig(warmup_steps=2, total_steps=10, remat=False)
+    optimizer = make_optimizer(tc)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    state = TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+    step_fn = make_train_step(cfg, tc, optimizer)
+    tokens = jnp.ones((2, 16), jnp.int32)
+    state, _ = step_fn(state, tokens)
+
+    ckpt.save("ts/1", state)
+    example = jax.eval_shape(lambda: state)
+    back = ckpt.restore("ts/1", example_tree=example)
+    assert isinstance(back, TrainState)
+    assert int(back.step) == 1
+    # restored state must be directly usable by train_step (donated argnums)
+    state2, metrics = step_fn(back, tokens)
+    assert int(state2.step) == 2 and float(metrics["loss"]) > 0
