@@ -596,6 +596,16 @@ class _FunctionCall(_Object, type_prefix="fc"):
         return [resp.info]
 
     @live_method
+    async def get_timeline(self) -> api_pb2.TaskGetTimelineResponse:
+        """Server-stamped boot/serve timestamps for the tasks that served
+        this call (assignment → ContainerHello → first input → first
+        output) — cold-start attribution, used by bench.py."""
+        return await retry_transient_errors(
+            self.client.stub.TaskGetTimeline,
+            api_pb2.TaskGetTimelineRequest(function_call_id=self.object_id),
+        )
+
+    @live_method
     async def cancel(self, terminate_containers: bool = False) -> None:
         await retry_transient_errors(
             self.client.stub.FunctionCallCancel,

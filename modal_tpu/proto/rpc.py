@@ -95,6 +95,7 @@ _RPC_NAMES = [
     "ContainerLog",
     "TaskResult",
     "TaskClusterHello",
+    "TaskGetTimeline",
     # Image builder
     "ImageGetOrCreate",
     "ImageJoinStreaming",

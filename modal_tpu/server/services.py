@@ -631,6 +631,7 @@ class ModalTPUServicer:
                     if len(inp.delivered_to) >= cluster.size:
                         inp.status = "claimed"
                         fn.pending.remove(input_id)
+                    task.first_input_at = task.first_input_at or time.time()
                     items.append(
                         api_pb2.FunctionGetInputsItem(
                             input_id=inp.input_id,
@@ -654,6 +655,7 @@ class ModalTPUServicer:
                         inp.status = "claimed"
                         inp.claimed_by = task.task_id
                         inp.claimed_at = time.time()
+                        task.first_input_at = task.first_input_at or time.time()
                         items.append(
                             api_pb2.FunctionGetInputsItem(
                                 input_id=inp.input_id,
@@ -694,6 +696,10 @@ class ModalTPUServicer:
             call = self.s.function_calls.get(item.function_call_id)
             if call is None:
                 continue
+            if pushing_task is not None:
+                # stamp before dedup: every rank's first push counts as its
+                # first output (cold-start attribution for gang members)
+                pushing_task.first_output_at = pushing_task.first_output_at or time.time()
             inp = self.s.inputs.get(item.input_id)
             if inp is not None:
                 if inp.status == "done":
@@ -721,6 +727,7 @@ class ModalTPUServicer:
                 )
             )
             call.num_done += 1
+            call.first_output_at = call.first_output_at or time.time()
             touched.add(call.function_call_id)
         for call_id in touched:
             call = self.s.function_calls[call_id]
@@ -844,6 +851,47 @@ class ModalTPUServicer:
         if fn is not None:
             fn.task_ids.discard(task.task_id)
         self.s.schedule_event.set()
+
+    async def TaskGetTimeline(self, request: api_pb2.TaskGetTimelineRequest, context) -> api_pb2.TaskGetTimelineResponse:
+        """Boot/serve timestamps for cold-start attribution (stamped by the
+        control plane at assignment / ContainerHello / first input / first
+        output — see bench.py's cold_start_to_first_step)."""
+        resp = api_pb2.TaskGetTimelineResponse()
+        task_ids: list[str] = []
+        if request.task_id:
+            if request.task_id not in self.s.tasks:
+                await context.abort(grpc.StatusCode.NOT_FOUND, "task not found")
+            task_ids = [request.task_id]
+        elif request.function_call_id:
+            call = self.s.function_calls.get(request.function_call_id)
+            if call is None:
+                await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+            resp.call_created_at = call.created_at
+            resp.call_first_output_at = call.first_output_at
+            seen: set[str] = set()
+            for iid in call.input_ids:
+                inp = self.s.inputs.get(iid)
+                if inp is None:
+                    continue
+                for tid in [inp.claimed_by, *inp.delivered_to]:
+                    if tid and tid not in seen:
+                        seen.add(tid)
+                        task_ids.append(tid)
+        for tid in task_ids:
+            task = self.s.tasks.get(tid)
+            if task is None:
+                continue
+            resp.tasks.append(
+                api_pb2.TaskTimeline(
+                    task_id=task.task_id,
+                    created_at=task.created_at,
+                    started_at=task.started_at,
+                    first_input_at=task.first_input_at,
+                    first_output_at=task.first_output_at,
+                    finished_at=task.finished_at,
+                )
+            )
+        return resp
 
     async def TaskClusterHello(self, request: api_pb2.TaskClusterHelloRequest, context) -> api_pb2.TaskClusterHelloResponse:
         """Gang rendezvous: block until all ranks report, then return rank +

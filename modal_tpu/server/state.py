@@ -77,6 +77,7 @@ class FunctionCallState:
     num_done: int = 0
     cancelled: bool = False
     return_exceptions: bool = False
+    first_output_at: float = 0.0
 
 
 @dataclass
@@ -112,6 +113,8 @@ class TaskState_:
     cluster_id: str = ""
     created_at: float = field(default_factory=time.time)
     started_at: float = 0.0
+    first_input_at: float = 0.0
+    first_output_at: float = 0.0
     finished_at: float = 0.0
     last_heartbeat: float = 0.0
     cancelled_input_ids: list[str] = field(default_factory=list)

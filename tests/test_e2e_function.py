@@ -77,3 +77,28 @@ def test_container_reuse_across_inputs(supervisor):
         warm_latency = time.monotonic() - t0
         assert pid1 == pid2, "second input should hit the warm container"
         assert warm_latency < first_latency, "warm path should skip container boot"
+
+
+def test_task_timeline_rpc(supervisor):
+    """TaskGetTimeline returns server-stamped boot/serve timestamps in causal
+    order — the cold-start attribution bench.py reports (assignment ->
+    ContainerHello -> first input -> first output)."""
+    import modal_tpu
+
+    app = modal_tpu.App("e2e-timeline")
+
+    def work(x):
+        return x + 1
+
+    f = app.function(serialized=True)(work)
+    with app.run():
+        call = f.spawn(1)
+        assert call.get() == 2
+        resp = call.get_timeline()
+    assert resp.call_created_at > 0 and resp.call_first_output_at >= resp.call_created_at
+    assert len(resp.tasks) == 1
+    t = resp.tasks[0]
+    assert t.created_at > 0
+    assert t.started_at >= t.created_at          # boot after assignment
+    assert t.first_input_at >= t.started_at      # input after hello
+    assert t.first_output_at >= t.first_input_at # output after input
