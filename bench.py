@@ -117,6 +117,53 @@ def _make_app(tpu_type: str, timeout_s: int):
     return app, llama_bench
 
 
+def _make_snap_app(tpu_type: str, timeout_s: int, model_name: str):
+    """Cold-start A/B: a snapshot-enabled class whose @enter(snap=True) does
+    the expensive weight init. Boot 1 pays it; boot 2 streams the warm-state
+    snapshot from disk to device (runtime/snapshot.py)."""
+    import modal_tpu
+
+    app = modal_tpu.App("bench-snap")
+
+    @app.cls(serialized=True, enable_memory_snapshot=True, tpu=tpu_type, timeout=timeout_s)
+    class SnapModel:
+        @modal_tpu.enter(snap=True)
+        def load(self):
+            import jax
+
+            from modal_tpu.models.llama import get_config, init_params
+
+            cfg = get_config(model_name)
+            self.params = init_params(cfg, jax.random.PRNGKey(0))
+            jax.block_until_ready(self.params)
+
+        @modal_tpu.method()
+        def first_step(self, batch: int, prompt_len: int) -> float:
+            import jax
+            import jax.numpy as jnp
+
+            from modal_tpu.models.llama import KVCache, get_config
+            from modal_tpu.models.sampling import prefill
+
+            cfg = get_config(model_name)
+            prompt = jnp.ones((batch, prompt_len), jnp.int32)
+            cache = KVCache.create(cfg, batch, prompt_len + 8)
+            logits, _ = prefill(self.params, cfg, prompt, cache)
+            return float(jnp.argmax(logits[0, -1]))
+
+    return app, SnapModel
+
+
+def _snap_cold_start(app, snap_model, batch: int, prompt_len: int, fn_timeout: int):
+    with app.run():
+        fc = snap_model().first_step.spawn(batch, prompt_len)
+        fc.get(timeout=fn_timeout)
+        tl = fc.get_timeline()
+    if tl.tasks and tl.tasks[0].first_output_at and tl.tasks[0].created_at:
+        return tl.tasks[0].first_output_at - tl.tasks[0].created_at
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Child: one full-stack attempt on one platform
 # ---------------------------------------------------------------------------
@@ -163,8 +210,6 @@ def child_main(mode: str) -> None:
         measure_wall_s = time.perf_counter() - t_meas0
         tl = fc.get_timeline()
 
-    synchronizer.run(sup.stop())
-
     # Honest cold start: server-stamped scheduler-assignment -> first output.
     cold_start_s = boot_s = exec_s = None
     if tl.tasks:
@@ -200,6 +245,25 @@ def child_main(mode: str) -> None:
         "measure_call_wall_s": round(measure_wall_s, 2),
         "bench_total_s": round(time.perf_counter() - t_child0, 2),
     }
+
+    # cold-start A/B: fresh enter vs warm-state snapshot restore (judged
+    # metric 2; the snapshot is the TPU analogue of CRIU+cuda-checkpoint)
+    if os.environ.get("MODAL_TPU_BENCH_SNAP", "1") == "1":
+        try:
+            snap_app, snap_model = _make_snap_app(f"{tpu_gen}-1", fn_timeout, model_name)
+            cold_fresh = _snap_cold_start(snap_app, snap_model, batch, prompt_len, fn_timeout)
+            cold_restore = _snap_cold_start(snap_app, snap_model, batch, prompt_len, fn_timeout)
+            if cold_fresh is not None:
+                result["cold_start_fresh_enter_s"] = round(cold_fresh, 2)
+            if cold_restore is not None:
+                result["cold_start_snap_restore_s"] = round(cold_restore, 2)
+            if cold_fresh and cold_restore:
+                result["snap_restore_speedup"] = round(cold_fresh / cold_restore, 2)
+        except Exception as exc:  # noqa: BLE001 — A/B is additive, never fatal
+            result["snap_bench_error"] = repr(exc)[:200]
+
+    synchronizer.run(sup.stop())
+    result["bench_total_s"] = round(time.perf_counter() - t_child0, 2)
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 

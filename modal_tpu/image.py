@@ -161,11 +161,11 @@ class _Image(_Object, type_prefix="im"):
     def debian_slim(python_version: Optional[str] = None, force_build: bool = False) -> "_Image":
         """Debian slim base with the pinned python (reference _image.py:2534)."""
         version = _validate_python_version(python_version)
+        # no tooling RUN layer here: the local worker backend materializes a
+        # matching-python venv as this base (image_builder.py), so the layer
+        # is pure FROM — keeps base images buildable without network egress
         return _Image._from_args(
-            dockerfile_commands=[
-                f"FROM python:{version}-slim-bookworm",
-                "RUN pip install --upgrade pip uv",
-            ],
+            dockerfile_commands=[f"FROM python:{version}-slim-bookworm"],
             force_build=force_build,
             rep=f"Image.debian_slim({version!r})",
         )

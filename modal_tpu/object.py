@@ -296,10 +296,19 @@ class Resolver:
             return await asyncio.shield(cached_future)
 
         async def _loader() -> _Object:
-            # load deps first (parallel)
+            # load deps first (parallel). A dep hydrated by a DIFFERENT
+            # client (e.g. the module-level default image, hydrated during a
+            # previous app run / against a previous server) must re-load —
+            # its object id means nothing to this context's server.
             deps = obj.deps()
             if deps:
-                await asyncio.gather(*[self.load(dep, context) for dep in deps if not dep._is_hydrated])
+                await asyncio.gather(
+                    *[
+                        self.load(dep, context)
+                        for dep in deps
+                        if not dep._is_hydrated or dep._client is not context.client
+                    ]
+                )
             if obj._load is not None:
                 await obj._load(obj, self, context, existing_object_id)
             if obj._object_id is None:

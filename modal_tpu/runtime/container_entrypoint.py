@@ -198,12 +198,28 @@ async def main_async() -> int:
         else:
             service = import_single_function_service(function_def, client)
 
-        # lifecycle: enter hooks (pre-snapshot = warm weight load)
-        await run_lifecycle_hooks(service.enter_pre_snapshot, "enter(snap=True)")
+        # lifecycle: enter hooks (pre-snapshot = warm weight load). With
+        # memory snapshots enabled, later cold boots SKIP the snap-enter
+        # hooks and stream the saved state straight to device — the TPU
+        # analogue of the reference's CRIU restore
+        # (task_lifecycle_manager.py:146-220); see runtime/snapshot.py.
+        restored = False
+        if function_def.enable_memory_snapshot and service.enter_pre_snapshot:
+            from .snapshot import restore_snapshot
+
+            # off-loop: a multi-GB restore must not starve the heartbeat task
+            restored = await asyncio.to_thread(
+                restore_snapshot, function_def, service.user_instance
+            )
+        if not restored:
+            await run_lifecycle_hooks(service.enter_pre_snapshot, "enter(snap=True)")
         if function_def.enable_memory_snapshot:
-            # TPU warm-state snapshot point: compiled executables are in the
-            # persistent cache; notify the control plane (analogue of the
-            # reference's ContainerCheckpoint → CRIU flow).
+            if not restored:
+                from .snapshot import save_snapshot
+
+                await asyncio.to_thread(save_snapshot, function_def, service.user_instance)
+            # notify the control plane a warm snapshot exists (analogue of
+            # the reference's ContainerCheckpoint → CRIU flow)
             await retry_transient_errors(
                 client.stub.ContainerCheckpoint,
                 api_pb2.ContainerCheckpointRequest(task_id=task_id, checkpoint_id=""),

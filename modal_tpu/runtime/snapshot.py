@@ -1,0 +1,175 @@
+"""Warm-state snapshots: the TPU analogue of container memory snapshots.
+
+The reference eliminates cold starts with CRIU process snapshots plus
+`cuda-checkpoint` for GPU memory (reference
+py/modal/_runtime/task_lifecycle_manager.py:146-220, gpu_memory_snapshot.py).
+No process/HBM checkpoint exists for TPU, so the analogue is state-level:
+
+- On the FIRST boot of a snapshot-enabled function, the `@enter(snap=True)`
+  hooks run (expensive: weight load/init), then every attribute the hooks set
+  on the service instance is snapshotted to worker-local disk — jax/numpy
+  array leaves as raw buffers, everything else cloudpickled, with the exact
+  pytree structure preserved.
+- On every LATER cold boot, the snap-enter hooks are SKIPPED and the state
+  streams straight from disk into device memory (`jax.device_put` per leaf) —
+  paired with the persistent XLA compilation cache, the two big cold-start
+  costs (weight init + compilation) disappear.
+
+Contract (documented on `@enter(snap=True)`): snap-enter hooks must only
+establish state on `self`. If any attribute can't be snapshotted (open
+sockets, locks), the snapshot is abandoned — the function still works, every
+boot just pays the full enter cost. Restore never partially applies.
+
+Snapshots are keyed by the full function definition hash (code, image,
+params), so code changes invalidate them automatically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Any, Optional
+
+from ..config import config, logger
+from ..proto import api_pb2
+
+
+def _snapshot_root() -> str:
+    return os.environ.get(
+        "MODAL_TPU_SNAPSHOT_DIR", os.path.join(config["state_dir"], "snapshots")
+    )
+
+
+def snapshot_key(function_def: api_pb2.Function) -> str:
+    # deterministic=True: map fields (experimental_options, volume_mounts)
+    # otherwise serialize in arbitrary order, splitting identical functions
+    # across snapshot keys
+    return hashlib.sha256(function_def.SerializeToString(deterministic=True)).hexdigest()[:24]
+
+
+def _leaf_is_array(leaf: Any) -> bool:
+    import jax
+    import numpy as np
+
+    return isinstance(leaf, (jax.Array, np.ndarray))
+
+
+def _array_bytes(arr) -> tuple[bytes, dict]:
+    import numpy as np
+
+    np_arr = np.asarray(arr)
+    meta = {"shape": list(np_arr.shape), "dtype": _dtype_str(np_arr.dtype)}
+    if np_arr.dtype.name == "bfloat16":
+        return np_arr.view(np.uint16).tobytes(), meta
+    return np_arr.tobytes(), meta
+
+
+def _dtype_str(dt) -> str:
+    import numpy as np
+
+    if dt == np.dtype("V2") or dt.name == "bfloat16":
+        return "bfloat16"
+    return str(dt)
+
+
+def _array_from_file(path: str, meta: dict):
+    import numpy as np
+
+    data = np.fromfile(path, dtype=np.uint8)
+    if meta["dtype"] == "bfloat16":
+        import ml_dtypes
+
+        return data.view(np.uint16).view(ml_dtypes.bfloat16).reshape(meta["shape"])
+    return data.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
+
+
+def save_snapshot(function_def: api_pb2.Function, user_instance: Any) -> bool:
+    """Snapshot user_instance attributes post-snap-enter. Returns True when a
+    complete snapshot landed; False (with everything cleaned up) otherwise."""
+    import jax
+
+    from ..serialization import serialize
+
+    if user_instance is None:
+        return False
+    key = snapshot_key(function_def)
+    final_dir = os.path.join(_snapshot_root(), key)
+    if os.path.exists(os.path.join(final_dir, "manifest.json")):
+        return True
+    tmp_dir = final_dir + ".saving"
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    os.makedirs(tmp_dir, exist_ok=True)
+    manifest: dict = {"attrs": {}}
+    try:
+        for name, value in vars(user_instance).items():
+            leaves, treedef = jax.tree_util.tree_flatten(value)
+            entry: dict = {"treedef": serialize(treedef).hex(), "leaves": []}
+            for i, leaf in enumerate(leaves):
+                if _leaf_is_array(leaf):
+                    if hasattr(leaf, "block_until_ready"):
+                        leaf.block_until_ready()
+                    data, meta = _array_bytes(leaf)
+                    fname = f"{name}.{i}.bin"
+                    with open(os.path.join(tmp_dir, fname), "wb") as f:
+                        f.write(data)
+                    entry["leaves"].append({"kind": "array", "file": fname, **meta})
+                else:
+                    entry["leaves"].append({"kind": "pickle", "data": serialize(leaf).hex()})
+            manifest["attrs"][name] = entry
+        with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp_dir, final_dir)
+        logger.debug(f"warm-state snapshot saved: {key} ({len(manifest['attrs'])} attrs)")
+        return True
+    except Exception as exc:  # noqa: BLE001 — snapshot is best-effort, never partial
+        logger.warning(f"warm-state snapshot abandoned ({type(exc).__name__}: {exc})")
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        return False
+
+
+def restore_snapshot(function_def: api_pb2.Function, user_instance: Any) -> bool:
+    """Stream a saved snapshot back onto user_instance (device_put per array
+    leaf). Returns True when fully applied; False → caller runs snap-enter
+    hooks normally. Never partially applies: attributes are staged first."""
+    import jax
+
+    from ..serialization import deserialize
+
+    if user_instance is None:
+        return False
+    key = snapshot_key(function_def)
+    snap_dir = os.path.join(_snapshot_root(), key)
+    manifest_path = os.path.join(snap_dir, "manifest.json")
+    if not os.path.exists(manifest_path):
+        return False
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        staged: dict[str, Any] = {}
+        for name, entry in manifest["attrs"].items():
+            treedef = deserialize(bytes.fromhex(entry["treedef"]), None)
+            leaves = []
+            for meta in entry["leaves"]:
+                if meta["kind"] == "array":
+                    arr = _array_from_file(os.path.join(snap_dir, meta["file"]), meta)
+                    leaves.append(jax.device_put(arr))
+                    del arr  # one leaf of host memory at a time
+                else:
+                    leaves.append(deserialize(bytes.fromhex(meta["data"]), None))
+            staged[name] = jax.tree_util.tree_unflatten(treedef, leaves)
+        for name, value in staged.items():
+            setattr(user_instance, name, value)
+        logger.debug(f"warm-state snapshot restored: {key} ({len(staged)} attrs)")
+        return True
+    except Exception as exc:  # noqa: BLE001
+        logger.warning(f"warm-state restore failed ({type(exc).__name__}: {exc}); running enter hooks")
+        # a snapshot that can't restore is worthless — drop it so the next
+        # boot's save_snapshot rewrites it instead of re-hitting this path
+        drop_snapshot(function_def)
+        return False
+
+
+def drop_snapshot(function_def: api_pb2.Function) -> None:
+    shutil.rmtree(os.path.join(_snapshot_root(), snapshot_key(function_def)), ignore_errors=True)

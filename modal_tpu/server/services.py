@@ -579,6 +579,9 @@ class ModalTPUServicer:
         task.state = api_pb2.TASK_STATE_ACTIVE
         task.started_at = task.started_at or time.time()
         task.last_heartbeat = time.time()
+        fn = self.s.functions.get(task.function_id)
+        if fn is not None:
+            fn.init_failures = 0  # a container came up: init is healthy
         return api_pb2.ContainerHelloResponse()
 
     async def ContainerHeartbeat(self, request, context) -> api_pb2.ContainerHeartbeatResponse:
@@ -767,9 +770,38 @@ class ModalTPUServicer:
             else:
                 task.state = api_pb2.TASK_STATE_FAILED
                 await self._fail_claimed_inputs(task, request.result)
+                if request.result.status == api_pb2.GENERIC_STATUS_INIT_FAILURE:
+                    # containers that die before serving (image build failed,
+                    # spawn failed) never claim inputs — repeated init
+                    # failures must fail the backlog or clients hang forever
+                    fn = self.s.functions.get(task.function_id)
+                    if fn is not None:
+                        fn.init_failures += 1
+                        if fn.init_failures >= 2:
+                            await self._fail_pending_inputs(fn, request.result)
             task.finished_at = time.time()
             self._release_task(task)
         return api_pb2.TaskResultResponse()
+
+    async def _fail_pending_inputs(self, fn: FunctionState, result: api_pb2.GenericResult) -> None:
+        for input_id in list(fn.pending):
+            inp = self.s.inputs.get(input_id)
+            if inp is None or inp.status != "pending":
+                continue
+            inp.status = "done"
+            fn.pending.remove(input_id)
+            call = self.s.function_calls.get(inp.function_call_id)
+            if call is None:
+                continue
+            call.outputs.append(
+                api_pb2.FunctionGetOutputsItem(
+                    result=result, idx=inp.idx, input_id=inp.input_id, retry_count=inp.retry_count
+                )
+            )
+            call.num_done += 1
+            call.first_output_at = call.first_output_at or time.time()
+            async with call.output_condition:
+                call.output_condition.notify_all()
 
     async def _fail_claimed_inputs(self, task: TaskState_, result: api_pb2.GenericResult) -> None:
         """Inputs claimed by a dead container either retry or fail
@@ -1139,7 +1171,7 @@ class ModalTPUServicer:
     # ------------------------------------------------------------------
 
     async def ImageGetOrCreate(self, request: api_pb2.ImageGetOrCreateRequest, context) -> api_pb2.ImageGetOrCreateResponse:
-        key = hashlib.sha256(request.image.SerializeToString()).hexdigest()[:16]
+        key = hashlib.sha256(request.image.SerializeToString(deterministic=True)).hexdigest()[:16]
         image_id = self.s.images_by_hash.get(key)
         if image_id is None:
             image_id = make_id("im")
@@ -1167,7 +1199,9 @@ class ModalTPUServicer:
         image = self.s.images.get(request.image_id)
         if image is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "image not found")
-        return api_pb2.ImageFromIdResponse(image_id=request.image_id, metadata=image.metadata)
+        return api_pb2.ImageFromIdResponse(
+            image_id=request.image_id, metadata=image.metadata, definition=image.definition
+        )
 
     # ------------------------------------------------------------------
     # Mounts

@@ -93,6 +93,7 @@ class FunctionState:
     # autoscaler bookkeeping
     task_ids: set[str] = field(default_factory=set)
     web_url: str = ""
+    init_failures: int = 0  # consecutive container INIT_FAILUREs
     bound_parent: Optional[str] = None  # parametrized variant parent id
     serialized_params: bytes = b""
     autoscaler_override: Optional[api_pb2.AutoscalerSettings] = None

@@ -72,6 +72,7 @@ class WorkerAgent:
         self._override_type = tpu_type
         self.state_dir = state_dir or config["state_dir"]
         self._procs: dict[str, asyncio.subprocess.Process] = {}
+        self._image_builder = None  # lazy ImageBuilder (created on first use)
         # stop events that raced ahead of their assignment (e.g. gang
         # rollback): the task is killed at/before registration instead of
         # booting on chips the scheduler already released. Bounded: stops for
@@ -186,6 +187,46 @@ class WorkerAgent:
             except ProcessLookupError:
                 pass
 
+    async def _materialize_image(self, image_id: str):
+        """Build (or reuse) the task's image; returns BuiltImage or None for
+        trivial chains (host venv). Raises ImageBuildError on failure."""
+        from .image_builder import get_image_builder
+
+        if self._image_builder is None:
+            self._image_builder = get_image_builder(self.state_dir)
+        return await self._image_builder.materialize(self._stub, image_id)
+
+    async def _prepare_image(self, task_id: str, image_id: str, env: dict):
+        """Materialize the image and fold its env/PATH/rootfs into `env`.
+        Returns (ok, built): on build failure reports INIT_FAILURE and
+        returns (False, None) — shared by the function and sandbox paths."""
+        if not image_id:
+            return True, None
+        try:
+            built = await self._materialize_image(image_id)
+        except Exception as exc:
+            logger.warning(f"image build failed for task {task_id}: {exc}")
+            try:
+                await retry_transient_errors(
+                    self._stub.TaskResult,
+                    api_pb2.TaskResultRequest(
+                        task_id=task_id,
+                        result=api_pb2.GenericResult(
+                            status=api_pb2.GENERIC_STATUS_INIT_FAILURE,
+                            exception=f"image build failed: {exc}",
+                        ),
+                    ),
+                    max_retries=2,
+                )
+            except Exception as report_exc:
+                logger.warning(f"failed reporting image build failure: {report_exc}")
+            return False, None
+        if built is not None:
+            env.update(built.env)
+            env["MODAL_TPU_IMAGE_ROOT"] = built.rootfs
+            env["PATH"] = os.path.dirname(built.python_bin) + os.pathsep + env.get("PATH", "")
+        return True, built
+
     def _consume_early_stop(self, task_id: str) -> bool:
         """True if a stop for this task arrived before it was registered."""
         if task_id in self._early_stops:
@@ -222,6 +263,10 @@ class WorkerAgent:
         sandbox_id = assignment.sandbox_id
         d = assignment.sandbox_def
         env = dict(os.environ)
+        ok, built_image = await self._prepare_image(task_id, d.image_id, env)
+        if not ok:
+            return
+        sandbox_cwd = d.workdir or (built_image.workdir if built_image else None) or None
         # secrets are resolved control-plane-side into the assignment env
         env.update(dict(assignment.container_arguments.env))
         if assignment.tpu_chip_ids:
@@ -235,7 +280,7 @@ class WorkerAgent:
                 stdin=asyncio.subprocess.PIPE,
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE,
-                cwd=d.workdir or None,
+                cwd=sandbox_cwd,
                 env=env,
             )
         except Exception as exc:
@@ -385,7 +430,13 @@ class WorkerAgent:
         with open(args_path, "wb") as f:
             f.write(args.SerializeToString())
 
+        # materialize the function's image (content-addressed venv; cached).
+        # Failures are loud: the task reports INIT_FAILURE with the build log
+        # tail instead of silently running the host venv (round-1 behavior).
         env = dict(os.environ)
+        ok, built_image = await self._prepare_image(task_id, args.function_def.image_id, env)
+        if not ok:
+            return
         env.update(dict(args.env))
         env["MODAL_TPU_CONTAINER_ARGS_PATH"] = args_path
         env["MODAL_TPU_SERVER_URL"] = self.server_url
@@ -430,16 +481,18 @@ class WorkerAgent:
 
         stdout_path = os.path.join(task_dir, "stdout.log")
         stderr_path = os.path.join(task_dir, "stderr.log")
+        container_python = built_image.python_bin if built_image is not None else sys.executable
+        container_cwd = (built_image.workdir if built_image is not None else "") or globals_path or None
         with open(stdout_path, "wb") as out_f, open(stderr_path, "wb") as err_f:
             proc = await asyncio.create_subprocess_exec(
-                sys.executable,
+                container_python,
                 "-u",
                 "-m",
                 "modal_tpu.runtime.container_entrypoint",
                 env=env,
                 stdout=out_f,
                 stderr=err_f,
-                cwd=globals_path or None,
+                cwd=container_cwd,
             )
         self._procs[task_id] = proc
         logger.debug(f"task {task_id} started pid={proc.pid}")

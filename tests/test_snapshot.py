@@ -1,0 +1,71 @@
+"""Warm-state snapshots (TPU analogue of reference CRIU memory snapshots,
+task_lifecycle_manager.py:146-220): later cold boots of a snapshot-enabled
+class skip @enter(snap=True) and stream saved state straight to device."""
+
+import os
+
+
+def test_warm_state_snapshot_skips_enter(supervisor, tmp_path):
+    import modal_tpu
+
+    marker = str(tmp_path / "enter_count.txt")
+
+    app = modal_tpu.App("snap-e2e")
+
+    @app.cls(serialized=True, enable_memory_snapshot=True)
+    class Model:
+        @modal_tpu.enter(snap=True)
+        def load(self):
+            import jax.numpy as jnp
+
+            with open(marker, "a") as f:
+                f.write("x")
+            self.w = jnp.arange(8.0)
+            self.meta = {"name": "m", "n": 8}
+
+        @modal_tpu.method()
+        def total(self, k):
+            return float(self.w.sum()) * k + self.meta["n"]
+
+    # run 1: fresh boot — snap-enter runs, snapshot saved
+    with app.run():
+        assert Model().total.remote(2) == 28.0 * 2 + 8
+    assert os.path.getsize(marker) == 1
+
+    # run 2: new app, new container — state restores, snap-enter SKIPPED
+    with app.run():
+        assert Model().total.remote(3) == 28.0 * 3 + 8
+    assert os.path.getsize(marker) == 1, "snap-enter must not run on a warm-snapshot boot"
+
+    snap_root = os.path.join(supervisor.state_dir, "snapshots")
+    assert os.path.isdir(snap_root) and len(os.listdir(snap_root)) == 1
+
+
+def test_snapshot_abandoned_on_unpicklable_state(supervisor, tmp_path):
+    """Unsnapshotable attributes abandon the snapshot (never partial):
+    every boot pays full enter cost but stays correct."""
+    import modal_tpu
+
+    marker = str(tmp_path / "count2.txt")
+    app = modal_tpu.App("snap-bad")
+
+    @app.cls(serialized=True, enable_memory_snapshot=True)
+    class Gnarly:
+        @modal_tpu.enter(snap=True)
+        def load(self):
+            import socket
+
+            with open(marker, "a") as f:
+                f.write("x")
+            self.sock = socket.socket()  # not picklable on purpose
+            self.value = 5
+
+        @modal_tpu.method()
+        def get(self):
+            return self.value
+
+    with app.run():
+        assert Gnarly().get.remote() == 5
+    with app.run():
+        assert Gnarly().get.remote() == 5
+    assert os.path.getsize(marker) == 2, "failed snapshot must re-run enter each boot"
