@@ -64,6 +64,9 @@ __all__ = [
     "Queue",
     "Retries",
     "Sandbox",
+    "ContainerProcess",
+    "SandboxFS",
+    "FileIO",
     "SchedulerPlacement",
     "Secret",
     "TPUSliceSpec",
@@ -101,4 +104,16 @@ def __getattr__(name: str):
             return Sandbox
         except ImportError as exc:
             raise AttributeError(f"Sandbox is not available yet: {exc}") from None
+    if name == "ContainerProcess":
+        from .container_process import ContainerProcess
+
+        return ContainerProcess
+    if name == "SandboxFS":
+        from .sandbox_fs import SandboxFS
+
+        return SandboxFS
+    if name == "FileIO":
+        from .sandbox_fs import FileIO
+
+        return FileIO
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -34,10 +34,11 @@ class RPCMethod:
     request_type: Any
     response_type: Any
     arity: Arity
+    service_name: str = SERVICE_NAME
 
     @property
     def path(self) -> str:
-        return f"/{SERVICE_NAME}/{self.name}"
+        return f"/{self.service_name}/{self.name}"
 
 
 # RPCs whose response message doesn't follow the `<Name>Response` convention,
@@ -150,6 +151,7 @@ _RPC_NAMES = [
     "SandboxGetFromName",
     "SandboxStdinWrite",
     "SandboxGetStdin",
+    "SandboxGetCommandRouterAccess",
     "SandboxGetLogs",
     "SandboxSnapshotFs",
     "ContainerExec",
@@ -172,29 +174,60 @@ _RPC_NAMES = [
 ]
 
 
-def _build_registry() -> dict[str, RPCMethod]:
+def _build_registry(
+    names: list[str],
+    overrides: dict[str, tuple[Optional[str], Optional[str], Arity]],
+    service_name: str,
+) -> dict[str, RPCMethod]:
     registry = {}
-    for name in _RPC_NAMES:
-        req_name, resp_name, arity = _OVERRIDES.get(name, (None, None, Arity.UNARY_UNARY))
+    for name in names:
+        req_name, resp_name, arity = overrides.get(name, (None, None, Arity.UNARY_UNARY))
         req_name = req_name or f"{name}Request"
         resp_name = resp_name or f"{name}Response"
         req = getattr(api_pb2, req_name, None)
         resp = getattr(api_pb2, resp_name, None)
         if req is None or resp is None:
             raise RuntimeError(f"proto message missing for RPC {name}: {req_name if req is None else resp_name}")
-        registry[name] = RPCMethod(name, req, resp, arity)
+        registry[name] = RPCMethod(name, req, resp, arity, service_name)
     return registry
 
 
-RPCS: dict[str, RPCMethod] = _build_registry()
+RPCS: dict[str, RPCMethod] = {}  # populated below
 
 
-class ModalTPUStub:
-    """Client-side stub: one multicallable per RPC, built on a grpc.aio channel."""
+# --- second data plane: the worker-served task command router ---------------
+# (reference modal_proto/task_command_router.proto — exec/stdio/FS directly
+# against the worker hosting a sandbox, bypassing the control plane)
+
+ROUTER_SERVICE_NAME = "modal.tpu.api.TaskCommandRouter"
+
+_ROUTER_OVERRIDES: dict[str, tuple[Optional[str], Optional[str], Arity]] = {
+    "TaskExecStdioRead": (None, "TaskExecStdioChunk", Arity.UNARY_STREAM),
+}
+
+_ROUTER_RPC_NAMES = [
+    "TaskExecStart",
+    "TaskExecStdioRead",
+    "TaskExecPutInput",
+    "TaskExecWait",
+    "TaskFsOp",
+]
+
+
+RPCS.update(_build_registry(_RPC_NAMES, _OVERRIDES, SERVICE_NAME))
+ROUTER_RPCS: dict[str, RPCMethod] = _build_registry(
+    _ROUTER_RPC_NAMES, _ROUTER_OVERRIDES, ROUTER_SERVICE_NAME
+)
+
+
+class _StubBase:
+    """Client-side stub: one multicallable per RPC on a grpc.aio channel."""
+
+    _registry: dict[str, RPCMethod] = {}
 
     def __init__(self, channel: "grpc.aio.Channel"):
         self._channel = channel
-        for method in RPCS.values():
+        for method in self._registry.values():
             if method.arity == Arity.UNARY_UNARY:
                 factory = channel.unary_unary
             elif method.arity == Arity.UNARY_STREAM:
@@ -214,15 +247,21 @@ class ModalTPUStub:
             )
 
 
-def build_generic_handler(servicer: Any) -> "grpc.GenericRpcHandler":
-    """Build a grpc generic handler routing every registered RPC to a
-    same-named async method on `servicer`. Unimplemented methods return
-    UNIMPLEMENTED (so partial servicers — e.g. a worker-only control plane —
-    are fine)."""
+class ModalTPUStub(_StubBase):
+    _registry = RPCS
+
+
+class TaskRouterStub(_StubBase):
+    _registry = ROUTER_RPCS
+
+
+def _build_handler(
+    servicer: Any, registry: dict[str, RPCMethod], service_name: str
+) -> "grpc.GenericRpcHandler":
     import grpc
 
     handlers = {}
-    for method in RPCS.values():
+    for method in registry.values():
         impl = getattr(servicer, method.name, None)
         if impl is None:
             continue
@@ -238,4 +277,16 @@ def build_generic_handler(servicer: Any) -> "grpc.GenericRpcHandler":
             handlers[method.name] = grpc.stream_unary_rpc_method_handler(impl, **kwargs)
         else:
             handlers[method.name] = grpc.stream_stream_rpc_method_handler(impl, **kwargs)
-    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+    return grpc.method_handlers_generic_handler(service_name, handlers)
+
+
+def build_generic_handler(servicer: Any) -> "grpc.GenericRpcHandler":
+    """Route every registered control-plane RPC to a same-named async method
+    on `servicer`. Unimplemented methods return UNIMPLEMENTED (so partial
+    servicers — e.g. a worker-only control plane — are fine)."""
+    return _build_handler(servicer, RPCS, SERVICE_NAME)
+
+
+def build_router_handler(servicer: Any) -> "grpc.GenericRpcHandler":
+    """Same, for the worker-served TaskCommandRouter service."""
+    return _build_handler(servicer, ROUTER_RPCS, ROUTER_SERVICE_NAME)

@@ -97,6 +97,8 @@ class _Sandbox(_Object, type_prefix="sb"):
     _stderr: Optional[_StreamReader] = None
     _stdin: Optional[_StreamWriter] = None
     _result: Optional[api_pb2.GenericResult] = None
+    _router: Optional[Any] = None
+    _fs: Optional[Any] = None
 
     @staticmethod
     async def create(
@@ -208,6 +210,47 @@ class _Sandbox(_Object, type_prefix="sb"):
             return int(self._result.data.decode())
         except (ValueError, AttributeError):
             return 0 if self._result.status == api_pb2.GENERIC_STATUS_SUCCESS else 1
+
+    # -- direct data plane (worker command router) --------------------------
+
+    def _get_router(self):
+        if self._router is None:
+            from ._utils.router_client import TaskRouterClient
+
+            self._router = TaskRouterClient(self.client.stub, self.object_id)
+        return self._router
+
+    async def exec(
+        self,
+        *args: str,
+        workdir: Optional[str] = None,
+        env: Optional[dict] = None,
+        timeout: int = 0,
+        text: bool = True,
+    ):
+        """Run a command inside the running sandbox, returning a
+        ContainerProcess with streamed stdio (reference Sandbox.exec,
+        sandbox.py:1930 — V2 data plane via the worker's command router)."""
+        if not args:
+            raise InvalidError("exec needs a command")
+        from .container_process import _ContainerProcess
+
+        router = self._get_router()
+        exec_id = await router.exec_start(list(args), workdir=workdir or "", env=env, timeout_secs=timeout)
+        return _ContainerProcess(router, exec_id, text=text)
+
+    @property
+    def fs(self):
+        """Typed filesystem API inside the sandbox (reference sandbox_fs.py)."""
+        if self._fs is None:
+            from .sandbox_fs import _SandboxFS
+
+            self._fs = _SandboxFS(self._get_router())
+        return self._fs
+
+    async def open(self, path: str, mode: str = "r"):
+        """Remote file handle (reference Sandbox.open / file_io.py)."""
+        return await self.fs.open(path, mode)
 
     async def terminate(self) -> None:
         await retry_transient_errors(

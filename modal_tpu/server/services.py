@@ -1148,9 +1148,30 @@ class ModalTPUServicer:
             memory_mb=request.memory_mb,
             container_address=request.container_address,
             slice_index=request.slice_index,
+            router_address=request.router_address,
         )
         self.s.schedule_event.set()
         return api_pb2.WorkerRegisterResponse(worker_id=worker_id)
+
+    async def SandboxGetCommandRouterAccess(
+        self, request: api_pb2.SandboxGetCommandRouterAccessRequest, context
+    ) -> api_pb2.SandboxGetCommandRouterAccessResponse:
+        """Hand the client the worker's direct data plane address (reference
+        SandboxGetCommandRouterAccess → task_command_router_client.py:42)."""
+        sandbox = self.s.sandboxes.get(request.sandbox_id)
+        if sandbox is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        # the task may still be scheduling: surface UNAVAILABLE so the
+        # client's bounded connect-retry loop keeps polling
+        task = self.s.tasks.get(sandbox.task_id) if sandbox.task_id else None
+        if task is None:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, "sandbox not yet scheduled")
+        worker = self.s.workers.get(task.worker_id)
+        if worker is None or not worker.router_address:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, "worker router unavailable")
+        return api_pb2.SandboxGetCommandRouterAccessResponse(
+            router_address=worker.router_address, task_id=task.task_id
+        )
 
     async def WorkerPoll(self, request: api_pb2.WorkerPollRequest, context):
         worker = self.s.workers.get(request.worker_id)

@@ -150,6 +150,7 @@ class WorkerState:
     milli_cpu: int = 0
     memory_mb: int = 0
     container_address: str = ""
+    router_address: str = ""  # worker's TaskCommandRouter data plane
     slice_index: int = 0
     last_heartbeat: float = field(default_factory=time.time)
     # assignment channel consumed by the worker's WorkerPoll stream

@@ -1,0 +1,347 @@
+"""TaskCommandRouter: the worker-served data plane for sandbox exec + FS.
+
+Reference: the worker hosting a sandbox serves a second gRPC service that
+clients dial directly — exec, stdio streaming, and filesystem ops without
+round-tripping the control plane (modal_proto/task_command_router.proto:371-419,
+MockTaskCommandRouterServicer in py/test/conftest.py:80 which execs local
+subprocesses with stdin offset bookkeeping and injected UNAVAILABLE faults).
+
+Semantics the client relies on:
+- **Stdio reads resume by byte offset**: output is buffered per (exec, fd);
+  `TaskExecStdioRead(offset=N)` streams from byte N, so a dropped connection
+  re-reads exactly where it left off.
+- **Stdin writes are idempotent by offset**: `TaskExecPutInput(offset=N)`
+  with N < acked bytes is deduplicated (retry-safe); the response carries the
+  acked total.
+- **Exec start is idempotent by exec_id**: a client-supplied exec_id makes
+  retried starts return the existing exec.
+
+Fault injection for tests mirrors the reference conftest knobs: set
+`FAULTS["stdio_unavailable_every"] = N` to abort every Nth stdio-read stream
+with UNAVAILABLE mid-flight (exercising client resume).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+
+from ..config import logger
+from ..proto import api_pb2
+
+# test-only fault injection (reference conftest.py:715-740 pattern)
+FAULTS: dict = {"stdio_unavailable_every": 0, "_stdio_reads": 0}
+
+
+@dataclass
+class ExecState:
+    exec_id: str
+    task_id: str
+    proc: asyncio.subprocess.Process
+    stdout: bytearray = field(default_factory=bytearray)
+    stderr: bytearray = field(default_factory=bytearray)
+    stdout_eof: bool = False
+    stderr_eof: bool = False
+    stdin_acked: int = 0
+    stdin_eof: bool = False
+    returncode: Optional[int] = None
+    condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+
+    def buf(self, fd: int) -> bytearray:
+        return self.stdout if fd == 1 else self.stderr
+
+    def buf_eof(self, fd: int) -> bool:
+        return self.stdout_eof if fd == 1 else self.stderr_eof
+
+
+@dataclass
+class TaskContext:
+    """What an exec inherits from its task: the sandbox/container's env+cwd
+    (the local backend's equivalent of 'inside the container')."""
+
+    env: dict[str, str]
+    cwd: str
+
+
+class TaskRouterServicer:
+    """Serves TaskCommandRouter RPCs for the tasks on one worker."""
+
+    # finished execs kept addressable for late reads, bounded
+    MAX_FINISHED_EXECS = 256
+
+    def __init__(self):
+        self._tasks: dict[str, TaskContext] = {}
+        self._execs: dict[str, ExecState] = {}
+        self._finished_order: list[str] = []
+        self._start_locks: dict[str, asyncio.Lock] = {}
+
+    # -- worker wiring ------------------------------------------------------
+
+    def register_task(self, task_id: str, env: dict[str, str], cwd: str) -> None:
+        self._tasks[task_id] = TaskContext(env=dict(env), cwd=cwd or os.getcwd())
+
+    def unregister_task(self, task_id: str) -> None:
+        self._tasks.pop(task_id, None)
+        # exec'd processes die with their sandbox/container
+        for st in self._execs.values():
+            if st.task_id == task_id and st.proc.returncode is None:
+                try:
+                    st.proc.kill()
+                except ProcessLookupError:
+                    pass
+
+    async def shutdown(self) -> None:
+        for st in self._execs.values():
+            if st.proc.returncode is None:
+                try:
+                    st.proc.kill()
+                except ProcessLookupError:
+                    pass
+
+    # -- exec ---------------------------------------------------------------
+
+    async def TaskExecStart(self, request: api_pb2.TaskExecStartRequest, context) -> api_pb2.TaskExecStartResponse:
+        task = self._tasks.get(request.task_id)
+        if task is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not on this worker")
+        exec_id = request.exec_id or f"ex-{uuid.uuid4().hex[:12]}"
+        # per-exec_id lock: a retried start racing the original's subprocess
+        # spawn must not create a second process
+        lock = self._start_locks.setdefault(exec_id, asyncio.Lock())
+        async with lock:
+            if exec_id in self._execs:  # idempotent retry
+                return api_pb2.TaskExecStartResponse(exec_id=exec_id)
+            env = dict(task.env)
+            env.update(dict(request.env))
+            cwd = request.workdir or task.cwd
+            proc = await asyncio.create_subprocess_exec(
+                *request.args,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+                env=env,
+                cwd=cwd or None,
+            )
+            st = ExecState(exec_id=exec_id, task_id=request.task_id, proc=proc)
+            self._execs[exec_id] = st
+        asyncio.create_task(self._pump(st, proc.stdout, 1))
+        asyncio.create_task(self._pump(st, proc.stderr, 2))
+        asyncio.create_task(self._reap(st, request.timeout_secs or 0))
+        return api_pb2.TaskExecStartResponse(exec_id=exec_id)
+
+    async def _pump(self, st: ExecState, stream, fd: int) -> None:
+        while True:
+            chunk = await stream.read(65536)
+            async with st.condition:
+                if not chunk:
+                    if fd == 1:
+                        st.stdout_eof = True
+                    else:
+                        st.stderr_eof = True
+                    st.condition.notify_all()
+                    return
+                st.buf(fd).extend(chunk)
+                st.condition.notify_all()
+
+    async def _reap(self, st: ExecState, timeout_secs: float) -> None:
+        try:
+            if timeout_secs:
+                rc = await asyncio.wait_for(st.proc.wait(), timeout=timeout_secs)
+            else:
+                rc = await st.proc.wait()
+        except asyncio.TimeoutError:
+            st.proc.kill()
+            rc = await st.proc.wait()
+        async with st.condition:
+            st.returncode = rc
+            st.condition.notify_all()
+        # bound memory: evict the oldest finished execs (their full stdio
+        # stays buffered for offset-resume until eviction)
+        self._finished_order.append(st.exec_id)
+        while len(self._finished_order) > self.MAX_FINISHED_EXECS:
+            old = self._finished_order.pop(0)
+            self._execs.pop(old, None)
+            self._start_locks.pop(old, None)
+
+    def _get_exec(self, exec_id: str):
+        return self._execs.get(exec_id)
+
+    async def TaskExecStdioRead(self, request: api_pb2.TaskExecStdioReadRequest, context):
+        st = self._get_exec(request.exec_id)
+        if st is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
+        fd = request.file_descriptor or 1
+        offset = request.offset
+        deadline = time.monotonic() + (request.timeout or 55.0)
+        FAULTS["_stdio_reads"] += 1
+        fault_stream = (
+            FAULTS["stdio_unavailable_every"]
+            and FAULTS["_stdio_reads"] % FAULTS["stdio_unavailable_every"] == 0
+        )
+        sent_one = False
+        while True:
+            data: Optional[bytes] = None
+            eof = False
+            # never yield while holding the condition: a slow consumer would
+            # block the output pumps
+            async with st.condition:
+                buf = st.buf(fd)
+                if offset < len(buf):
+                    data = bytes(buf[offset : offset + 256 * 1024])
+                elif st.buf_eof(fd):
+                    eof = True
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return  # client re-polls from its offset
+                    try:
+                        await asyncio.wait_for(st.condition.wait(), timeout=remaining)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+            if data is not None:
+                yield api_pb2.TaskExecStdioChunk(data=data, offset=offset)
+                offset += len(data)
+                if fault_stream and not sent_one:
+                    # injected mid-stream failure: client must resume from
+                    # the offset it has acked (reference conftest.py:93-103
+                    # UNAVAILABLE injection)
+                    await context.abort(grpc.StatusCode.UNAVAILABLE, "injected fault")
+                sent_one = True
+            elif eof:
+                yield api_pb2.TaskExecStdioChunk(offset=offset, eof=True)
+                return
+
+    async def TaskExecPutInput(self, request: api_pb2.TaskExecPutInputRequest, context) -> api_pb2.TaskExecPutInputResponse:
+        st = self._get_exec(request.exec_id)
+        if st is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
+        data = request.data
+        # offset-dedupe: drop the prefix we've already accepted
+        if request.offset < st.stdin_acked:
+            overlap = st.stdin_acked - request.offset
+            data = data[overlap:] if overlap < len(data) else b""
+        elif request.offset > st.stdin_acked:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"stdin gap: acked {st.stdin_acked}, got offset {request.offset}",
+            )
+        if data and st.proc.stdin is not None and not st.stdin_eof:
+            st.proc.stdin.write(data)
+            await st.proc.stdin.drain()
+            st.stdin_acked += len(data)
+        if request.eof and not st.stdin_eof:
+            st.stdin_eof = True
+            if st.proc.stdin is not None:
+                st.proc.stdin.close()
+        return api_pb2.TaskExecPutInputResponse(acked_offset=st.stdin_acked)
+
+    async def TaskExecWait(self, request: api_pb2.TaskExecWaitRequest, context) -> api_pb2.TaskExecWaitResponse:
+        st = self._get_exec(request.exec_id)
+        if st is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
+        # honor timeout=0 exactly: poll() means "answer immediately"
+        deadline = time.monotonic() + request.timeout
+        async with st.condition:
+            while st.returncode is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0.001:
+                    return api_pb2.TaskExecWaitResponse(completed=False)
+                try:
+                    await asyncio.wait_for(st.condition.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    pass
+            return api_pb2.TaskExecWaitResponse(completed=True, returncode=st.returncode)
+
+    # -- filesystem ---------------------------------------------------------
+
+    async def TaskFsOp(self, request: api_pb2.TaskFsOpRequest, context) -> api_pb2.TaskFsOpResponse:
+        task = self._tasks.get(request.task_id)
+        if task is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not on this worker")
+        path = request.path
+        if not os.path.isabs(path):
+            path = os.path.join(task.cwd, path)
+        try:
+            return await asyncio.to_thread(self._fs_op_sync, request, path, task)
+        except FileNotFoundError as exc:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+        except (OSError, ValueError) as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"{type(exc).__name__}: {exc}")
+
+    def _fs_op_sync(self, request: api_pb2.TaskFsOpRequest, path: str, task: TaskContext) -> api_pb2.TaskFsOpResponse:
+        op = request.op
+        resp = api_pb2.TaskFsOpResponse()
+        if op == "read":
+            with open(path, "rb") as f:
+                f.seek(request.offset)
+                resp.data = f.read(request.length or -1)
+        elif op == "write":
+            os.makedirs(os.path.dirname(path) or "/", exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(request.data)
+        elif op == "append":
+            with open(path, "ab") as f:
+                f.write(request.data)
+        elif op == "ls":
+            for name in sorted(os.listdir(path)):
+                full = os.path.join(path, name)
+                try:
+                    s = os.lstat(full)  # lstat: a dangling symlink must not
+                except OSError:  # fail the whole listing
+                    continue
+                resp.entries.append(
+                    api_pb2.FsEntry(
+                        name=name,
+                        is_dir=os.path.isdir(full),
+                        size=s.st_size,
+                        mode=s.st_mode,
+                        mtime=s.st_mtime,
+                    )
+                )
+        elif op == "mkdir":
+            if request.recursive:
+                os.makedirs(path, exist_ok=True)
+            else:
+                os.mkdir(path)
+        elif op == "rm":
+            if os.path.isdir(path):
+                if request.recursive:
+                    shutil.rmtree(path)
+                else:
+                    os.rmdir(path)
+            else:
+                os.remove(path)
+        elif op == "stat":
+            resp.exists = os.path.exists(path)
+            if resp.exists:
+                s = os.stat(path)
+                resp.stat.CopyFrom(
+                    api_pb2.FsEntry(
+                        name=os.path.basename(path),
+                        is_dir=os.path.isdir(path),
+                        size=s.st_size,
+                        mode=s.st_mode,
+                        mtime=s.st_mtime,
+                    )
+                )
+        elif op in ("mv", "cp"):
+            dest = request.dest
+            if not os.path.isabs(dest):
+                dest = os.path.join(task.cwd, dest)
+            if op == "mv":
+                shutil.move(path, dest)
+            elif os.path.isdir(path):
+                shutil.copytree(path, dest, dirs_exist_ok=True)
+            else:
+                shutil.copy2(path, dest)
+        else:
+            raise ValueError(f"unknown fs op {op!r}")
+        return resp

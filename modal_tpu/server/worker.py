@@ -93,6 +93,19 @@ class WorkerAgent:
             num_chips = self._override_chips
         if self._override_type is not None:
             tpu_type = self._override_type
+        # second data plane: the task command router clients dial directly
+        # (reference task_command_router.proto — exec/stdio/FS on the worker)
+        import grpc as _grpc
+
+        from ..proto.rpc import build_router_handler
+        from .task_router import TaskRouterServicer
+
+        self.router = TaskRouterServicer()
+        self._router_server = _grpc.aio.server()
+        self._router_server.add_generic_rpc_handlers((build_router_handler(self.router),))
+        router_port = self._router_server.add_insecure_port("127.0.0.1:0")
+        await self._router_server.start()
+        self.router_address = f"127.0.0.1:{router_port}"
         resp = await retry_transient_errors(
             self._stub.WorkerRegister,
             api_pb2.WorkerRegisterRequest(
@@ -104,6 +117,7 @@ class WorkerAgent:
                 milli_cpu=(os.cpu_count() or 1) * 1000,
                 memory_mb=16384,
                 container_address="127.0.0.1",
+                router_address=self.router_address,
             ),
             max_retries=10,
             max_delay=2.0,
@@ -120,6 +134,10 @@ class WorkerAgent:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         for task_id, proc in list(self._procs.items()):
             await self._kill_proc(proc)
+        if getattr(self, "router", None) is not None:
+            await self.router.shutdown()
+        if getattr(self, "_router_server", None) is not None:
+            await self._router_server.stop(grace=0.2)
         if self._channel is not None:
             await self._channel.close()
 
@@ -298,6 +316,7 @@ class WorkerAgent:
         self._procs[task_id] = proc
         if self._consume_early_stop(task_id):  # stop raced in during spawn
             proc.kill()
+        self.router.register_task(task_id, env, sandbox_cwd or os.getcwd())
 
         async def _heartbeat() -> None:
             # sandboxes heartbeat like function containers so the reaper
@@ -401,6 +420,7 @@ class WorkerAgent:
             exception = f"sandbox exceeded timeout of {timeout_s}s"
         finally:
             self._procs.pop(task_id, None)
+            self.router.unregister_task(task_id)
             stdin_task.cancel()
             hb_task.cancel()
             await asyncio.gather(stdin_task, hb_task, return_exceptions=True)
@@ -498,9 +518,11 @@ class WorkerAgent:
         logger.debug(f"task {task_id} started pid={proc.pid}")
         if self._consume_early_stop(task_id):  # stop raced in during spawn
             proc.kill()
+        self.router.register_task(task_id, env, container_cwd or os.getcwd())
         tail_task = asyncio.create_task(self._stream_logs(task_id, stdout_path, stderr_path, proc))
         returncode = await proc.wait()
         del self._procs[task_id]
+        self.router.unregister_task(task_id)
         tail_task.cancel()
         try:
             await tail_task

@@ -1,0 +1,173 @@
+"""Sandbox exec + FS through the worker's TaskCommandRouter — the second
+data plane (reference modal_proto/task_command_router.proto:371-419,
+py/modal/sandbox.py:1930 Sandbox.exec, MockTaskCommandRouterServicer
+semantics incl. injected-UNAVAILABLE stdio resume, conftest.py:93-103)."""
+
+import pytest
+
+
+def _make_sandbox(modal_tpu, *args, **kwargs):
+    sb = modal_tpu.Sandbox.create(*args, **kwargs)
+    return sb
+
+
+def test_exec_basic(supervisor):
+    import modal_tpu
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30")
+    try:
+        p = sb.exec("sh", "-c", "echo out-line; echo err-line >&2; exit 3")
+        assert p.wait() == 3
+        assert p.stdout.read() == "out-line\n"
+        assert p.stderr.read() == "err-line\n"
+    finally:
+        sb.terminate()
+
+
+def test_exec_stdin_roundtrip(supervisor):
+    import modal_tpu
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30")
+    try:
+        p = sb.exec("cat")
+        p.stdin.write("hello ")
+        p.stdin.drain()
+        p.stdin.write(b"router")
+        p.stdin.write_eof()
+        p.stdin.drain()
+        assert p.wait() == 0
+        assert p.stdout.read() == "hello router"
+    finally:
+        sb.terminate()
+
+
+def test_exec_stdin_offset_dedupe(supervisor):
+    """Retried PutInput with an already-acked offset must not duplicate
+    bytes (reference stdin offset bookkeeping)."""
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.proto import api_pb2
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30")
+    try:
+        p = sb.exec("cat")
+        router = sb._router
+
+        async def _dup():
+            stub = await router.connect()
+            r1 = await stub.TaskExecPutInput(
+                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"abc", offset=0)
+            )
+            # duplicate retry of the same bytes: acked stays 3
+            r2 = await stub.TaskExecPutInput(
+                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"abc", offset=0)
+            )
+            # partial-overlap retry: only the new suffix lands
+            r3 = await stub.TaskExecPutInput(
+                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"bcdef", offset=1, eof=True)
+            )
+            return r1.acked_offset, r2.acked_offset, r3.acked_offset
+
+        a1, a2, a3 = synchronizer.run(_dup())
+        assert (a1, a2, a3) == (3, 3, 6)
+        assert p.wait() == 0
+        assert p.stdout.read() == "abcdef"
+    finally:
+        sb.terminate()
+
+
+def test_exec_stdio_resume_on_unavailable(supervisor):
+    """Injected UNAVAILABLE mid-stream: the client resumes from its acked
+    offset and the assembled output has no gaps or duplicates."""
+    import modal_tpu
+    from modal_tpu.server import task_router
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30")
+    try:
+        task_router.FAULTS["stdio_unavailable_every"] = 1  # every stream breaks once
+        task_router.FAULTS["_stdio_reads"] = 0
+        p = sb.exec("sh", "-c", "for i in $(seq 1 200); do echo line-$i; done")
+        assert p.wait() == 0
+        out = p.stdout.read()
+        assert out.splitlines() == [f"line-{i}" for i in range(1, 201)]
+    finally:
+        task_router.FAULTS["stdio_unavailable_every"] = 0
+        sb.terminate()
+
+
+def test_exec_poll_immediate(supervisor):
+    """poll() on a running exec returns None without blocking (timeout=0 is
+    honored exactly by the wait RPC)."""
+    import time
+
+    import modal_tpu
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30")
+    try:
+        p = sb.exec("sleep", "5")
+        t0 = time.monotonic()
+        assert p.poll() is None
+        assert time.monotonic() - t0 < 2.0, "poll must not block on a running process"
+    finally:
+        sb.terminate()
+
+
+def test_exec_workdir_and_env(supervisor, tmp_path):
+    import modal_tpu
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30")
+    try:
+        p = sb.exec("sh", "-c", "pwd; echo $EXEC_FLAVOR", workdir=str(tmp_path), env={"EXEC_FLAVOR": "tpu"})
+        assert p.wait() == 0
+        assert p.stdout.read().splitlines() == [str(tmp_path), "tpu"]
+    finally:
+        sb.terminate()
+
+
+def test_sandbox_fs_ops(supervisor, tmp_path):
+    import modal_tpu
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30", workdir=str(tmp_path))
+    try:
+        fs = sb.fs
+        fs.write_file("data/a.txt", "hello fs")
+        assert fs.read_text("data/a.txt") == "hello fs"
+        fs.append_file("data/a.txt", "!")
+        assert fs.read_text("data/a.txt") == "hello fs!"
+        entries = fs.ls("data")
+        assert [e.name for e in entries] == ["a.txt"] and not entries[0].is_dir
+        assert fs.exists("data/a.txt") and not fs.exists("data/b.txt")
+        st = fs.stat("data/a.txt")
+        assert st.size == 9
+        fs.cp("data/a.txt", "data/b.txt")
+        fs.mv("data/b.txt", "data/c.txt")
+        assert fs.exists("data/c.txt") and not fs.exists("data/b.txt")
+        fs.mkdir("sub/deep", parents=True)
+        assert fs.stat("sub/deep").is_dir
+        fs.rm("data", recursive=True)
+        assert not fs.exists("data")
+        # ranged read
+        fs.write_file("r.bin", b"0123456789")
+        assert fs.read_file("r.bin", offset=3, length=4) == b"3456"
+    finally:
+        sb.terminate()
+
+
+def test_sandbox_open_file_handle(supervisor, tmp_path):
+    import modal_tpu
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30", workdir=str(tmp_path))
+    try:
+        f = sb.open("notes.txt", "w")
+        f.write("line1\n")
+        f.write("line2\n")
+        f.close()
+        g = sb.open("notes.txt", "r")
+        assert g.read() == "line1\nline2\n"
+        g.seek(0)
+        assert g.read(5) == "line1"
+        g.close()
+        with pytest.raises(FileNotFoundError):
+            sb.open("missing.txt", "r")
+    finally:
+        sb.terminate()
