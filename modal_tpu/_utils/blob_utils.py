@@ -27,6 +27,44 @@ LARGE_FILE_LIMIT = 4 * 1024 * 1024
 # Multipart threshold + parallelism (reference blob_utils.py:54,46).
 MULTIPART_THRESHOLD = 1024 * 1024 * 1024
 MULTIPART_CONCURRENCY = 20
+# Inflight memory budget for map pumping / uploads (reference
+# blob_utils.py:57-59: min 256 MiB, max 2 GiB, <=50% of RAM).
+DEFAULT_BYTE_BUDGET = 256 * 1024 * 1024
+
+
+class _ByteBudget:
+    """Async byte-count backpressure (reference _ByteBudget,
+    blob_utils.py:66): acquire(n) blocks while the inflight total would
+    exceed the budget; release(n) frees it. A single item larger than the
+    whole budget is admitted alone rather than deadlocking."""
+
+    def __init__(self, budget: int = DEFAULT_BYTE_BUDGET, max_items: int = 0):
+        self._budget = budget
+        self._max_items = max_items  # 0 = unlimited
+        self._inflight_bytes = 0
+        self._inflight_items = 0
+        self._condition = asyncio.Condition()
+
+    def would_block(self, nbytes: int) -> bool:
+        return (self._inflight_bytes + nbytes > self._budget and self._inflight_items > 0) or bool(
+            self._max_items and self._inflight_items >= self._max_items
+        )
+
+    async def acquire(self, nbytes: int) -> None:
+        async with self._condition:
+            while (
+                (self._inflight_bytes + nbytes > self._budget and self._inflight_items > 0)
+                or (self._max_items and self._inflight_items >= self._max_items)
+            ):
+                await self._condition.wait()
+            self._inflight_bytes += nbytes
+            self._inflight_items += 1
+
+    async def release(self, nbytes: int) -> None:
+        async with self._condition:
+            self._inflight_bytes -= nbytes
+            self._inflight_items -= 1
+            self._condition.notify_all()
 
 _http_session: Optional["object"] = None
 _http_session_loop = None

@@ -197,8 +197,13 @@ class TaskRouterClient:
     async def fs_op(self, **kwargs) -> api_pb2.TaskFsOpResponse:
         stub = await self.connect()
         req = api_pb2.TaskFsOpRequest(task_id=self.task_id, **kwargs)
-        if kwargs.get("op") in ("append", "mv"):
-            # NOT idempotent: a retry after a lost response would append the
-            # bytes twice / fail a completed move — no transparent retries
+        op = kwargs.get("op")
+        non_idempotent = op in ("append", "mv", "rm") or (
+            op == "mkdir" and not kwargs.get("recursive")
+        )
+        if non_idempotent:
+            # a retry after a lost response would append bytes twice, fail a
+            # completed mv/rm with NOT_FOUND, or fail a completed mkdir with
+            # EEXIST — no transparent retries for these
             return await stub.TaskFsOp(req)
         return await retry_transient_errors(stub.TaskFsOp, req)

@@ -58,9 +58,13 @@ class _ExecStreamWriter:
         self._eof = True
 
     async def drain(self) -> None:
+        # buffer stays intact until the worker acks: a failed drain can be
+        # retried and the server's offset dedupe handles any overlap
         data = bytes(self._buffer)
-        self._buffer.clear()
-        self._offset = await self._router.put_input(self._exec_id, data, self._offset, self._eof)
+        acked = await self._router.put_input(self._exec_id, data, self._offset, self._eof)
+        consumed = max(0, acked - self._offset)
+        del self._buffer[:consumed]
+        self._offset = acked
 
 
 class _ContainerProcess:

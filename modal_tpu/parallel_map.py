@@ -5,6 +5,20 @@ with concurrent stages: input pump (`SyncInputPumper.pump_inputs`,
 parallel_map.py:173-215, batched FunctionPutInputs), output long-poll
 (`get_all_outputs`, parallel_map.py:446-522, last_entry_id cursor), blob
 fetch, ordered/unordered yield.
+
+Failure story (reference parallel_map.py:241,793 + blob_utils.py:66):
+- **Client-driven retries**: a failed output whose retry_count is under the
+  function's retry policy is NOT yielded — a retry-deadline queue re-submits
+  the input via FunctionRetryInputs after the policy's backoff delay.
+  (Container crashes are retried server-side; this path covers user-code
+  exceptions, exactly like the reference's retry queue.)
+- **Lost-input polling**: every LOST_INPUT_CHECK_PERIOD the client asks
+  MapCheckInputs which unfinished idxs the server no longer tracks and
+  re-pumps those (payloads for unfinished inputs are retained — bounded by
+  the byte budget).
+- **Byte-budgeted backpressure**: the pump admits at most
+  DEFAULT_BYTE_BUDGET inflight serialized bytes / MAX_INPUTS_OUTSTANDING
+  items; finished outputs release their input's budget.
 """
 
 from __future__ import annotations
@@ -15,12 +29,13 @@ import typing
 from typing import Any, AsyncGenerator, AsyncIterable, Iterable, Optional, Union
 
 from ._utils.async_utils import TaskContext, aclosing, queue_batch_iterator, synchronizer, sync_or_async_iter
-from ._utils.blob_utils import resolve_blob_data
+from ._utils.blob_utils import _ByteBudget, resolve_blob_data
 from ._utils.function_utils import OUTPUTS_TIMEOUT
 from ._utils.grpc_utils import retry_transient_errors
 from .config import logger
 from .exception import InvalidError
 from .proto import api_pb2
+from .retries import RetryManager
 from .serialization import deserialize_data_format, deserialize_exception
 
 if typing.TYPE_CHECKING:
@@ -30,6 +45,7 @@ if typing.TYPE_CHECKING:
 # puts, RESOURCE_EXHAUSTED-aware).
 MAP_INPUT_BATCH_SIZE = 100
 MAX_INPUTS_OUTSTANDING = 1000
+LOST_INPUT_CHECK_PERIOD = 30.0  # reference MapCheckInputs cadence
 
 
 async def _map_invocation(
@@ -61,8 +77,40 @@ async def _map_invocation(
     if function_call_id_out is not None:
         function_call_id_out.append(function_call_id)
 
+    # retry policy: user-code failures under max_retries are re-queued via
+    # FunctionRetryInputs with backoff (reference retry-deadline queue,
+    # parallel_map.py:241). Container crashes retry server-side.
+    retry_proto = None
+    if function._spec is not None:
+        retry_proto = function._spec.retry_policy_proto()
+    max_retries = retry_proto.retries if retry_proto is not None else 0
+    retry_mgr = RetryManager(retry_proto) if retry_proto is not None else None
+
     pump_done = asyncio.Event()
     inputs_sent = 0
+    # unfinished inputs: idx -> (item, nbytes). Bounded by the byte budget;
+    # needed for retries (input_id comes back on the failed output) and for
+    # lost-input re-pump.
+    unfinished: dict[int, tuple[api_pb2.FunctionPutInputsItem, int]] = {}
+    finalized: set[int] = set()
+    pending_retries = 0
+    retry_errors: list[BaseException] = []
+    # backpressure only applies when outputs are consumed — spawn_map never
+    # polls outputs, so nothing would ever release the budget
+    budget = _ByteBudget(max_items=MAX_INPUTS_OUTSTANDING) if wait_for_outputs else None
+    grpc = __import__("grpc")
+
+    async def _put_batch(batch: list[api_pb2.FunctionPutInputsItem]) -> None:
+        req = api_pb2.FunctionPutInputsRequest(
+            function_id=function.object_id, function_call_id=function_call_id, inputs=batch
+        )
+        await retry_transient_errors(
+            stub.FunctionPutInputs,
+            req,
+            max_retries=8,
+            max_delay=15.0,
+            additional_status_codes=[grpc.StatusCode.RESOURCE_EXHAUSTED],
+        )
 
     async def pump_inputs() -> None:
         nonlocal inputs_sent
@@ -71,19 +119,11 @@ async def _map_invocation(
         batch: list[api_pb2.FunctionPutInputsItem] = []
 
         async def _flush() -> None:
-            nonlocal batch
+            nonlocal batch, inputs_sent
             if not batch:
                 return
-            req = api_pb2.FunctionPutInputsRequest(
-                function_id=function.object_id, function_call_id=function_call_id, inputs=batch
-            )
-            await retry_transient_errors(
-                stub.FunctionPutInputs,
-                req,
-                max_retries=8,
-                max_delay=15.0,
-                additional_status_codes=[__import__("grpc").StatusCode.RESOURCE_EXHAUSTED],
-            )
+            await _put_batch(batch)
+            inputs_sent += len(batch)
             batch = []
 
         idx = 0
@@ -93,9 +133,17 @@ async def _map_invocation(
                     item = await _create_input(
                         args, kwargs, stub, idx=idx, method_name=function._use_method_name
                     )
+                    nbytes = len(item.input.args) if item.input.WhichOneof("args_oneof") == "args" else 64
+                    if budget is not None:
+                        if batch and budget.would_block(nbytes):
+                            # flush first so inflight inputs can produce
+                            # outputs and release budget — an unflushed
+                            # batch can't drain
+                            await _flush()
+                        await budget.acquire(nbytes)
+                        unfinished[idx] = (item, nbytes)
                     batch.append(item)
                     idx += 1
-                    inputs_sent = idx
                     if len(batch) >= MAP_INPUT_BATCH_SIZE:
                         await _flush()
             await _flush()
@@ -103,12 +151,66 @@ async def _map_invocation(
             # Always unblock the poll loop — on pump failure it drains what
             # was sent, then `await pump_task` surfaces the error instead of
             # the caller hanging in the output long-poll.
-            inputs_sent = idx - len(batch)
             pump_done.set()
 
-    async def poll_outputs() -> AsyncGenerator[tuple[int, Any], None]:
+    async def _finalize(idx: int) -> None:
+        finalized.add(idx)
+        entry = unfinished.pop(idx, None)
+        if entry is not None and budget is not None:
+            await budget.release(entry[1])
+
+    async def _schedule_retry(tc: TaskContext, item: api_pb2.FunctionGetOutputsItem) -> None:
+        """Retry-deadline queue, one deadline per failed input."""
+        nonlocal pending_retries
+        pending_retries += 1
+        next_count = item.retry_count + 1
+        delay = retry_mgr.attempt_delay(next_count) if retry_mgr is not None else 0.0
+
+        async def _fire(input_id: str = item.input_id, count: int = next_count) -> None:
+            nonlocal pending_retries
+            try:
+                if delay:
+                    await asyncio.sleep(delay)
+                await retry_transient_errors(
+                    stub.FunctionRetryInputs,
+                    api_pb2.FunctionRetryInputsRequest(
+                        function_call_jwt=function_call_id,
+                        inputs=[api_pb2.FunctionRetryInputsItem(input_id=input_id, retry_count=count)],
+                    ),
+                )
+            except BaseException as exc:  # noqa: BLE001
+                # a failed re-submission means the input will never produce
+                # another output — surface it instead of hanging the map
+                retry_errors.append(exc)
+                raise
+            finally:
+                pending_retries -= 1
+
+        tc.create_task(_fire())
+
+    async def check_lost_inputs() -> None:
+        """Periodic MapCheckInputs: re-pump inputs the server forgot
+        (reference parallel_map.py:793)."""
+        while True:
+            await asyncio.sleep(LOST_INPUT_CHECK_PERIOD)
+            idxs = [i for i in unfinished.keys() if i not in finalized]
+            if not idxs:
+                continue
+            try:
+                resp = await retry_transient_errors(
+                    stub.MapCheckInputs,
+                    api_pb2.MapCheckInputsRequest(function_call_id=function_call_id, idxs=idxs),
+                )
+            except Exception as exc:  # noqa: BLE001 — advisory check
+                logger.debug(f"MapCheckInputs failed: {exc}")
+                continue
+            lost = [unfinished[i][0] for i in resp.lost_idxs if i in unfinished]
+            if lost:
+                logger.warning(f"re-submitting {len(lost)} lost map inputs")
+                await _put_batch(lost)
+
+    async def poll_outputs(tc: TaskContext) -> AsyncGenerator[tuple[int, Any], None]:
         last_entry_id = ""
-        received = 0
         while True:
             resp = await retry_transient_errors(
                 stub.FunctionGetOutputs,
@@ -125,10 +227,22 @@ async def _map_invocation(
             )
             last_entry_id = resp.last_entry_id or last_entry_id
             for item in resp.outputs:
-                received += 1
+                if item.idx in finalized:
+                    continue  # stale output from a retried attempt
+                retryable = (
+                    item.result.status
+                    in (api_pb2.GENERIC_STATUS_FAILURE, api_pb2.GENERIC_STATUS_INTERNAL_FAILURE)
+                    and item.retry_count < max_retries
+                )
+                if retryable:
+                    await _schedule_retry(tc, item)
+                    continue
+                await _finalize(item.idx)
                 value = await _decode_output(item, stub, client, return_exceptions)
                 yield item.idx, value
-            if pump_done.is_set() and received >= inputs_sent:
+            if retry_errors:
+                raise retry_errors[0]
+            if pump_done.is_set() and len(finalized) >= inputs_sent and pending_retries == 0 and not unfinished:
                 return
             if pump_task.done() and pump_task.exception() is not None:
                 raise pump_task.exception()
@@ -138,17 +252,21 @@ async def _map_invocation(
         if not wait_for_outputs:
             await pump_task
             return
-        if order_outputs:
-            buffer: dict[int, Any] = {}
-            next_idx = 0
-            async for idx, value in poll_outputs():
-                buffer[idx] = value
-                while next_idx in buffer:
-                    yield buffer.pop(next_idx)
-                    next_idx += 1
-        else:
-            async for _idx, value in poll_outputs():
-                yield value
+        checker_task = tc.create_task(check_lost_inputs())
+        try:
+            if order_outputs:
+                buffer: dict[int, Any] = {}
+                next_idx = 0
+                async for idx, value in poll_outputs(tc):
+                    buffer[idx] = value
+                    while next_idx in buffer:
+                        yield buffer.pop(next_idx)
+                        next_idx += 1
+            else:
+                async for _idx, value in poll_outputs(tc):
+                    yield value
+        finally:
+            checker_task.cancel()
         # surface pump errors (e.g. serialization failures)
         await pump_task
 

@@ -80,6 +80,7 @@ _RPC_NAMES = [
     "FunctionMap",
     "FunctionPutInputs",
     "FunctionRetryInputs",
+    "MapCheckInputs",
     "FunctionGetOutputs",
     "FunctionCallGetData",
     "FunctionCallPutData",

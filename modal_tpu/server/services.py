@@ -439,13 +439,33 @@ class ModalTPUServicer:
                 continue
             old.status = "pending"
             old.retry_count = item.retry_count
-            old.input.CopyFrom(item.input)
-            fn.pending.append(old.input_id)
+            if item.input.WhichOneof("args_oneof"):  # payload resend optional
+                old.input.CopyFrom(item.input)
+            old.delivered_to.clear()
+            old.claimed_by = ""
+            old.claimed_at = 0.0
+            if old.input_id not in fn.pending:
+                fn.pending.append(old.input_id)
             jwts.append(old.input_id)
         async with fn.input_condition:
             fn.input_condition.notify_all()
         self.s.schedule_event.set()
         return api_pb2.FunctionRetryInputsResponse(input_jwts=jwts)
+
+    async def MapCheckInputs(self, request: api_pb2.MapCheckInputsRequest, context) -> api_pb2.MapCheckInputsResponse:
+        """Which of the caller's unfinished idxs does the server no longer
+        track? (reference MapCheckInputs, parallel_map.py:793 — the client
+        re-submits lost inputs)."""
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        known_idxs = set()
+        for iid in call.input_ids:
+            inp = self.s.inputs.get(iid)
+            if inp is not None:
+                known_idxs.add(inp.idx)
+        lost = [idx for idx in request.idxs if idx not in known_idxs]
+        return api_pb2.MapCheckInputsResponse(lost_idxs=lost)
 
     async def FunctionGetOutputs(self, request: api_pb2.FunctionGetOutputsRequest, context) -> api_pb2.FunctionGetOutputsResponse:
         call = self.s.function_calls.get(request.function_call_id)

@@ -1,0 +1,166 @@
+"""Map-plane failure hardening (reference parallel_map.py:241,793 +
+blob_utils.py:66): client-driven retries of user exceptions, container-death
+recovery mid-.map(), lost-input re-pump, byte-budget backpressure."""
+
+import os
+import time
+
+import pytest
+
+
+def test_map_retries_user_exception(supervisor, tmp_path):
+    """A user exception under the retry policy is retried via
+    FunctionRetryInputs (client retry-deadline queue), not yielded."""
+    import modal_tpu
+
+    app = modal_tpu.App("map-retry")
+    attempts_dir = str(tmp_path / "attempts")
+    os.makedirs(attempts_dir)
+
+    def flaky(x):
+        # fail the first attempt of every input, succeed on retry
+        marker = os.path.join(attempts_dir, str(x))
+        with open(marker, "a") as f:
+            f.write("x")
+        if os.path.getsize(marker) == 1:
+            raise ValueError(f"transient {x}")
+        return x * 10
+
+    f = app.function(
+        serialized=True, retries=modal_tpu.Retries(max_retries=2, initial_delay=0.1)
+    )(flaky)
+    with app.run():
+        results = list(f.map([1, 2, 3]))
+    assert results == [10, 20, 30]
+    # every input ran exactly twice (one failure + one retry)
+    assert sorted(os.path.getsize(os.path.join(attempts_dir, str(x))) for x in (1, 2, 3)) == [2, 2, 2]
+
+
+def test_map_retries_exhausted_raises(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("map-exhaust")
+
+    def always_fails(x):
+        raise RuntimeError(f"perma {x}")
+
+    f = app.function(
+        serialized=True, retries=modal_tpu.Retries(max_retries=1, initial_delay=0.1)
+    )(always_fails)
+    with app.run():
+        with pytest.raises(RuntimeError, match="perma"):
+            list(f.map([1, 2]))
+        # return_exceptions collects them instead
+        outs = list(f.map([1], return_exceptions=True))
+        assert len(outs) == 1 and isinstance(outs[0], RuntimeError)
+
+
+def test_map_survives_container_kill(supervisor):
+    """SIGKILL a container mid-.map(): the server retries its claimed inputs
+    on a replacement container and the map still completes."""
+    import modal_tpu
+
+    app = modal_tpu.App("map-kill")
+
+    def slowish(x):
+        import time as _t
+
+        _t.sleep(0.5)
+        return os.getpid(), x * 2
+
+    f = app.function(serialized=True, retries=1, max_containers=1)(slowish)
+    with app.run():
+        gen = f.map(list(range(6)), order_outputs=False)
+        first_pid, first_val = next(gen)  # a container is live and working
+        # kill the container process out from under the worker
+        worker = supervisor.workers[0]
+        assert worker._procs, "expected a live container"
+        for proc in list(worker._procs.values()):
+            proc.kill()
+        rest = list(gen)
+    values = sorted([first_val] + [v for _pid, v in rest])
+    assert values == [0, 2, 4, 6, 8, 10], "all inputs must complete despite the kill"
+    assert any(pid != first_pid for pid, _v in rest), "a replacement container took over"
+
+
+def test_map_lost_input_repump(supervisor, monkeypatch):
+    """An input the server forgot (MapCheckInputs reports it lost) is
+    re-submitted by the client's checker."""
+    import modal_tpu
+    from modal_tpu import parallel_map
+
+    monkeypatch.setattr(parallel_map, "LOST_INPUT_CHECK_PERIOD", 1.0)
+    app = modal_tpu.App("map-lost")
+
+    def work(x):
+        import time as _t
+
+        _t.sleep(0.3)
+        return x + 100
+
+    f = app.function(serialized=True, max_containers=1)(work)
+    with app.run():
+        gen = f.map(list(range(5)), order_outputs=False)
+        got = [next(gen)]  # processing started
+        # drop a still-pending input from server state entirely
+        state = supervisor.state
+        fn_state = next(iter(state.functions.values()))
+        dropped = None
+        for iid in list(fn_state.pending):
+            inp = state.inputs.get(iid)
+            if inp is not None and inp.status == "pending":
+                dropped = inp
+                fn_state.pending.remove(iid)
+                del state.inputs[iid]
+                break
+        assert dropped is not None, "expected a pending input to drop"
+        got.extend(gen)
+    assert sorted(got) == [100, 101, 102, 103, 104]
+
+
+def test_spawn_map_exceeds_outstanding_cap(supervisor):
+    """spawn_map never polls outputs, so it must bypass the byte budget —
+    more inputs than MAX_INPUTS_OUTSTANDING must not deadlock."""
+    import modal_tpu
+    from modal_tpu.parallel_map import MAX_INPUTS_OUTSTANDING
+
+    app = modal_tpu.App("map-spawn-big")
+
+    def ident(x):
+        return x
+
+    f = app.function(serialized=True)(ident)
+    n = MAX_INPUTS_OUTSTANDING + 50
+    with app.run():
+        call = f.spawn_map(range(n))
+        assert call.object_id.startswith("fc-")
+
+
+def test_byte_budget_backpressure():
+    """_ByteBudget blocks when the budget is exceeded and admits oversized
+    single items alone (no deadlock)."""
+    import asyncio
+
+    from modal_tpu._utils.blob_utils import _ByteBudget
+
+    async def _run():
+        b = _ByteBudget(budget=100, max_items=3)
+        await b.acquire(60)
+        assert b.would_block(60)
+        acquired = asyncio.Event()
+
+        async def second():
+            await b.acquire(60)
+            acquired.set()
+
+        t = asyncio.create_task(second())
+        await asyncio.sleep(0.05)
+        assert not acquired.is_set(), "second acquire must block over budget"
+        await b.release(60)
+        await asyncio.wait_for(acquired.wait(), 1.0)
+        await b.release(60)
+        # oversized single item admitted when nothing is inflight
+        await asyncio.wait_for(b.acquire(10_000), 1.0)
+        await b.release(10_000)
+
+    asyncio.run(_run())
