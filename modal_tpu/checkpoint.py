@@ -71,11 +71,18 @@ class _VolumeCheckpointer:
         path = path.strip("/")
         flat = _tree_flatten_with_paths(tree)
         treedef = jax.tree_util.tree_structure(tree)
-        is_writer = jax.process_count() == 1 or jax.process_index() == 0
+        # process topology WITHOUT jax.process_count()/process_index(): those
+        # force backend initialization — a collective gloo setup that hangs
+        # 30s and fails if a gang peer already died (and is pure overhead for
+        # non-jax trees)
+        num_processes, process_id = _process_topology()
+        is_writer = num_processes == 1 or process_id == 0
         manifest = {"format": 1, "treedef": str(treedef), "leaves": []}
+        wrote_shards = False
         async with self._volume.batch_upload(force=True) as batch:
             for i, (leaf_path, leaf) in enumerate(flat):
                 if _use_shard_format(leaf, shard_leaves_over):
+                    wrote_shards = True
                     # Sharded across processes: every process writes ONLY the
                     # shards whose replica-0 copy it holds — no host ever
                     # materializes the global array (SURVEY §7 hard part 6).
@@ -120,8 +127,9 @@ class _VolumeCheckpointer:
                     }
                 manifest["leaves"].append({"index": i, "path": leaf_path, **meta})
         # barrier: every process's shard uploads must be flushed (the batch
-        # context above awaits them) before the manifest becomes visible
-        if jax.process_count() > 1:
+        # context above awaits them) before the manifest becomes visible.
+        # Only needed when multiple processes actually wrote shard files.
+        if num_processes > 1 and wrote_shards:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(f"modal_tpu_ckpt_save:{path}")
@@ -261,6 +269,22 @@ class _VolumeCheckpointer:
             return True
         except NotFoundError:
             return False
+
+
+def _process_topology() -> tuple[int, int]:
+    """(num_processes, process_id) from the distributed client state —
+    available without initializing any jax backend."""
+    try:
+        from jax._src import distributed
+
+        st = distributed.global_state
+        if st.client is None:
+            return 1, 0
+        return int(st.num_processes or 1), int(st.process_id or 0)
+    except Exception:  # pragma: no cover — private-API drift fallback
+        import jax
+
+        return jax.process_count(), jax.process_index()
 
 
 def _use_shard_format(leaf: Any, shard_leaves_over: Optional[int]) -> bool:

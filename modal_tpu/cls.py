@@ -55,16 +55,19 @@ class _Obj:
         assert service is not None
         if not service.is_hydrated:
             await service.hydrate()
-        if not self._args and not self._kwargs:
+        options = self._cls._options
+        if not self._args and not self._kwargs and options is None:
             self._bound_function = service
         else:
-            resp = await retry_transient_errors(
-                service.client.stub.FunctionBindParams,
-                api_pb2.FunctionBindParamsRequest(
-                    function_id=service.object_id,
-                    serialized_params=serialize((self._args, self._kwargs)),
+            req = api_pb2.FunctionBindParamsRequest(
+                function_id=service.object_id,
+                serialized_params=(
+                    serialize((self._args, self._kwargs)) if (self._args or self._kwargs) else b""
                 ),
             )
+            if options is not None:
+                req.options.CopyFrom(options)
+            resp = await retry_transient_errors(service.client.stub.FunctionBindParams, req)
             bound = _Function._new_hydrated(resp.bound_function_id, service.client, resp.handle_metadata)
             self._bound_function = bound
         return self._bound_function
@@ -138,11 +141,13 @@ class _Cls(_Object, type_prefix="cs"):
     _method_partials: dict[str, _PartialFunction] = {}
     _app: Optional["_App"] = None
     _name: Optional[str] = None
+    _options: Optional[api_pb2.FunctionOptions] = None
 
     def _initialize_from_empty(self) -> None:
         self._user_cls = None
         self._service_function = None
         self._method_partials = {}
+        self._options = None
 
     def _hydrate_metadata(self, metadata: Optional[api_pb2.ClassHandleMetadata]) -> None:
         pass
@@ -231,6 +236,41 @@ class _Cls(_Object, type_prefix="cs"):
         obj = _Cls.from_name(app_name, name)
         await obj.hydrate(client)
         return obj
+
+    def with_options(
+        self,
+        *,
+        min_containers: Optional[int] = None,
+        max_containers: Optional[int] = None,
+        buffer_containers: Optional[int] = None,
+        scaledown_window: Optional[int] = None,
+        timeout: Optional[int] = None,
+        tpu: Optional[str] = None,
+        retries: Optional[Any] = None,
+        max_concurrent_inputs: Optional[int] = None,
+        secrets: Sequence[Any] = (),
+    ) -> "_Cls":
+        """A variant of this class with rebinding-time overrides (reference
+        cls.py:722 `with_options`): instances bind through FunctionBindParams
+        carrying the overrides, so the variant gets its own containers with
+        the adjusted autoscaler/resources/timeout/retries."""
+        import copy
+
+        from .functions import build_function_options
+
+        new_cls = copy.copy(self)
+        new_cls._options = build_function_options(
+            min_containers=min_containers,
+            max_containers=max_containers,
+            buffer_containers=buffer_containers,
+            scaledown_window=scaledown_window,
+            timeout=timeout,
+            tpu=tpu,
+            retries=retries,
+            max_concurrent_inputs=max_concurrent_inputs,
+            secrets=secrets,
+        )
+        return new_cls
 
     def __call__(self, *args: Any, **kwargs: Any) -> _Obj:
         """Instantiate: returns an _Obj binding constructor params."""

@@ -42,6 +42,52 @@ from .schedule import Schedule, SchedulerPlacement
 from .serialization import deserialize, deserialize_data_format, deserialize_exception, serialize
 from .tpu_config import TPUSliceSpec, parse_tpu_config
 
+def build_function_options(
+    *,
+    min_containers: Optional[int] = None,
+    max_containers: Optional[int] = None,
+    buffer_containers: Optional[int] = None,
+    scaledown_window: Optional[int] = None,
+    timeout: Optional[int] = None,
+    tpu: Optional[str] = None,
+    retries: Optional[Any] = None,
+    max_concurrent_inputs: Optional[int] = None,
+    secrets: Sequence[Any] = (),
+) -> api_pb2.FunctionOptions:
+    """FunctionOptions proto for `with_options` rebinding (shared by
+    Function and Cls). Only fields the caller passed are present — the
+    server merges them over the parent definition."""
+    opts = api_pb2.FunctionOptions()
+    if min_containers is not None:
+        opts.min_containers = min_containers
+    if max_containers is not None:
+        opts.max_containers = max_containers
+    if buffer_containers is not None:
+        opts.buffer_containers = buffer_containers
+    if scaledown_window is not None:
+        opts.scaledown_window = scaledown_window
+    if timeout is not None:
+        opts.timeout_secs = timeout
+    if tpu is not None:
+        from .tpu_config import parse_tpu_config
+
+        spec = parse_tpu_config(tpu)
+        if spec is not None:
+            opts.has_tpu = True
+            opts.tpu_config.CopyFrom(spec.to_proto())
+    if retries is not None:
+        policy = Retries(max_retries=retries) if isinstance(retries, int) else retries
+        opts.has_retry_policy = True
+        opts.retry_policy.CopyFrom(policy.to_proto())
+    if max_concurrent_inputs is not None:
+        opts.max_concurrent_inputs = max_concurrent_inputs
+    if secrets:
+        opts.replace_secrets = True
+        for s in secrets:
+            opts.secret_ids.append(s.object_id)
+    return opts
+
+
 if typing.TYPE_CHECKING:
     from .app import _App
     from .image import _Image
@@ -280,6 +326,54 @@ class _Function(_Object, type_prefix="fu"):
         obj = _Function.from_name(app_name, name)
         await obj.hydrate(client)
         return obj
+
+    def with_options(
+        self,
+        *,
+        min_containers: Optional[int] = None,
+        max_containers: Optional[int] = None,
+        buffer_containers: Optional[int] = None,
+        scaledown_window: Optional[int] = None,
+        timeout: Optional[int] = None,
+        tpu: Optional[str] = None,
+        retries: Optional[Any] = None,
+        max_concurrent_inputs: Optional[int] = None,
+        secrets: Sequence[Any] = (),
+    ) -> "_Function":
+        """A variant of this function with rebinding-time overrides —
+        autoscaler, resources, timeout, retries — without redefining it
+        (reference `with_options`, _function_variants.py / _functions.py:1526).
+        The variant is created server-side at hydration via
+        FunctionBindParams."""
+        opts = build_function_options(
+            min_containers=min_containers,
+            max_containers=max_containers,
+            buffer_containers=buffer_containers,
+            scaledown_window=scaledown_window,
+            timeout=timeout,
+            tpu=tpu,
+            retries=retries,
+            max_concurrent_inputs=max_concurrent_inputs,
+            secrets=secrets,
+        )
+        parent = self
+
+        async def _load(self: "_Function", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+            if not parent.is_hydrated:
+                await resolver.load(parent, context)
+            resp = await retry_transient_errors(
+                parent.client.stub.FunctionBindParams,
+                api_pb2.FunctionBindParamsRequest(function_id=parent.object_id, options=opts),
+            )
+            self._hydrate(resp.bound_function_id, parent.client, resp.handle_metadata)
+
+        fn = _Function._from_loader(
+            _load, f"{self._rep}.with_options(...)", hydrate_lazily=True, deps=lambda: [parent]
+        )
+        fn._spec = self._spec
+        fn._info = self._info
+        fn._is_generator = self._is_generator
+        return fn
 
     # ------------------------------------------------------------------
     # Properties

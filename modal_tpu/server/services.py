@@ -335,6 +335,29 @@ class ModalTPUServicer:
         bound_id = make_id("fu")
         bound_def = api_pb2.Function()
         bound_def.CopyFrom(parent.definition)
+        # with_options variant: MERGE rebind-time overrides — only fields the
+        # caller passed change; everything else keeps the parent's values
+        # (reference _function_variants.py semantics)
+        opts = request.options
+        if opts.HasField("min_containers"):
+            bound_def.autoscaler_settings.min_containers = opts.min_containers
+        if opts.HasField("max_containers"):
+            bound_def.autoscaler_settings.max_containers = opts.max_containers
+        if opts.HasField("buffer_containers"):
+            bound_def.autoscaler_settings.buffer_containers = opts.buffer_containers
+        if opts.HasField("scaledown_window"):
+            bound_def.autoscaler_settings.scaledown_window = opts.scaledown_window
+        if opts.HasField("timeout_secs"):
+            bound_def.timeout_secs = opts.timeout_secs
+        if opts.has_tpu:
+            bound_def.resources.tpu_config.CopyFrom(opts.tpu_config)  # tpu ONLY
+        if opts.has_retry_policy:
+            bound_def.retry_policy.CopyFrom(opts.retry_policy)
+        if opts.HasField("max_concurrent_inputs"):
+            bound_def.max_concurrent_inputs = opts.max_concurrent_inputs
+        if opts.replace_secrets:
+            del bound_def.secret_ids[:]
+            bound_def.secret_ids.extend(opts.secret_ids)
         bound = FunctionState(
             function_id=bound_id,
             app_id=parent.app_id,
@@ -719,6 +742,17 @@ class ModalTPUServicer:
             call = self.s.function_calls.get(item.function_call_id)
             if call is None:
                 continue
+            if (
+                pushing_task is not None
+                and pushing_task.preempted
+                and item.result.status == api_pb2.GENERIC_STATUS_SUCCESS
+            ):
+                # a gang-preempted task pushes void results: its input was
+                # already re-queued for a replacement gang, and a stale
+                # SUCCESS would complete the call with partial work.
+                # (Only .preempted — plain terminate also covers app drain,
+                # where concurrent calls' successes are still valid.)
+                continue
             if pushing_task is not None:
                 # stamp before dedup: every rank's first push counts as its
                 # first output (cold-start attribution for gang members)
@@ -788,7 +822,9 @@ class ModalTPUServicer:
             if request.result.status == api_pb2.GENERIC_STATUS_SUCCESS:
                 task.state = api_pb2.TASK_STATE_COMPLETED
             else:
-                task.state = api_pb2.TASK_STATE_FAILED
+                task.state = (
+                    api_pb2.TASK_STATE_PREEMPTED if task.preempted else api_pb2.TASK_STATE_FAILED
+                )
                 await self._fail_claimed_inputs(task, request.result)
                 if request.result.status == api_pb2.GENERIC_STATUS_INIT_FAILURE:
                     # containers that die before serving (image build failed,
@@ -838,6 +874,7 @@ class ModalTPUServicer:
                 peer = self.s.tasks.get(peer_id)
                 if peer is not None and peer_id != task.task_id and not peer.terminate:
                     peer.terminate = True
+                    peer.preempted = True  # surfaced as TASK_STATE_PREEMPTED
                     worker = self.s.workers.get(peer.worker_id)
                     if worker is not None:
                         await worker.events.put(

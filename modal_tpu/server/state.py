@@ -120,6 +120,7 @@ class TaskState_:
     last_heartbeat: float = 0.0
     cancelled_input_ids: list[str] = field(default_factory=list)
     terminate: bool = False
+    preempted: bool = False  # torn down because a gang peer died
     result: Optional[api_pb2.GenericResult] = None
     tpu_chip_ids: list[int] = field(default_factory=list)
     container_address: str = ""

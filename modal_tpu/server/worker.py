@@ -82,6 +82,7 @@ class WorkerAgent:
         self._channel = None
         self._stub: Optional[ModalTPUStub] = None
         self._tasks: list[asyncio.Task] = []
+        self._escalations: set[asyncio.Task] = set()
         self._stopped = False
 
     async def start(self) -> None:
@@ -203,7 +204,27 @@ class WorkerAgent:
             try:
                 proc.terminate()
             except ProcessLookupError:
-                pass
+                return
+            # escalate: a container stuck in user code (native collective,
+            # non-cancellable thread) must still die so e.g. a replacement
+            # gang can schedule — SIGKILL after the grace window
+            grace = float(os.environ.get("MODAL_TPU_STOP_GRACE", "10"))
+
+            async def _escalate(p=proc, task_id=stop.task_id) -> None:
+                try:
+                    await asyncio.wait_for(p.wait(), timeout=grace)
+                except asyncio.TimeoutError:
+                    logger.warning(f"task {task_id} ignored SIGTERM for {grace}s; killing")
+                    try:
+                        p.kill()
+                    except ProcessLookupError:
+                        pass
+
+            # strong reference: a bare create_task could be GC'd mid-grace
+            # and the SIGKILL would never fire
+            esc = asyncio.create_task(_escalate())
+            self._escalations.add(esc)
+            esc.add_done_callback(self._escalations.discard)
 
     async def _materialize_image(self, image_id: str):
         """Build (or reuse) the task's image; returns BuiltImage or None for

@@ -115,3 +115,77 @@ def test_function_concurrent_inputs(supervisor):
         # 4 × 0.5s sequentially would be ≥2s even before overhead; concurrent
         # execution in one container (or scale-out) must beat that
         assert elapsed < 3.5, f"concurrency not effective: {elapsed:.1f}s"
+
+
+def test_cls_parametrized_bind_e2e(supervisor):
+    """Constructor params flow through FunctionBindParams into the container;
+    each parameterization gets its own warm container (reference cls.py:447,
+    _type_manager.py:20 — VERDICT r1 item 7)."""
+    import modal_tpu
+
+    app = modal_tpu.App("cls-bind")
+
+    @app.cls(serialized=True)
+    class Multiplier:
+        def __init__(self, factor=1):
+            self.factor = factor
+
+        @modal_tpu.method()
+        def mul(self, x):
+            import os
+
+            return self.factor * x, os.getpid()
+
+    with app.run():
+        m2 = Multiplier(factor=2)
+        m5 = Multiplier(5)
+        r2, pid2 = m2.mul.remote(10)
+        r5, pid5 = m5.mul.remote(10)
+        r2b, pid2b = m2.mul.remote(3)
+    assert (r2, r5, r2b) == (20, 50, 6)
+    assert pid2 != pid5, "parameterizations must get separate containers"
+    assert pid2 == pid2b, "same parameterization reuses its warm container"
+
+
+def test_cls_with_options(supervisor):
+    """with_options rebinds autoscaler/timeout at lookup time without
+    redefining the class (reference cls.py:722, _function_variants.py)."""
+    import modal_tpu
+
+    app = modal_tpu.App("cls-opts")
+
+    @app.cls(serialized=True)
+    class Greeter:
+        def __init__(self, name="x"):
+            self.name = name
+
+        @modal_tpu.method()
+        def hello(self):
+            return f"hi {self.name}"
+
+    with app.run():
+        Variant = Greeter.with_options(max_containers=3, timeout=123, retries=2)
+        assert Variant(name="opt").hello.remote() == "hi opt"
+        # base class unaffected
+        assert Greeter(name="base").hello.remote() == "hi base"
+        bound = [f for f in supervisor.state.functions.values() if f.bound_parent]
+        variant_defs = [f.definition for f in bound if f.definition.timeout_secs == 123]
+        assert variant_defs, "with_options variant must exist server-side"
+        assert variant_defs[0].autoscaler_settings.max_containers == 3
+        assert variant_defs[0].retry_policy.retries == 2
+
+
+def test_function_with_options(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("fn-opts")
+
+    def double(x):
+        return x * 2
+
+    f = app.function(serialized=True, timeout=300)(double)
+    with app.run():
+        fv = f.with_options(timeout=77, max_containers=2)
+        assert fv.remote(21) == 42
+        bound = [fn for fn in supervisor.state.functions.values() if fn.bound_parent]
+        assert any(fn.definition.timeout_secs == 77 for fn in bound)

@@ -108,3 +108,73 @@ def test_clustered_jax_distributed_psum(supervisor):
         assert out["process_count"] == 2, out
         assert out["global_devices"] >= 2
         assert out["sum"] == 3.0 * out["global_devices"]
+
+
+def test_gang_elastic_recovery(supervisor, tmp_path):
+    """Elastic slice recovery (SURVEY §5, net-new): rank 1 dies mid-training
+    → the whole gang tears down (peers surfaced PREEMPTED) → the input
+    re-queues → a REPLACEMENT gang with a fresh coordinator re-rendezvouses,
+    re-runs jax.distributed.initialize, restores the Volume checkpoint, and
+    finishes the work."""
+    import modal_tpu
+    from modal_tpu.proto import api_pb2
+
+    app = modal_tpu.App("gang-elastic")
+    crash_marker = str(tmp_path / "crashed-once")
+
+    @app.function(serialized=True, retries=1, timeout=180)
+    @modal_tpu.clustered(size=2)
+    def train(total_steps):
+        import os
+        import time as _t
+
+        import modal_tpu as mt
+        from modal_tpu import get_cluster_info
+        from modal_tpu.checkpoint import VolumeCheckpointer
+
+        info = get_cluster_info()
+        vol = mt.Volume.from_name("gang-elastic-ckpt", create_if_missing=True)
+        vol.hydrate()
+        ckpt = VolumeCheckpointer(vol)
+
+        # resume point: the volume checkpoint written by the previous gang
+        if ckpt.exists("train/state"):
+            vol.reload()
+            start_step = int(ckpt.restore("train/state")["step"][0])
+        else:
+            start_step = 0
+
+        step = start_step
+        while step < total_steps:
+            _t.sleep(0.2)  # a "training step"
+            step += 1
+            if info.rank == 0:
+                import numpy as np
+
+                ckpt.save("train/state", {"step": np.array([step])})
+            if step == 1 and info.rank == 1 and not os.path.exists(crash_marker):
+                open(crash_marker, "w").write("x")
+                os._exit(1)  # simulated preemption mid-run
+            if step == 1 and info.rank == 0 and not os.path.exists(crash_marker + ".seen"):
+                # first gang's rank 0: linger so the teardown (not a clean
+                # SUCCESS) is what ends this attempt
+                open(crash_marker + ".seen", "w").write("x")
+                _t.sleep(60)
+        return {"rank": info.rank, "start_step": start_step, "end_step": step,
+                "coordinator": info.coordinator_address}
+
+    with app.run():
+        out = train.remote(3)
+    # the SUCCESSFUL attempt resumed from the checkpoint, not from zero
+    assert out["start_step"] == 1, out
+    assert out["end_step"] == 3
+    assert os.path.exists(crash_marker), "rank 1 must have crashed once"
+    # two gangs were formed, with distinct coordinators (fresh rendezvous)
+    clusters = list(supervisor.state.clusters.values())
+    assert len(clusters) == 2, "a replacement gang must have been scheduled"
+    assert clusters[0].coordinator_port != clusters[1].coordinator_port or (
+        clusters[0].cluster_id != clusters[1].cluster_id
+    )
+    # the surviving peer of the dead gang is surfaced as PREEMPTED
+    states = [t.state for t in supervisor.state.tasks.values()]
+    assert api_pb2.TASK_STATE_PREEMPTED in states, states
