@@ -277,7 +277,15 @@ def forward(
     if cache is None:
         # mask=None = "pure causal, 0-aligned" per the attn_impl contract:
         # lets flash/ring impls use their internal causal masking (the pallas
-        # kernel never materializes the [S, S] mask in HBM)
+        # kernel never materializes the [S, S] mask in HBM).
+        # Default attention for the no-cache (training / full prefill) path
+        # is the flash kernel — pallas forward+backward on TPU, einsum
+        # fallback elsewhere (ops/attention.py dispatch).
+        if attn_impl is None:
+            from ..ops.attention import flash_attention
+
+            attn_impl = flash_attention
+
         def body(x_carry, layer):
             x_out, _ = _layer_forward(
                 cfg, x_carry, layer, positions, None, inv_freq, None, None, attn_impl

@@ -36,6 +36,7 @@ def _flash_kernel(
     k_ref,  # [S, head_dim]
     v_ref,  # [S, head_dim]
     o_ref,  # [block_q, head_dim]
+    lse_ref,  # [block_q] — logsumexp per query row (backward needs it)
     *,
     sm_scale: float,
     block_k: int,
@@ -70,13 +71,170 @@ def _flash_kernel(
         return m_new, l_new, acc_new
 
     if causal:
-        # only k blocks up to (and including) this q block's diagonal
-        last_block = jnp.minimum(num_k_blocks, (q_blk + 1) * block_q // block_k)
+        # k blocks up to (and including) this q block's diagonal — CEILING
+        # division so a partial diagonal block (block_k > block_q) is still
+        # visited; the in-loop mask trims it exactly
+        last_block = jnp.minimum(num_k_blocks, -(-((q_blk + 1) * block_q) // block_k))
     else:
         last_block = num_k_blocks
     m, l, acc = lax.fori_loop(0, last_block, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = m + jnp.log(l_safe)
+
+
+def _flash_dq_kernel(
+    q_ref,  # [block_q, d]
+    k_ref,  # [S, d]
+    v_ref,  # [S, d]
+    do_ref,  # [block_q, d]
+    lse_ref,  # [block_q]
+    delta_ref,  # [block_q] — rowsum(dO * O)
+    dq_ref,  # [block_q, d]
+    *,
+    sm_scale: float,
+    block_k: int,
+    causal: bool,
+    block_q: int,
+):
+    """dQ = (P ∘ (dP - delta)) @ K, recomputing P from the saved logsumexp —
+    the standard flash-attention backward (no [S, S] materialization)."""
+    q_blk = pl.program_id(2)
+    seq_len = k_ref.shape[0]
+    q = q_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...]
+    delta = delta_ref[...]
+    q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    num_k_blocks = seq_len // block_k
+
+    def body(kb, acc):
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * sm_scale
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # exact probs via saved lse
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return acc + ds @ k
+
+    if causal:
+        # ceiling division: include the partial diagonal K block
+        last_block = jnp.minimum(num_k_blocks, -(-((q_blk + 1) * block_q) // block_k))
+    else:
+        last_block = num_k_blocks
+    acc0 = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
+    dq_ref[...] = lax.fori_loop(0, last_block, body, acc0).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref,  # [S, d]
+    k_ref,  # [block_k, d]
+    v_ref,  # [block_k, d]
+    do_ref,  # [S, d]
+    lse_ref,  # [S]
+    delta_ref,  # [S]
+    dk_ref,  # [block_k, d]
+    dv_ref,  # [block_k, d]
+    *,
+    sm_scale: float,
+    block_k: int,
+    causal: bool,
+    block_q: int,
+):
+    """dV = Pᵀ @ dO and dK = dSᵀ @ Q, iterating over the query blocks this
+    K/V block is visible to (for causal: q blocks at/after the diagonal)."""
+    k_blk = pl.program_id(2)
+    seq_len = q_ref.shape[0]
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    k_pos = k_blk * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    num_q_blocks = seq_len // block_q
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[pl.ds(qb * block_q, block_q)]
+        s = (q @ k.T) * sm_scale  # [block_q, block_k]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc = dv_acc + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc = dk_acc + ds.T @ q
+        return dk_acc, dv_acc
+
+    if causal:
+        first_block = (k_blk * block_k) // block_q  # earlier q rows can't see this k
+    else:
+        first_block = 0
+    zeros = jnp.zeros((k_ref.shape[0], k_ref.shape[1]), jnp.float32)
+    dk, dv = lax.fori_loop(first_block, num_q_blocks, body, (zeros, zeros))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _blocks(s: int, skv: int, block_q: int, block_k: int) -> tuple[int, int]:
+    block_q = min(block_q, s)
+    block_k = min(block_k, skv)
+    if s % block_q or skv % block_k:
+        raise ValueError(f"seq lengths ({s},{skv}) must divide block sizes ({block_q},{block_k})")
+    return block_q, block_k
+
+
+def _flash_forward(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (out [B,S,H,D], lse [B,H,S])."""
+    b, s, h, d = q.shape
+    skv = k.shape[1]
+    if causal and s != skv:
+        raise ValueError(
+            f"causal flash attention requires Sq == Sk (got {s} != {skv}): the kernel "
+            "aligns q and k at position 0; cached/chunked calls need an explicit mask"
+        )
+    block_q, block_k = _blocks(s, skv, block_q, block_k)
+    sm_scale = 1.0 / math.sqrt(d)
+
+    # layout: [B, H, S, D] so the grid tiles (batch, head, q block)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal, block_q=block_q
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse
 
 
 def flash_attention_pallas(
@@ -88,35 +246,110 @@ def flash_attention_pallas(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
+
+
+def _flash_backward(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,  # [B, H, S]
+    do: jax.Array,  # [B, S, H, D]
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     b, s, h, d = q.shape
     skv = k.shape[1]
-    block_q = min(block_q, s)
-    block_k = min(block_k, skv)
-    if s % block_q or skv % block_k:
-        raise ValueError(f"seq lengths ({s},{skv}) must divide block sizes ({block_q},{block_k})")
+    block_q, block_k = _blocks(s, skv, block_q, block_k)
     sm_scale = 1.0 / math.sqrt(d)
 
-    # layout: [B, H, S, D] so the grid tiles (batch, head, q block)
+    # delta = rowsum(dO ∘ O) — cheap elementwise, XLA fuses it
+    delta = jnp.einsum(
+        "bshd,bshd->bhs",
+        do.astype(jnp.float32),
+        out.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
 
-    kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal, block_q=block_q
+    dq_kernel = functools.partial(
+        _flash_dq_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal, block_q=block_q
     )
-    out = pl.pallas_call(
-        kernel,
+    dq = pl.pallas_call(
+        dq_kernel,
         grid=(b, h, s // block_q),
         in_specs=[
             pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((None, None, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((None, None, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
         ],
         out_specs=pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    )(qt, kt, vt, dot, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal, block_q=block_q
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, skv // block_k),
+        in_specs=[
+            pl.BlockSpec((None, None, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((None, None, s), lambda bi, hi, ki: (bi, hi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, skv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    return dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3), dv.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_causal(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Differentiable causal flash attention (pallas forward AND backward —
+    training never materializes the [S, S] score matrix)."""
+    return _flash_forward(q, k, v, True, block_q, block_k, interpret)[0]
+
+
+def _flash_vjp_fwd(q, k, v, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, True, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, do, True, block_q, block_k, interpret)
+
+
+flash_attention_causal.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
@@ -130,14 +363,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax
             f"mask=None implies aligned causal attention but Sq={q.shape[1]} != Sk={k.shape[1]}; "
             "pass the cache visibility mask for cached/chunked calls"
         )
-    platform = q.devices().pop().platform if hasattr(q, "devices") else jax.default_backend()
+    try:
+        platform = next(iter(q.devices())).platform
+    except Exception:  # tracers raise ConcretizationTypeError under jit
+        platform = jax.default_backend()
     if (
         platform == "tpu"
         and mask is None
         and q.shape[1] >= DEFAULT_BLOCK_Q
         and q.shape[1] % DEFAULT_BLOCK_Q == 0
     ):
-        return flash_attention_pallas(q, k, v, causal=True)
+        # custom_vjp: differentiable, so the training path can use it too
+        return flash_attention_causal(q, k, v)
     from ..models.llama import attention as einsum_attention
 
     return einsum_attention(q, k, v, mask)
