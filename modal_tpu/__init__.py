@@ -8,6 +8,7 @@ ICI-topology-aware gang scheduling; jax.distributed bootstrap in the
 entrypoint).
 """
 
+from ._output import enable_output
 from .app import App, _App
 from .client import Client, _Client
 from .cls import Cls, Obj, _Cls
@@ -77,6 +78,7 @@ __all__ = [
     "config",
     "current_function_call_id",
     "current_input_id",
+    "enable_output",
     "enter",
     "exit",
     "get_cluster_info",

@@ -1,11 +1,16 @@
-"""Client-side log streaming (reference: py/modal/_logs.py tail_logs /
-_logs_manager.py follow state machines — simplified: one AppGetLogs tail)."""
+"""Client-side logs: historical backfill + live tail.
+
+Reference: py/modal/_logs.py — `fetch_logs` (bucketed historical fetch,
+_logs.py:114-310) and `tail_logs`; _logs_manager.py follow state machines.
+Here: `fetch_app_logs` pages AppFetchLogs over the stored history (with
+time/task filters) and `stream_app_logs` long-polls the live tail; the CLI's
+`app logs` chains the two (backfill → follow)."""
 
 from __future__ import annotations
 
 import asyncio
 import sys
-from typing import Optional, TextIO
+from typing import AsyncGenerator, Optional, TextIO
 
 from ._utils.grpc_utils import retry_transient_errors
 from .client import _Client
@@ -13,19 +18,84 @@ from .config import logger
 from .proto import api_pb2
 
 
+async def fetch_app_logs(
+    client: _Client,
+    app_id: str,
+    *,
+    min_timestamp: float = 0.0,
+    max_timestamp: float = 0.0,
+    task_id: str = "",
+    final_index: Optional[list] = None,
+) -> AsyncGenerator[api_pb2.TaskLogs, None]:
+    """Page through the app's FULL stored log history (backfill). Pass a
+    list as `final_index` to receive the end cursor (for a follow handoff)."""
+    index = 0
+    while True:
+        resp = await retry_transient_errors(
+            client.stub.AppFetchLogs,
+            api_pb2.AppFetchLogsRequest(
+                app_id=app_id,
+                start_index=index,
+                min_timestamp=min_timestamp,
+                max_timestamp=max_timestamp,
+                task_id=task_id,
+            ),
+        )
+        for entry in resp.entries:
+            yield entry
+        done = resp.next_index <= index or resp.next_index >= resp.total
+        index = max(index, resp.next_index)
+        if done:
+            break
+    if final_index is not None:
+        final_index.append(index)
+
+
+async def print_app_logs(
+    client: _Client,
+    app_id: str,
+    out: Optional[TextIO] = None,
+    *,
+    follow: bool = False,
+    task_id: str = "",
+) -> None:
+    """Backfill the stored history, then optionally follow the live tail."""
+    out = out or sys.stdout
+    end_cursor: list = []
+    async for entry in fetch_app_logs(client, app_id, task_id=task_id, final_index=end_cursor):
+        text = entry.data
+        if text:
+            out.write(text if text.endswith("\n") else text + "\n")
+    out.flush()
+    if follow:
+        # live tail resumes from the backfill's end (entry ids are indices)
+        await stream_app_logs(
+            client,
+            app_id,
+            out,
+            stop_on_app_done=True,
+            start_entry_id=str(end_cursor[0]) if end_cursor else "",
+            task_id=task_id,
+        )
+
+
 async def stream_app_logs(
     client: _Client,
     app_id: str,
     out: Optional[TextIO] = None,
     stop_on_app_done: bool = True,
+    start_entry_id: str = "",
+    task_id: str = "",
 ) -> None:
     """Tail an app's logs until cancelled or the app finishes."""
     out = out or sys.stdout
-    last_entry_id = ""
+    last_entry_id = start_entry_id
     while True:
         try:
             async for batch in client.stub.AppGetLogs(
-                api_pb2.AppGetLogsRequest(app_id=app_id, timeout=30.0, last_entry_id=last_entry_id)
+                api_pb2.AppGetLogsRequest(
+                    app_id=app_id, timeout=30.0, last_entry_id=last_entry_id, task_id=task_id
+                )
             ):
                 last_entry_id = batch.entry_id or last_entry_id
                 for item in batch.items:

@@ -46,11 +46,13 @@ def run(ctx: click.Context, ref: str, detach: bool, env: Optional[str]) -> None:
     from ..functions import _Function
     from .import_refs import import_and_filter, parse_import_ref, pick_runnable_for_run
 
+    from .._output import enable_output
+
     runnable = import_and_filter(parse_import_ref(ref))
     target = pick_runnable_for_run(runnable)
     args = _parse_entrypoint_args(target, ctx.args)
 
-    with _AppRunBlocking(runnable.app, detach=detach, environment_name=env):
+    with enable_output(), _AppRunBlocking(runnable.app, detach=detach, environment_name=env):
         if isinstance(target, _LocalEntrypoint):
             target(*args)
         else:
@@ -121,10 +123,12 @@ def _parse_entrypoint_args(target, raw_args: list[str]) -> list:
 def deploy(ref: str, name: Optional[str], env: Optional[str], tag: str) -> None:
     """Deploy an app durably: modal-tpu deploy file.py"""
     from ..runner import deploy_app
+    from .._output import enable_output
     from .import_refs import import_and_filter, parse_import_ref
 
     runnable = import_and_filter(parse_import_ref(ref))
-    url = deploy_app(runnable.app, name=name, environment_name=env, tag=tag)
+    with enable_output():
+        url = deploy_app(runnable.app, name=name, environment_name=env, tag=tag)
     click.echo(f"deployed: {url}")
 
 
@@ -220,13 +224,22 @@ def app_stop(app_id: str) -> None:
 
 @app_group.command("logs")
 @click.argument("app_id")
-def app_logs(app_id: str) -> None:
-    """Stream an app's logs."""
-    from .._logs import stream_app_logs
+@click.option("--follow", "-f", is_flag=True, help="Keep following after the backfill.")
+@click.option("--task", "task_id", default="", help="Filter to one container.")
+def app_logs(app_id: str, follow: bool, task_id: str) -> None:
+    """Print an app's FULL log history (backfill), optionally following."""
+    from .._logs import print_app_logs
 
     client = _client()
     try:
-        synchronizer.run(stream_app_logs(client._impl_obj if hasattr(client, "_impl_obj") else client, app_id))
+        synchronizer.run(
+            print_app_logs(
+                client._impl_obj if hasattr(client, "_impl_obj") else client,
+                app_id,
+                follow=follow,
+                task_id=task_id,
+            )
+        )
     except KeyboardInterrupt:
         pass
 

@@ -68,6 +68,7 @@ _RPC_NAMES = [
     "AppGetByDeploymentName",
     "AppDeploymentHistory",
     "AppGetLogs",
+    "AppFetchLogs",
     # Blob store
     "BlobCreate",
     "BlobGet",

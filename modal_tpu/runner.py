@@ -18,6 +18,7 @@ from ._utils.grpc_utils import retry_transient_errors
 from .client import HEARTBEAT_INTERVAL, _Client
 from .config import config, logger
 from .exception import InvalidError
+from . import _output
 from .object import LoadContext, Resolver
 from .proto import api_pb2
 
@@ -117,19 +118,28 @@ async def _run_app(
     app._app_id = app_id
     app._client = client
     logger.debug(f"created app {app_id}")
+    _output.done(f"Initialized app {app_id} ({app.description or 'ephemeral'})")
 
     async with TaskContext(grace=config.get("logs_timeout")) as tc:
         tc.infinite_loop(lambda: _heartbeat(client, app_id), sleep=HEARTBEAT_INTERVAL)
         try:
+            _output.step("Creating objects...")
             function_ids, class_ids = await _create_all_objects(app, client, app_id, environment_name)
+            for tag in function_ids:
+                _output.done(f"Created function {tag}")
+            for tag in class_ids:
+                _output.done(f"Created class {tag}")
             await _publish_app(app, client, app_id, app_state, function_ids, class_ids)
+            _output.done("App ready")
             yield app
         except BaseException as exc:
             await _status_based_disconnect(client, app_id, exc)
             app._app_id = None
             raise
+    _output.step("Stopping app...")
     await _status_based_disconnect(client, app_id)
     app._app_id = None
+    _output.done(f"App {app_id} stopped")
     logger.debug(f"app {app_id} disconnected")
 
 
@@ -164,10 +174,14 @@ async def _deploy_app(
 
     async with TaskContext(grace=2.0) as tc:
         tc.infinite_loop(lambda: _heartbeat(client, app_id), sleep=HEARTBEAT_INTERVAL)
+        _output.step(f"Deploying {name}...")
         function_ids, class_ids = await _create_all_objects(app, client, app_id, environment_name)
+        for tag in list(function_ids) + list(class_ids):
+            _output.done(f"Created {tag}")
         url = await _publish_app(
             app, client, app_id, api_pb2.APP_STATE_DEPLOYED, function_ids, class_ids, name=name, tag=tag
         )
+    _output.done(f"Deployed app {name} ({app_id})")
     logger.info(f"deployed app {name} ({app_id})")
     return url
 

@@ -15,9 +15,21 @@ class Cron(Schedule):
     """Cron-string schedule, e.g. ``Cron("5 4 * * *")``."""
 
     def __init__(self, cron_string: str, timezone: str = "UTC"):
-        parts = cron_string.split()
-        if len(parts) != 5:
-            raise InvalidError(f"cron string must have 5 fields, got {cron_string!r}")
+        # full validation at construction: a bad expression must fail HERE,
+        # not poison the server's scheduler loop at fire time
+        from .server.cron import parse_cron
+
+        try:
+            parse_cron(cron_string)
+        except ValueError as exc:
+            raise InvalidError(f"invalid cron string {cron_string!r}: {exc}") from None
+        if timezone not in ("", "UTC"):
+            from zoneinfo import ZoneInfo, ZoneInfoNotFoundError
+
+            try:
+                ZoneInfo(timezone)
+            except (ZoneInfoNotFoundError, ValueError) as exc:
+                raise InvalidError(f"unknown timezone {timezone!r}: {exc}") from None
         self.cron_string = cron_string
         self.timezone = timezone
 

@@ -253,6 +253,8 @@ class ModalTPUServicer:
             entries = app.log_entries[pos:]
             if entries:
                 for i, entry in enumerate(entries):
+                    if request.task_id and entry.task_id != request.task_id:
+                        continue  # filtered entries still advance the cursor
                     batch = api_pb2.TaskLogsBatch(entry_id=str(pos + i + 1))
                     batch.items.append(entry)
                     yield batch
@@ -814,6 +816,28 @@ class ModalTPUServicer:
                 async with app.log_condition:
                     app.log_condition.notify_all()
         return api_pb2.ContainerLogResponse()
+
+    async def AppFetchLogs(self, request: api_pb2.AppFetchLogsRequest, context) -> api_pb2.AppFetchLogsResponse:
+        """Historical log backfill: offset-paged over the app's stored
+        entries with time/task filters (reference _logs.py:114-310)."""
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
+        page = request.max_entries or 500
+        resp = api_pb2.AppFetchLogsResponse(total=len(app.log_entries))
+        i = request.start_index
+        while i < len(app.log_entries) and len(resp.entries) < page:
+            entry = app.log_entries[i]
+            i += 1
+            if request.min_timestamp and entry.timestamp < request.min_timestamp:
+                continue
+            if request.max_timestamp and entry.timestamp >= request.max_timestamp:
+                continue
+            if request.task_id and entry.task_id != request.task_id:
+                continue
+            resp.entries.append(entry)
+        resp.next_index = i
+        return resp
 
     async def TaskResult(self, request: api_pb2.TaskResultRequest, context) -> api_pb2.TaskResultResponse:
         task = self.s.tasks.get(request.task_id)

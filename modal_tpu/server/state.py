@@ -78,6 +78,7 @@ class FunctionCallState:
     cancelled: bool = False
     return_exceptions: bool = False
     first_output_at: float = 0.0
+    server_originated: bool = False  # scheduled fire: GC after completion
 
 
 @dataclass
@@ -93,6 +94,7 @@ class FunctionState:
     # autoscaler bookkeeping
     task_ids: set[str] = field(default_factory=set)
     web_url: str = ""
+    next_fire_at: float = 0.0  # schedule evaluation (server/cron.py)
     init_failures: int = 0  # consecutive container INIT_FAILUREs
     bound_parent: Optional[str] = None  # parametrized variant parent id
     serialized_params: bytes = b""

@@ -1,0 +1,141 @@
+"""Observability floor: server-side schedule firing, logs backfill, output
+manager (VERDICT r1 item 10 — schedules were accepted and silently never
+fired; only a live tail existed; output was plain prints)."""
+
+import io
+import time
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# cron calculator
+# ---------------------------------------------------------------------------
+
+
+def test_cron_next_basic():
+    from datetime import datetime, timezone
+
+    from modal_tpu.server.cron import cron_next
+
+    base = datetime(2026, 7, 29, 10, 30, tzinfo=timezone.utc).timestamp()
+    # every minute
+    assert cron_next("* * * * *", base) == base + 60
+    # specific minute of every hour: 10:45
+    t = cron_next("45 * * * *", base)
+    assert datetime.fromtimestamp(t, timezone.utc).strftime("%H:%M") == "10:45"
+    # daily at midnight → next day
+    t = cron_next("0 0 * * *", base)
+    assert datetime.fromtimestamp(t, timezone.utc).strftime("%d %H:%M") == "30 00:00"
+    # every 15 min
+    t = cron_next("*/15 * * * *", base)
+    assert datetime.fromtimestamp(t, timezone.utc).minute == 45
+    # weekly: Sunday (2026-08-02 is a Sunday)
+    t = cron_next("0 9 * * 0", base)
+    assert datetime.fromtimestamp(t, timezone.utc).strftime("%Y-%m-%d %H:%M") == "2026-08-02 09:00"
+    # dom+dow both set → vixie OR (next 1st OR next Monday)
+    t = cron_next("0 0 1 * 1", base)
+    assert datetime.fromtimestamp(t, timezone.utc).strftime("%Y-%m-%d") == "2026-08-01"
+
+
+def test_cron_rejects_bad_exprs():
+    from modal_tpu.server.cron import cron_next
+
+    with pytest.raises(ValueError):
+        cron_next("61 * * * *", 0)
+    with pytest.raises(ValueError):
+        cron_next("* * *", 0)
+
+
+# ---------------------------------------------------------------------------
+# schedule firing e2e
+# ---------------------------------------------------------------------------
+
+
+def test_period_schedule_fires(supervisor, tmp_path):
+    """A Period(seconds=1) schedule actually runs the function repeatedly."""
+    import modal_tpu
+
+    marker = str(tmp_path / "fires.log")
+    app = modal_tpu.App("sched-e2e")
+
+    def tick():
+        with open(marker, "a") as f:
+            f.write("x\n")
+
+    app.function(serialized=True, schedule=modal_tpu.Period(seconds=1))(tick)
+    import os
+
+    with app.run():
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if os.path.exists(marker) and os.path.getsize(marker) >= 4:
+                break
+            time.sleep(0.5)
+    assert os.path.exists(marker), "schedule never fired"
+    assert os.path.getsize(marker) >= 4, "schedule should fire repeatedly"
+
+
+# ---------------------------------------------------------------------------
+# logs backfill
+# ---------------------------------------------------------------------------
+
+
+def test_app_fetch_logs_backfill(supervisor):
+    """AppFetchLogs pages the full history — including lines emitted before
+    the reader attached (the live tail can't serve those retroactively)."""
+    import modal_tpu
+    from modal_tpu._logs import print_app_logs
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+
+    app = modal_tpu.App("logs-backfill")
+
+    def chatty(n):
+        for i in range(n):
+            print(f"log-line-{i}")
+        return n
+
+    f = app.function(serialized=True)(chatty)
+    with app.run():
+        assert f.remote(20) == 20
+        time.sleep(1.0)  # container log pump flushes
+
+        out = io.StringIO()
+
+        async def _fetch():
+            client = await _Client.from_env()
+            await print_app_logs(client, app._app_id, out)
+
+        synchronizer.run(_fetch())
+    text = out.getvalue()
+    for i in range(20):
+        assert f"log-line-{i}" in text, f"missing line {i} in backfill:\n{text[:500]}"
+
+
+# ---------------------------------------------------------------------------
+# output manager
+# ---------------------------------------------------------------------------
+
+
+def test_output_manager_run_progress(supervisor):
+    """enable_output surfaces run lifecycle steps."""
+    import modal_tpu
+    from modal_tpu import _output
+
+    stream = io.StringIO()
+    app = modal_tpu.App("out-e2e")
+
+    def noop():
+        return 1
+
+    f = app.function(serialized=True)(noop)
+    with _output.enable_output(plain=True) as mgr:
+        mgr._stream = stream
+        with app.run():
+            assert f.remote() == 1
+    text = stream.getvalue()
+    assert "Initialized app" in text
+    assert "Created function" in text and "noop" in text
+    assert "App ready" in text
+    assert "stopped" in text
