@@ -33,7 +33,17 @@ from .exception import (
 )
 from .functions import Function, FunctionCall, _Function, _FunctionCall
 from .image import Image, _Image
-from .partial_function import batched, clustered, concurrent, enter, exit, method
+from .partial_function import (
+    asgi_app,
+    batched,
+    clustered,
+    concurrent,
+    enter,
+    exit,
+    method,
+    web_endpoint,
+    wsgi_app,
+)
 from .retries import Retries
 from .runtime.clustered import ClusterInfo, get_cluster_info, get_fabric_peers
 from .runtime.execution_context import current_function_call_id, current_input_id, is_local
@@ -86,6 +96,9 @@ __all__ = [
     "is_local",
     "method",
     "parse_tpu_config",
+    "asgi_app",
+    "web_endpoint",
+    "wsgi_app",
 ]
 
 

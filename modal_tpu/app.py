@@ -242,7 +242,15 @@ class _App:
             )
             if is_generator is None:
                 is_generator = params.is_generator
-            function = _Function.from_local(info, self, spec, is_generator=is_generator)
+            function = _Function.from_local(
+                info,
+                self,
+                spec,
+                is_generator=is_generator,
+                webhook_type=params.webhook_type or api_pb2.WEB_ENDPOINT_TYPE_UNSPECIFIED,
+            )
+            if params.web_method:
+                spec.experimental_options["web_method"] = params.web_method
             self._add_function(function)
             return function
 

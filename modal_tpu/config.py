@@ -119,6 +119,9 @@ _SETTINGS: dict[str, _Setting] = {
     "local_workers": _Setting(1, int),
     # Force JAX platform inside containers (cpu for tests, tpu in prod).
     "jax_platform": _Setting(""),
+    # Per-module import tracing in containers (cold-start attribution;
+    # events land in <task_dir>/imports.jsonl — runtime/telemetry.py).
+    "import_trace": _Setting(False, _to_boolean),
 }
 
 

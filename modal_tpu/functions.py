@@ -498,6 +498,19 @@ class _Function(_Object, type_prefix="fu"):
         return await _spawn_map_async(self, *input_iterators, kwargs=kwargs)
 
     @live_method
+    async def get_web_url(self, timeout: float = 60.0) -> str:
+        """URL of this function's web endpoint, long-polling while the
+        serving container boots (reference web_url on function handles)."""
+        resp = await retry_transient_errors(
+            self.client.stub.FunctionGetWebUrl,
+            api_pb2.FunctionGetWebUrlRequest(function_id=self.object_id, timeout=timeout),
+            attempt_timeout=timeout + 5.0,
+        )
+        if not resp.web_url:
+            raise ExecutionError("web endpoint did not come up (is webhook_type set?)")
+        return resp.web_url
+
+    @live_method
     async def get_current_stats(self) -> api_pb2.FunctionStats:
         return await retry_transient_errors(
             self.client.stub.FunctionGetCurrentStats,

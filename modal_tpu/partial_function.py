@@ -29,6 +29,7 @@ class _PartialFunctionFlags(enum.IntFlag):
     BATCHED = 16
     CONCURRENT = 32
     CLUSTERED = 64
+    WEB_ENDPOINT = 128
 
     @staticmethod
     def all() -> "_PartialFunctionFlags":
@@ -47,6 +48,9 @@ class _PartialFunctionParams:
     broadcast_inputs: bool = True
     tpu_slice: Optional[str] = None  # e.g. "v5p-64": the whole gang's slice
     fabric_size: Optional[int] = None
+    # web endpoints (reference @modal.asgi_app/wsgi_app/web_endpoint)
+    webhook_type: Optional[int] = None  # api_pb2.WebEndpointType
+    web_method: Optional[str] = None  # plain-function endpoints: HTTP method
 
     def update(self, other: "_PartialFunctionParams") -> None:
         for f in self.__dataclass_fields__:
@@ -270,3 +274,65 @@ def find_callables_for_obj(user_obj: Any, flags: int) -> dict[str, Callable]:
         k: pf.raw_f.__get__(user_obj)
         for k, pf in find_partial_methods_for_user_cls(user_cls, flags).items()
     }
+
+
+def web_endpoint(
+    _warn_parentheses_missing: Any = None,
+    *,
+    method: str = "POST",
+) -> Callable[[Callable], _PartialFunction]:
+    """Expose a plain function as a JSON HTTP endpoint (the reference wraps
+    these with fastapi, reference _partial_function.py web_endpoint; here a
+    dependency-free JSON adapter — runtime/asgi.py function_to_asgi)."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.web_endpoint() with parentheses.")
+
+    def wrapper(raw_f: Callable) -> _PartialFunction:
+        from .proto import api_pb2
+
+        params = _PartialFunctionParams(
+            webhook_type=api_pb2.WEB_ENDPOINT_TYPE_FUNCTION, web_method=method
+        )
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(_PartialFunctionFlags.WEB_ENDPOINT, params)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.WEB_ENDPOINT, params)
+
+    return wrapper
+
+
+def asgi_app(
+    _warn_parentheses_missing: Any = None,
+) -> Callable[[Callable], _PartialFunction]:
+    """The decorated function RETURNS an ASGI app, served from the container
+    (reference @modal.asgi_app, _runtime/asgi.py)."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.asgi_app() with parentheses.")
+
+    def wrapper(raw_f: Callable) -> _PartialFunction:
+        from .proto import api_pb2
+
+        params = _PartialFunctionParams(webhook_type=api_pb2.WEB_ENDPOINT_TYPE_ASGI_APP)
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(_PartialFunctionFlags.WEB_ENDPOINT, params)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.WEB_ENDPOINT, params)
+
+    return wrapper
+
+
+def wsgi_app(
+    _warn_parentheses_missing: Any = None,
+) -> Callable[[Callable], _PartialFunction]:
+    """The decorated function RETURNS a WSGI app (flask-style), served via
+    the threaded WSGI bridge (reference @modal.wsgi_app / vendored a2wsgi)."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.wsgi_app() with parentheses.")
+
+    def wrapper(raw_f: Callable) -> _PartialFunction:
+        from .proto import api_pb2
+
+        params = _PartialFunctionParams(webhook_type=api_pb2.WEB_ENDPOINT_TYPE_WSGI_APP)
+        if isinstance(raw_f, _PartialFunction):
+            return raw_f.add_flags(_PartialFunctionFlags.WEB_ENDPOINT, params)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.WEB_ENDPOINT, params)
+
+    return wrapper

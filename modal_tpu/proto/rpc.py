@@ -77,6 +77,8 @@ _RPC_NAMES = [
     "FunctionGet",
     "FunctionBindParams",
     "FunctionUpdateSchedulingParams",
+    "FunctionSetWebUrl",
+    "FunctionGetWebUrl",
     "FunctionGetCurrentStats",
     "FunctionMap",
     "FunctionPutInputs",

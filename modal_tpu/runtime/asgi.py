@@ -1,0 +1,350 @@
+"""Web endpoint runtime: serve ASGI/WSGI apps and plain-function endpoints
+from inside a container.
+
+Reference: py/modal/_runtime/asgi.py (528 LoC — asgi_app_wrapper, lifespan,
+vendored a2wsgi). The reference hands requests to the container through the
+platform's web layer; the local backend serves HTTP directly from the
+container process (asyncio HTTP/1.1 server speaking ASGI) and registers the
+URL with the control plane, mirroring the worker-direct command-router
+pattern. No third-party server (uvicorn et al.) is assumed.
+
+Supported: HTTP/1.1 request/response with content-length bodies, ASGI
+lifespan startup/shutdown, WSGI apps (threaded bridge), and JSON
+plain-function endpoints (`@modal_tpu.web_endpoint`). Not supported (v0):
+websockets, chunked request bodies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import sys
+import urllib.parse
+from typing import Any, Callable, Optional
+
+from ..config import logger
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class AsgiHttpServer:
+    """Minimal asyncio HTTP/1.1 server driving an ASGI 3 application."""
+
+    def __init__(self, asgi_app: Callable, host: str = "127.0.0.1", port: int = 0):
+        self.asgi_app = asgi_app
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._lifespan_send: Optional[asyncio.Queue] = None
+        self._lifespan_task: Optional[asyncio.Task] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        await self._lifespan("startup")
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.debug(f"web endpoint serving at {self.url}")
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self._lifespan("shutdown")
+
+    async def _lifespan(self, phase: str) -> None:
+        """Run one ASGI lifespan phase; apps without lifespan support are
+        fine (errors are swallowed per spec)."""
+        if phase == "startup":
+            self._lifespan_send = asyncio.Queue()
+            state: dict = {}
+            self._lifespan_state = state
+            scope = {"type": "lifespan", "asgi": {"version": "3.0"}, "state": state}
+            receive_q: asyncio.Queue = asyncio.Queue()
+            self._lifespan_receive = receive_q
+            complete: asyncio.Queue = asyncio.Queue()
+
+            async def receive():
+                return await receive_q.get()
+
+            async def send(message):
+                await complete.put(message)
+
+            async def _run():
+                try:
+                    await self.asgi_app(scope, receive, send)
+                    # app returned without completing the protocol (common:
+                    # `if scope["type"] == "lifespan": return`) — unblock the
+                    # startup wait instead of eating the 30s timeout
+                    await complete.put({"type": "lifespan.exited"})
+                except Exception:
+                    await complete.put({"type": "lifespan.startup.failed"})
+
+            self._lifespan_task = asyncio.create_task(_run())
+            await receive_q.put({"type": "lifespan.startup"})
+            try:
+                msg = await asyncio.wait_for(complete.get(), timeout=30.0)
+                if msg.get("type") == "lifespan.startup.failed":
+                    logger.warning(f"ASGI lifespan startup failed: {msg.get('message', '')}")
+            except asyncio.TimeoutError:
+                logger.debug("ASGI app has no lifespan handler (startup timeout)")
+            self._lifespan_complete = complete
+        else:
+            if self._lifespan_task is None or self._lifespan_task.done():
+                return
+            await self._lifespan_receive.put({"type": "lifespan.shutdown"})
+            try:
+                await asyncio.wait_for(self._lifespan_complete.get(), timeout=10.0)
+            except asyncio.TimeoutError:
+                pass
+            self._lifespan_task.cancel()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError, ConnectionError):
+            writer.close()
+            return
+        try:
+            request_line, *header_lines = head.decode("latin-1").split("\r\n")
+            method, target, _version = request_line.split(" ", 2)
+            headers: list[tuple[bytes, bytes]] = []
+            content_length = 0
+            for line in header_lines:
+                if not line:
+                    continue
+                name, _, value = line.partition(":")
+                headers.append((name.strip().lower().encode(), value.strip().encode()))
+                if name.strip().lower() == "content-length":
+                    content_length = int(value)
+            body = b""
+            if content_length:
+                if content_length > MAX_BODY_BYTES:
+                    writer.write(b"HTTP/1.1 413 Payload Too Large\r\ncontent-length: 0\r\n\r\n")
+                    await writer.drain()
+                    writer.close()
+                    return
+                body = await reader.readexactly(content_length)
+            path, _, query = target.partition("?")
+            scope = {
+                "type": "http",
+                "asgi": {"version": "3.0", "spec_version": "2.3"},
+                "http_version": "1.1",
+                "method": method.upper(),
+                "scheme": "http",
+                "path": urllib.parse.unquote(path),
+                "raw_path": path.encode(),
+                "query_string": query.encode(),
+                "headers": headers,
+                "client": writer.get_extra_info("peername"),
+                "server": (self.host, self.port),
+                "state": getattr(self, "_lifespan_state", {}),
+            }
+            await self._run_app(scope, body, writer)
+        except Exception as exc:  # noqa: BLE001 — a bad request must not kill the server
+            logger.warning(f"web request failed: {exc}")
+            try:
+                writer.write(b"HTTP/1.1 500 Internal Server Error\r\ncontent-length: 0\r\n\r\n")
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _run_app(self, scope: dict, body: bytes, writer: asyncio.StreamWriter) -> None:
+        received = {"done": False}
+
+        async def receive():
+            if received["done"]:
+                return {"type": "http.disconnect"}
+            received["done"] = True
+            return {"type": "http.request", "body": body, "more_body": False}
+
+        started = {"sent": False}
+
+        async def send(message):
+            if message["type"] == "http.response.start":
+                status = message["status"]
+                writer.write(f"HTTP/1.1 {status} {_reason(status)}\r\n".encode())
+                has_length = False
+                for name, value in message.get("headers", []):
+                    if name.lower() == b"content-length":
+                        has_length = True
+                    writer.write(name + b": " + value + b"\r\n")
+                if not has_length:
+                    writer.write(b"transfer-encoding: identity\r\n")
+                writer.write(b"connection: close\r\n\r\n")
+                started["sent"] = True
+            elif message["type"] == "http.response.body":
+                writer.write(message.get("body", b""))
+                await writer.drain()
+
+        await self.asgi_app(scope, receive, send)
+        if not started["sent"]:
+            writer.write(b"HTTP/1.1 500 Internal Server Error\r\ncontent-length: 0\r\n\r\n")
+        await writer.drain()
+
+
+def _reason(status: int) -> str:
+    import http
+
+    try:
+        return http.HTTPStatus(status).phrase
+    except ValueError:
+        return "Unknown"
+
+
+# ---------------------------------------------------------------------------
+# Adapters
+# ---------------------------------------------------------------------------
+
+
+def wsgi_to_asgi(wsgi_app: Callable) -> Callable:
+    """Threaded WSGI→ASGI bridge (reference vendored a2wsgi, simplified:
+    whole-body buffering, one worker thread per request)."""
+
+    async def app(scope, receive, send):
+        if scope["type"] == "lifespan":
+            # WSGI has no lifespan; complete the protocol politely
+            while True:
+                msg = await receive()
+                if msg["type"] == "lifespan.startup":
+                    await send({"type": "lifespan.startup.complete"})
+                elif msg["type"] == "lifespan.shutdown":
+                    await send({"type": "lifespan.shutdown.complete"})
+                    return
+        body = b""
+        while True:
+            msg = await receive()
+            if msg["type"] == "http.request":
+                body += msg.get("body", b"")
+                if not msg.get("more_body"):
+                    break
+            else:
+                return
+
+        def run_wsgi():
+            environ = {
+                "REQUEST_METHOD": scope["method"],
+                "SCRIPT_NAME": "",
+                "PATH_INFO": scope["path"],
+                "QUERY_STRING": scope["query_string"].decode(),
+                "SERVER_NAME": scope["server"][0],
+                "SERVER_PORT": str(scope["server"][1]),
+                "SERVER_PROTOCOL": "HTTP/1.1",
+                "wsgi.version": (1, 0),
+                "wsgi.url_scheme": "http",
+                "wsgi.input": io.BytesIO(body),
+                "wsgi.errors": sys.stderr,
+                "wsgi.multithread": True,
+                "wsgi.multiprocess": False,
+                "wsgi.run_once": False,
+            }
+            for name, value in scope["headers"]:
+                key = name.decode().upper().replace("-", "_")
+                if key == "CONTENT_TYPE":
+                    environ["CONTENT_TYPE"] = value.decode()
+                elif key == "CONTENT_LENGTH":
+                    environ["CONTENT_LENGTH"] = value.decode()
+                else:
+                    environ["HTTP_" + key] = value.decode()
+            result = {"status": 500, "headers": [], "chunks": []}
+
+            def start_response(status_line, headers, exc_info=None):
+                result["status"] = int(status_line.split(" ", 1)[0])
+                result["headers"] = [
+                    (k.encode(), v.encode()) for k, v in headers
+                ]
+
+            chunks = wsgi_app(environ, start_response)
+            try:
+                result["chunks"] = [c for c in chunks]
+            finally:
+                if hasattr(chunks, "close"):
+                    chunks.close()
+            return result
+
+        result = await asyncio.to_thread(run_wsgi)
+        payload = b"".join(result["chunks"])
+        headers = [h for h in result["headers"] if h[0].lower() != b"content-length"]
+        headers.append((b"content-length", str(len(payload)).encode()))
+        await send({"type": "http.response.start", "status": result["status"], "headers": headers})
+        await send({"type": "http.response.body", "body": payload})
+
+    return app
+
+
+def function_to_asgi(fn: Callable, method: str = "POST") -> Callable:
+    """JSON endpoint adapter for a plain function (the reference wraps these
+    with fastapi; here a dependency-free equivalent): GET passes query
+    params, POST/PUT pass the JSON body as kwargs; the return value is
+    JSON-encoded."""
+    import inspect
+
+    async def app(scope, receive, send):
+        if scope["type"] == "lifespan":
+            while True:
+                msg = await receive()
+                if msg["type"] == "lifespan.startup":
+                    await send({"type": "lifespan.startup.complete"})
+                elif msg["type"] == "lifespan.shutdown":
+                    await send({"type": "lifespan.shutdown.complete"})
+                    return
+        body = b""
+        while True:
+            msg = await receive()
+            if msg["type"] == "http.request":
+                body += msg.get("body", b"")
+                if not msg.get("more_body"):
+                    break
+            else:
+                return
+
+        async def respond(status: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            await send(
+                {
+                    "type": "http.response.start",
+                    "status": status,
+                    "headers": [
+                        (b"content-type", b"application/json"),
+                        (b"content-length", str(len(data)).encode()),
+                    ],
+                }
+            )
+            await send({"type": "http.response.body", "body": data})
+
+        if scope["method"] not in ("GET", method.upper()):
+            await respond(405, {"error": f"method {scope['method']} not allowed"})
+            return
+        try:
+            kwargs: dict = {}
+            if scope["query_string"]:
+                kwargs.update(
+                    {k: v[0] for k, v in urllib.parse.parse_qs(scope["query_string"].decode()).items()}
+                )
+            if body:
+                parsed = json.loads(body)
+                if not isinstance(parsed, dict):
+                    await respond(400, {"error": "JSON body must be an object"})
+                    return
+                kwargs.update(parsed)
+            if inspect.iscoroutinefunction(fn):
+                result = await fn(**kwargs)
+            else:
+                result = await asyncio.to_thread(fn, **kwargs)
+            await respond(200, {"result": result})
+        except TypeError as exc:
+            await respond(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — surface as a 500 payload
+            logger.warning(f"web endpoint raised: {exc}")
+            await respond(500, {"error": f"{type(exc).__name__}: {exc}"})
+
+    return app

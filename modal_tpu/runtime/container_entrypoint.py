@@ -22,6 +22,12 @@ import time
 import traceback
 from typing import Any, Optional
 
+# import tracing hooks in FIRST so the heavy imports below are attributed
+# (reference _container_entrypoint.py:12-16)
+from .telemetry import maybe_instrument_from_env
+
+maybe_instrument_from_env()
+
 from ..client import _Client
 from ..config import config, logger
 from ..exception import ExecutionError
@@ -156,6 +162,47 @@ async def run_input_loop(service: Service, io: ContainerIOManager) -> None:
             tg.create_task(_run_one(ctx))
 
 
+async def run_web_endpoint(
+    service: Service, io: ContainerIOManager, client: _Client, container_args: api_pb2.ContainerArguments
+) -> None:
+    """Serve the function as HTTP instead of polling the input queue
+    (reference run_server/asgi flow, _container_entrypoint.py:394 +
+    _runtime/asgi.py): build the ASGI app, bind a local port, register the
+    URL with the control plane, serve until drained."""
+    from .asgi import AsgiHttpServer, function_to_asgi, wsgi_to_asgi
+
+    function_def = container_args.function_def
+    webhook_type = function_def.webhook_type
+    callable_ = service.get_callable()
+    if webhook_type == api_pb2.WEB_ENDPOINT_TYPE_ASGI_APP:
+        asgi = callable_()  # user factory returns the ASGI app
+    elif webhook_type == api_pb2.WEB_ENDPOINT_TYPE_WSGI_APP:
+        asgi = wsgi_to_asgi(callable_())
+    elif webhook_type == api_pb2.WEB_ENDPOINT_TYPE_FUNCTION:
+        method = function_def.experimental_options.get("web_method", "POST")
+        asgi = function_to_asgi(callable_, method=method)
+    else:
+        raise ExecutionError(f"unsupported webhook type {webhook_type}")
+
+    server = AsgiHttpServer(asgi)
+    await server.start()
+    try:
+        await retry_transient_errors(
+            client.stub.FunctionSetWebUrl,
+            api_pb2.FunctionSetWebUrlRequest(
+                function_id=container_args.function_id,
+                task_id=container_args.task_id,
+                web_url=server.url,
+            ),
+            max_retries=3,
+        )
+        logger.debug(f"web endpoint registered: {server.url}")
+        while not io.terminate:
+            await asyncio.sleep(0.3)
+    finally:
+        await server.stop()
+
+
 async def main_async() -> int:
     container_args = load_container_arguments()
     task_id = container_args.task_id
@@ -227,7 +274,10 @@ async def main_async() -> int:
             )
         await run_lifecycle_hooks(service.enter_post_snapshot, "enter")
 
-        await run_input_loop(service, io)
+        if function_def.webhook_type != api_pb2.WEB_ENDPOINT_TYPE_UNSPECIFIED:
+            await run_web_endpoint(service, io, client, container_args)
+        else:
+            await run_input_loop(service, io)
     except BaseException as exc:
         if isinstance(exc, (KeyboardInterrupt, asyncio.CancelledError)):
             # SIGTERM from the worker (app stop / drain): graceful shutdown —

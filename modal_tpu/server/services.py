@@ -58,25 +58,63 @@ class ModalTPUServicer:
         return api_pb2.ClientHelloResponse(server_version="0.1.0", image_builder_version="2026.07")
 
     async def EnvironmentList(self, request, context):
-        names = sorted({env for env, _ in self.s.deployed_apps.keys()} | {""})
+        names = set(self.s.environments) | {env for env, _ in self.s.deployed_apps.keys() if env}
         return api_pb2.EnvironmentListResponse(
-            items=[api_pb2.EnvironmentListItem(name=n or "main") for n in names]
+            items=[api_pb2.EnvironmentListItem(name=n) for n in sorted(names)]
         )
 
     async def EnvironmentCreate(self, request, context):
+        name = request.name
+        if not name:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "environment needs a name")
+        self.s.environments.setdefault(name, "")
         return api_pb2.EnvironmentCreateResponse()
 
     async def EnvironmentDelete(self, request, context):
+        name = request.name
+        if any(env == name for env, _ in self.s.deployed_apps.keys()):
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, f"environment {name!r} still has deployed apps"
+            )
+        self.s.environments.pop(name, None)
         return api_pb2.EnvironmentDeleteResponse()
 
     async def EnvironmentUpdate(self, request, context):
+        current = request.current_name
+        if current not in self.s.environments:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"environment {current!r} not found")
+        if request.HasField("web_suffix"):
+            self.s.environments[current] = request.web_suffix
+        if request.HasField("name") and request.name and request.name != current:
+            self.s.environments[request.name] = self.s.environments.pop(current)
+            # re-key deployments under the new name
+            for (env, app_name), app_id in list(self.s.deployed_apps.items()):
+                if env == current:
+                    del self.s.deployed_apps[(env, app_name)]
+                    self.s.deployed_apps[(request.name, app_name)] = app_id
         return api_pb2.EnvironmentUpdateResponse()
 
     async def TokenFlowCreate(self, request, context):
-        return api_pb2.TokenFlowCreateResponse(token_flow_id="tf-local", web_url="http://localhost/token", code="LOCAL")
+        # local token issuance: real random credentials, stored server-side
+        # (the reference's browser flow is replaced by immediate grant)
+        import secrets as _secrets
+
+        flow_id = make_id("tf")
+        token_id = "tk-" + _secrets.token_hex(8)
+        token_secret = "ts-" + _secrets.token_hex(16)
+        self.s.tokens[token_id] = token_secret
+        self.s.pending_token_flows[flow_id] = (token_id, token_secret)
+        return api_pb2.TokenFlowCreateResponse(
+            token_flow_id=flow_id, web_url="local://token-granted", code=token_id[-6:]
+        )
 
     async def TokenFlowWait(self, request, context):
-        return api_pb2.TokenFlowWaitResponse(token_id="tk-local", token_secret="ts-local", workspace_name="local")
+        pair = self.s.pending_token_flows.pop(request.token_flow_id, None)
+        if pair is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "unknown token flow")
+        return api_pb2.TokenFlowWaitResponse(
+            token_id=pair[0], token_secret=pair[1], workspace_name="local"
+        )
 
     # ------------------------------------------------------------------
     # Apps
@@ -305,11 +343,18 @@ class ModalTPUServicer:
         if request.app_id and request.app_id not in self.s.apps:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"app {request.app_id} not found")
         function_id = request.existing_function_id or make_id("fu")
+        definition = request.function
+        if definition.webhook_type != api_pb2.WEB_ENDPOINT_TYPE_UNSPECIFIED:
+            # web functions serve HTTP, not a queue: at least one warm
+            # container must exist for the endpoint to answer
+            definition.autoscaler_settings.min_containers = max(
+                1, definition.autoscaler_settings.min_containers
+            )
         fn = FunctionState(
             function_id=function_id,
             app_id=request.app_id,
             tag=request.tag or request.function.function_name,
-            definition=request.function,
+            definition=definition,
         )
         self.s.functions[function_id] = fn
         app = self.s.apps.get(request.app_id)
@@ -372,6 +417,30 @@ class ModalTPUServicer:
         return api_pb2.FunctionBindParamsResponse(
             bound_function_id=bound_id, handle_metadata=self._function_metadata(bound)
         )
+
+    async def FunctionSetWebUrl(self, request: api_pb2.FunctionSetWebUrlRequest, context) -> api_pb2.FunctionSetWebUrlResponse:
+        fn = self.s.functions.get(request.function_id)
+        if fn is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
+        fn.web_url = request.web_url
+        async with fn.input_condition:
+            fn.input_condition.notify_all()
+        return api_pb2.FunctionSetWebUrlResponse()
+
+    async def FunctionGetWebUrl(self, request: api_pb2.FunctionGetWebUrlRequest, context) -> api_pb2.FunctionGetWebUrlResponse:
+        fn = self.s.functions.get(request.function_id)
+        if fn is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
+        deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
+        while not fn.web_url and time.monotonic() < deadline:
+            async with fn.input_condition:
+                try:
+                    await asyncio.wait_for(
+                        fn.input_condition.wait(), timeout=max(0.05, deadline - time.monotonic())
+                    )
+                except asyncio.TimeoutError:
+                    break
+        return api_pb2.FunctionGetWebUrlResponse(web_url=fn.web_url)
 
     async def FunctionUpdateSchedulingParams(self, request, context):
         fn = self.s.functions.get(request.function_id)

@@ -265,6 +265,9 @@ class ServerState:
         self.images: dict[str, ImageState] = {}
         self.images_by_hash: dict[str, str] = {}
         self.sandboxes: dict[str, SandboxState_] = {}
+        self.environments: dict[str, str] = {"main": ""}  # name -> web suffix
+        self.tokens: dict[str, str] = {}  # token_id -> token_secret
+        self.pending_token_flows: dict[str, tuple[str, str]] = {}
         self.blob_url_base: str = ""  # set by supervisor once blob server is up
 
         # scheduling wakeup

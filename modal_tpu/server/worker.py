@@ -483,6 +483,9 @@ class WorkerAgent:
         env["MODAL_TPU_SERVER_URL"] = self.server_url
         env["MODAL_TPU_TASK_ID"] = task_id
         env["MODAL_TPU_TASK_DIR"] = task_dir
+        if config.get("import_trace"):  # env: MODAL_TPU_IMPORT_TRACE
+            # per-module import timings land next to the task's logs
+            env["MODAL_TPU_TELEMETRY_PATH"] = os.path.join(task_dir, "imports.jsonl")
         # sys.path propagation for "file"-defined functions
         globals_path = args.function_def.experimental_options.get("globals_path", "")
         if globals_path:

@@ -292,3 +292,50 @@ async def test_resolver_dedup():
     ctx = LoadContext(client="dummy")
     await asyncio.gather(*[resolver.load(obj, ctx) for _ in range(5)])
     assert loads == 1
+
+
+def test_environments_real(supervisor):
+    """Environment RPCs are stateful (round 1: no-op stubs)."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.proto import api_pb2
+
+    async def _go():
+        client = await _Client.from_env()
+        stub = client.stub
+        await stub.EnvironmentCreate(api_pb2.EnvironmentCreateRequest(name="staging"))
+        resp = await stub.EnvironmentList(api_pb2.EnvironmentListRequest())
+        names = {i.name for i in resp.items}
+        assert {"main", "staging"} <= names
+        await stub.EnvironmentUpdate(
+            api_pb2.EnvironmentUpdateRequest(current_name="staging", name="prod")
+        )
+        resp = await stub.EnvironmentList(api_pb2.EnvironmentListRequest())
+        names = {i.name for i in resp.items}
+        assert "prod" in names and "staging" not in names
+        await stub.EnvironmentDelete(api_pb2.EnvironmentDeleteRequest(name="prod"))
+        resp = await stub.EnvironmentList(api_pb2.EnvironmentListRequest())
+        assert "prod" not in {i.name for i in resp.items}
+
+    synchronizer.run(_go())
+
+
+def test_token_flow_issues_real_tokens(supervisor):
+    """TokenFlowCreate/Wait grant unique stored credentials (round 1:
+    hardcoded local strings)."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.proto import api_pb2
+
+    async def _go():
+        client = await _Client.from_env()
+        stub = client.stub
+        flow = await stub.TokenFlowCreate(api_pb2.TokenFlowCreateRequest())
+        got = await stub.TokenFlowWait(api_pb2.TokenFlowWaitRequest(token_flow_id=flow.token_flow_id))
+        assert got.token_id.startswith("tk-") and len(got.token_secret) > 20
+        flow2 = await stub.TokenFlowCreate(api_pb2.TokenFlowCreateRequest())
+        got2 = await stub.TokenFlowWait(api_pb2.TokenFlowWaitRequest(token_flow_id=flow2.token_flow_id))
+        assert got2.token_id != got.token_id
+        assert supervisor.state.tokens[got.token_id] == got.token_secret
+
+    synchronizer.run(_go())

@@ -139,3 +139,40 @@ def test_output_manager_run_progress(supervisor):
     assert "Created function" in text and "noop" in text
     assert "App ready" in text
     assert "stopped" in text
+
+
+# ---------------------------------------------------------------------------
+# import telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_import_telemetry_traces_container_imports(supervisor, monkeypatch):
+    """With import tracing on, every container writes per-module load
+    timings (cold-start attribution, reference _runtime/telemetry.py)."""
+    import os
+
+    import modal_tpu
+    from modal_tpu.runtime.telemetry import summarize
+
+    monkeypatch.setenv("MODAL_TPU_IMPORT_TRACE", "1")
+    app = modal_tpu.App("telemetry-e2e")
+
+    def uses_json(x):
+        import xml.dom.minidom  # an import the entrypoint doesn't pull in
+
+        return x + 1
+
+    f = app.function(serialized=True)(uses_json)
+    with app.run():
+        assert f.remote(1) == 2
+    tasks_dir = os.path.join(supervisor.state_dir, "tasks")
+    trace_files = [
+        os.path.join(tasks_dir, d, "imports.jsonl")
+        for d in os.listdir(tasks_dir)
+        if os.path.exists(os.path.join(tasks_dir, d, "imports.jsonl"))
+    ]
+    assert trace_files, "no import trace written"
+    roots = summarize(trace_files[0], top=1000)
+    modules = {e["module"] for e in roots}
+    assert any(m.startswith("xml") for m in modules), sorted(modules)[:20]
+    assert all(e["duration_s"] >= 0 for e in roots)

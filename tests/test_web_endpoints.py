@@ -1,0 +1,112 @@
+"""Web endpoints: ASGI/WSGI/plain-function HTTP served from the container
+(reference py/modal/_runtime/asgi.py, @app.server / @modal.asgi_app — the
+webhook_type field round 1 recorded but never served)."""
+
+import json
+import urllib.error
+import urllib.request
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+def _post(url: str, payload: dict):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"content-type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+def test_web_endpoint_function(supervisor):
+    """@web_endpoint: JSON-in/JSON-out over real HTTP, query params on GET."""
+    import modal_tpu
+
+    app = modal_tpu.App("web-fn")
+
+    @app.function(serialized=True)
+    @modal_tpu.web_endpoint(method="POST")
+    def square(x=0):
+        return int(x) * int(x)
+
+    with app.run():
+        url = square.get_web_url()
+        assert url.startswith("http://127.0.0.1:")
+        status, body = _post(url, {"x": 7})
+        assert (status, body) == (200, {"result": 49})
+        status, body = _get(url + "?x=5")
+        assert (status, body) == (200, {"result": 25})
+        # user errors surface as HTTP errors, not hung connections
+        try:
+            _post(url, {"nope": 1})
+            raise AssertionError("expected HTTP error")
+        except urllib.error.HTTPError as exc:
+            assert exc.code in (400, 500)
+
+
+def test_asgi_app_endpoint(supervisor):
+    """@asgi_app: the factory's ASGI app is served as-is."""
+    import modal_tpu
+
+    app = modal_tpu.App("web-asgi")
+
+    @app.function(serialized=True)
+    @modal_tpu.asgi_app()
+    def make_app():
+        async def asgi(scope, receive, send):
+            if scope["type"] == "lifespan":
+                while True:
+                    msg = await receive()
+                    if msg["type"] == "lifespan.startup":
+                        await send({"type": "lifespan.startup.complete"})
+                    else:
+                        await send({"type": "lifespan.shutdown.complete"})
+                        return
+            await receive()
+            body = json.dumps({"path": scope["path"], "method": scope["method"]}).encode()
+            await send(
+                {
+                    "type": "http.response.start",
+                    "status": 200,
+                    "headers": [(b"content-type", b"application/json"), (b"content-length", str(len(body)).encode())],
+                }
+            )
+            await send({"type": "http.response.body", "body": body})
+
+        return asgi
+
+    with app.run():
+        url = make_app.get_web_url()
+        status, body = _get(url + "/hello/world")
+        assert status == 200
+        assert body == {"path": "/hello/world", "method": "GET"}
+
+
+def test_wsgi_app_endpoint(supervisor):
+    """@wsgi_app: flask-style WSGI callables work through the bridge."""
+    import modal_tpu
+
+    app = modal_tpu.App("web-wsgi")
+
+    @app.function(serialized=True)
+    @modal_tpu.wsgi_app()
+    def make_app():
+        def wsgi(environ, start_response):
+            body = json.dumps(
+                {"path": environ["PATH_INFO"], "q": environ["QUERY_STRING"]}
+            ).encode()
+            start_response("200 OK", [("Content-Type", "application/json"), ("Content-Length", str(len(body)))])
+            return [body]
+
+        return wsgi
+
+    with app.run():
+        url = make_app.get_web_url()
+        status, body = _get(url + "/w?a=1")
+        assert status == 200
+        assert body == {"path": "/w", "q": "a=1"}
