@@ -120,6 +120,14 @@ async def retry_transient_errors(
             return await fn(*args, metadata=md, timeout=timeout)
         except grpc.aio.AioRpcError as exc:
             code = exc.code()
+            if code == grpc.StatusCode.CANCELLED:
+                # grpc.aio surfaces OUR OWN task cancellation as
+                # AioRpcError(CANCELLED); retrying it would swallow e.g. the
+                # container's SIGTERM drain. Server-side cancels (task not
+                # being cancelled) stay retryable.
+                current = asyncio.current_task()
+                if current is not None and getattr(current, "cancelling", lambda: 0)():
+                    raise asyncio.CancelledError() from exc
             if code == grpc.StatusCode.UNAUTHENTICATED:
                 raise AuthError(exc.details()) from None
             if code == grpc.StatusCode.NOT_FOUND:

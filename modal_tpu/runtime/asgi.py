@@ -25,7 +25,6 @@ from typing import Any, Callable, Optional
 
 from ..config import logger
 
-MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 64 * 1024 * 1024
 
 
@@ -37,7 +36,6 @@ class AsgiHttpServer:
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
-        self._lifespan_send: Optional[asyncio.Queue] = None
         self._lifespan_task: Optional[asyncio.Task] = None
 
     @property
@@ -60,7 +58,6 @@ class AsgiHttpServer:
         """Run one ASGI lifespan phase; apps without lifespan support are
         fine (errors are swallowed per spec)."""
         if phase == "startup":
-            self._lifespan_send = asyncio.Queue()
             state: dict = {}
             self._lifespan_state = state
             scope = {"type": "lifespan", "asgi": {"version": "3.0"}, "state": state}
@@ -109,6 +106,7 @@ class AsgiHttpServer:
         except (asyncio.IncompleteReadError, asyncio.LimitOverrunError, ConnectionError):
             writer.close()
             return
+        started = {"sent": False}
         try:
             request_line, *header_lines = head.decode("latin-1").split("\r\n")
             method, target, _version = request_line.split(" ", 2)
@@ -144,12 +142,15 @@ class AsgiHttpServer:
                 "server": (self.host, self.port),
                 "state": getattr(self, "_lifespan_state", {}),
             }
-            await self._run_app(scope, body, writer)
+            await self._run_app(scope, body, writer, started)
         except Exception as exc:  # noqa: BLE001 — a bad request must not kill the server
             logger.warning(f"web request failed: {exc}")
             try:
-                writer.write(b"HTTP/1.1 500 Internal Server Error\r\ncontent-length: 0\r\n\r\n")
-                await writer.drain()
+                if not started["sent"]:
+                    writer.write(b"HTTP/1.1 500 Internal Server Error\r\ncontent-length: 0\r\n\r\n")
+                    await writer.drain()
+                # response already started: truncate by closing — appending a
+                # second status line would corrupt the stream
             except Exception:
                 pass
         finally:
@@ -158,7 +159,9 @@ class AsgiHttpServer:
             except Exception:
                 pass
 
-    async def _run_app(self, scope: dict, body: bytes, writer: asyncio.StreamWriter) -> None:
+    async def _run_app(
+        self, scope: dict, body: bytes, writer: asyncio.StreamWriter, started: dict
+    ) -> None:
         received = {"done": False}
 
         async def receive():
@@ -166,8 +169,6 @@ class AsgiHttpServer:
                 return {"type": "http.disconnect"}
             received["done"] = True
             return {"type": "http.request", "body": body, "more_body": False}
-
-        started = {"sent": False}
 
         async def send(message):
             if message["type"] == "http.response.start":
@@ -206,20 +207,25 @@ def _reason(status: int) -> str:
 # ---------------------------------------------------------------------------
 
 
+async def _lifespan_protocol(receive, send) -> None:
+    """Politely complete the lifespan protocol for adapters with no
+    startup/shutdown work of their own."""
+    while True:
+        msg = await receive()
+        if msg["type"] == "lifespan.startup":
+            await send({"type": "lifespan.startup.complete"})
+        elif msg["type"] == "lifespan.shutdown":
+            await send({"type": "lifespan.shutdown.complete"})
+            return
+
+
 def wsgi_to_asgi(wsgi_app: Callable) -> Callable:
     """Threaded WSGI→ASGI bridge (reference vendored a2wsgi, simplified:
     whole-body buffering, one worker thread per request)."""
 
     async def app(scope, receive, send):
         if scope["type"] == "lifespan":
-            # WSGI has no lifespan; complete the protocol politely
-            while True:
-                msg = await receive()
-                if msg["type"] == "lifespan.startup":
-                    await send({"type": "lifespan.startup.complete"})
-                elif msg["type"] == "lifespan.shutdown":
-                    await send({"type": "lifespan.shutdown.complete"})
-                    return
+            return await _lifespan_protocol(receive, send)
         body = b""
         while True:
             msg = await receive()
@@ -258,14 +264,18 @@ def wsgi_to_asgi(wsgi_app: Callable) -> Callable:
             result = {"status": 500, "headers": [], "chunks": []}
 
             def start_response(status_line, headers, exc_info=None):
+                if exc_info is not None and result["chunks"]:
+                    raise exc_info[1].with_traceback(exc_info[2])  # PEP 3333
                 result["status"] = int(status_line.split(" ", 1)[0])
                 result["headers"] = [
                     (k.encode(), v.encode()) for k, v in headers
                 ]
+                return result["chunks"].append  # legacy write() protocol
 
             chunks = wsgi_app(environ, start_response)
             try:
-                result["chunks"] = [c for c in chunks]
+                for c in chunks:  # extend: write()-protocol bytes come first
+                    result["chunks"].append(c)
             finally:
                 if hasattr(chunks, "close"):
                     chunks.close()
@@ -290,13 +300,7 @@ def function_to_asgi(fn: Callable, method: str = "POST") -> Callable:
 
     async def app(scope, receive, send):
         if scope["type"] == "lifespan":
-            while True:
-                msg = await receive()
-                if msg["type"] == "lifespan.startup":
-                    await send({"type": "lifespan.startup.complete"})
-                elif msg["type"] == "lifespan.shutdown":
-                    await send({"type": "lifespan.shutdown.complete"})
-                    return
+            return await _lifespan_protocol(receive, send)
         body = b""
         while True:
             msg = await receive()
@@ -324,8 +328,8 @@ def function_to_asgi(fn: Callable, method: str = "POST") -> Callable:
         if scope["method"] not in ("GET", method.upper()):
             await respond(405, {"error": f"method {scope['method']} not allowed"})
             return
+        kwargs: dict = {}
         try:
-            kwargs: dict = {}
             if scope["query_string"]:
                 kwargs.update(
                     {k: v[0] for k, v in urllib.parse.parse_qs(scope["query_string"].decode()).items()}
@@ -336,13 +340,21 @@ def function_to_asgi(fn: Callable, method: str = "POST") -> Callable:
                     await respond(400, {"error": "JSON body must be an object"})
                     return
                 kwargs.update(parsed)
+            # bad arguments are the CALLER's fault (400); anything raised
+            # inside the handler (including TypeErrors) is a 500
+            inspect.signature(fn).bind(**kwargs)
+        except json.JSONDecodeError as exc:
+            await respond(400, {"error": f"invalid JSON body: {exc}"})
+            return
+        except TypeError as exc:
+            await respond(400, {"error": str(exc)})
+            return
+        try:
             if inspect.iscoroutinefunction(fn):
                 result = await fn(**kwargs)
             else:
                 result = await asyncio.to_thread(fn, **kwargs)
             await respond(200, {"result": result})
-        except TypeError as exc:
-            await respond(400, {"error": str(exc)})
         except Exception as exc:  # noqa: BLE001 — surface as a 500 payload
             logger.warning(f"web endpoint raised: {exc}")
             await respond(500, {"error": f"{type(exc).__name__}: {exc}"})

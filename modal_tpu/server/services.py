@@ -49,6 +49,20 @@ class ModalTPUServicer:
     def __init__(self, state: ServerState):
         self.s = state
         self.scheduler = None  # wired by the supervisor (sandbox placement)
+        # failure-injection knobs (reference test servicer,
+        # py/test/conftest.py:715-740: fail_get_inputs,
+        # fail_put_inputs_with_grpc_error, rate_limit_sleep_duration):
+        # counters of how many upcoming calls to fail with UNAVAILABLE
+        self.fail_get_inputs = 0
+        self.fail_put_outputs = 0
+        self.fail_put_inputs = 0
+        self.fail_get_outputs = 0
+        self.rate_limit_sleep_duration = 0.0
+
+    async def _maybe_fail(self, context, knob: str) -> None:
+        if getattr(self, knob) > 0:
+            setattr(self, knob, getattr(self, knob) - 1)
+            await context.abort(grpc.StatusCode.UNAVAILABLE, f"injected fault: {knob}")
 
     # ------------------------------------------------------------------
     # Misc
@@ -86,6 +100,10 @@ class ModalTPUServicer:
         if request.HasField("web_suffix"):
             self.s.environments[current] = request.web_suffix
         if request.HasField("name") and request.name and request.name != current:
+            if request.name in self.s.environments:
+                await context.abort(
+                    grpc.StatusCode.ALREADY_EXISTS, f"environment {request.name!r} already exists"
+                )
             self.s.environments[request.name] = self.s.environments.pop(current)
             # re-key deployments under the new name
             for (env, app_name), app_id in list(self.s.deployed_apps.items()):
@@ -431,9 +449,19 @@ class ModalTPUServicer:
         fn = self.s.functions.get(request.function_id)
         if fn is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
+        if fn.definition.webhook_type == api_pb2.WEB_ENDPOINT_TYPE_UNSPECIFIED:
+            # fast-fail: a non-web function can never grow a URL — don't
+            # make the client wait out the long-poll window
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "function has no web endpoint (webhook_type unset)"
+            )
         deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
-        while not fn.web_url and time.monotonic() < deadline:
+        while time.monotonic() < deadline:
             async with fn.input_condition:
+                # re-check UNDER the lock: a SetWebUrl notify between an
+                # unlocked check and wait() would otherwise be lost
+                if fn.web_url:
+                    break
                 try:
                     await asyncio.wait_for(
                         fn.input_condition.wait(), timeout=max(0.05, deadline - time.monotonic())
@@ -508,6 +536,7 @@ class ModalTPUServicer:
         return resp
 
     async def FunctionPutInputs(self, request, context) -> api_pb2.FunctionPutInputsResponse:
+        await self._maybe_fail(context, "fail_put_inputs")
         fn = self.s.functions.get(request.function_id)
         call = self.s.function_calls.get(request.function_call_id)
         if fn is None or call is None:
@@ -562,6 +591,7 @@ class ModalTPUServicer:
         return api_pb2.MapCheckInputsResponse(lost_idxs=lost)
 
     async def FunctionGetOutputs(self, request: api_pb2.FunctionGetOutputsRequest, context) -> api_pb2.FunctionGetOutputsResponse:
+        await self._maybe_fail(context, "fail_get_outputs")
         call = self.s.function_calls.get(request.function_call_id)
         if call is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"call {request.function_call_id} not found")
@@ -712,6 +742,7 @@ class ModalTPUServicer:
         return resp
 
     async def FunctionGetInputs(self, request: api_pb2.FunctionGetInputsRequest, context) -> api_pb2.FunctionGetInputsResponse:
+        await self._maybe_fail(context, "fail_get_inputs")
         fn = self.s.functions.get(request.function_id)
         task = self.s.tasks.get(request.task_id)
         if fn is None or task is None:
@@ -795,9 +826,13 @@ class ModalTPUServicer:
                         except asyncio.TimeoutError:
                             break
             if items:
-                return api_pb2.FunctionGetInputsResponse(inputs=items)
+                return api_pb2.FunctionGetInputsResponse(
+                    inputs=items, rate_limit_sleep_duration=self.rate_limit_sleep_duration
+                )
             if time.monotonic() >= deadline:
-                return api_pb2.FunctionGetInputsResponse(inputs=[])
+                return api_pb2.FunctionGetInputsResponse(
+                    inputs=[], rate_limit_sleep_duration=self.rate_limit_sleep_duration
+                )
             async with fn.input_condition:
                 try:
                     await asyncio.wait_for(
@@ -807,6 +842,7 @@ class ModalTPUServicer:
                     pass
 
     async def FunctionPutOutputs(self, request: api_pb2.FunctionPutOutputsRequest, context) -> api_pb2.FunctionPutOutputsResponse:
+        await self._maybe_fail(context, "fail_put_outputs")
         touched: set[str] = set()
         pushing_task = self.s.tasks.get(request.task_id) if request.task_id else None
         for item in request.outputs:
@@ -911,6 +947,11 @@ class ModalTPUServicer:
     async def TaskResult(self, request: api_pb2.TaskResultRequest, context) -> api_pb2.TaskResultResponse:
         task = self.s.tasks.get(request.task_id)
         if task is not None:
+            if task.result is not None:
+                # first report wins: the container's own result (e.g.
+                # TERMINATED from a graceful drain) must not be overwritten
+                # by the worker's rc-based backstop report
+                return api_pb2.TaskResultResponse()
             task.result = request.result
             if request.result.status == api_pb2.GENERIC_STATUS_SUCCESS:
                 task.state = api_pb2.TASK_STATE_COMPLETED

@@ -1,0 +1,208 @@
+"""Failure-injection + cancellation tiers (reference test strategy, SURVEY
+§4: servicer knobs conftest.py:715-740, cancellation matrix
+container_test.py / _container_entrypoint.py:194-264)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+
+def test_remote_survives_injected_get_inputs_faults(supervisor):
+    """The container's input loop retries injected UNAVAILABLE on
+    FunctionGetInputs and the call still completes."""
+    import modal_tpu
+
+    app = modal_tpu.App("fi-getinputs")
+
+    def work(x):
+        return x * 2
+
+    f = app.function(serialized=True)(work)
+    with app.run():
+        supervisor.servicer.fail_get_inputs = 3
+        assert f.remote(21) == 42
+        assert supervisor.servicer.fail_get_inputs == 0, "faults must have been consumed"
+
+
+def test_remote_survives_injected_put_outputs_faults(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("fi-putout")
+
+    def work(x):
+        return x + 5
+
+    f = app.function(serialized=True)(work)
+    with app.run():
+        supervisor.servicer.fail_put_outputs = 2
+        assert f.remote(5) == 10
+
+
+def test_map_survives_injected_put_inputs_faults(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("fi-putin")
+
+    def ident(x):
+        return x
+
+    f = app.function(serialized=True)(ident)
+    with app.run():
+        supervisor.servicer.fail_put_inputs = 2
+        assert sorted(f.map([1, 2, 3])) == [1, 2, 3]
+
+
+def test_rate_limit_sleep_is_honored(supervisor):
+    """rate_limit_sleep_duration on GetInputs responses throttles the
+    container's fetch loop without breaking it."""
+    import modal_tpu
+
+    app = modal_tpu.App("fi-rate")
+
+    def work(x):
+        return x
+
+    f = app.function(serialized=True)(work)
+    with app.run():
+        supervisor.servicer.rate_limit_sleep_duration = 0.2
+        try:
+            assert f.remote(1) == 1
+            assert f.remote(2) == 2
+        finally:
+            supervisor.servicer.rate_limit_sleep_duration = 0.0
+
+
+# ---------------------------------------------------------------------------
+# cancellation matrix
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_inflight_input(supervisor):
+    """FunctionCallCancel mid-execution: the input is cancelled via the
+    heartbeat channel and the call reports terminated."""
+    import modal_tpu
+    from modal_tpu.exception import RemoteError
+
+    app = modal_tpu.App("cancel-e2e")
+
+    def slow(x):
+        import time as _t
+
+        _t.sleep(30)
+        return x
+
+    f = app.function(serialized=True)(slow)
+    with app.run():
+        call = f.spawn(1)
+        time.sleep(2.5)  # container picked it up
+        t0 = time.monotonic()
+        call.cancel()
+        with pytest.raises(RemoteError, match="terminated|cancelled"):
+            call.get(timeout=20)
+        assert time.monotonic() - t0 < 15, "cancel must interrupt promptly, not wait out the sleep"
+
+
+def test_cancel_then_container_serves_next_input(supervisor):
+    """A cancelled input must not poison the container: the same container
+    serves subsequent inputs."""
+    import modal_tpu
+    from modal_tpu.exception import RemoteError
+
+    app = modal_tpu.App("cancel-recover")
+
+    def sometimes_slow(x):
+        import os as _os
+        import time as _t
+
+        if x < 0:
+            _t.sleep(30)
+        return x, _os.getpid()
+
+    f = app.function(serialized=True)(sometimes_slow)
+    with app.run():
+        fast_val, pid1 = f.remote(1)
+        call = f.spawn(-1)
+        time.sleep(2.0)
+        call.cancel()
+        with pytest.raises(RemoteError):
+            call.get(timeout=20)
+        val, pid2 = f.remote(7)
+        assert (fast_val, val) == (1, 7)
+        assert pid1 == pid2, "container should survive the cancellation"
+
+
+# ---------------------------------------------------------------------------
+# process-level signal matrix (real container subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_runs_exit_hooks_and_reports(supervisor, tmp_path):
+    """SIGTERM to a real container process: graceful drain — @exit hooks run
+    and the task reports TERMINATED (reference container_test.py
+    process-level variants / _container_entrypoint.py:194-264)."""
+    import modal_tpu
+    from modal_tpu.proto import api_pb2
+
+    marker = str(tmp_path / "exited")
+    app = modal_tpu.App("sig-term")
+
+    @app.cls(serialized=True)
+    class Svc:
+        @modal_tpu.enter()
+        def up(self):
+            self.ready = True
+
+        @modal_tpu.exit()
+        def down(self):
+            with open(marker, "w") as fh:
+                fh.write("clean")
+
+        @modal_tpu.method()
+        def ping(self):
+            return os.getpid()
+
+    with app.run():
+        pid = Svc().ping.remote()
+        worker = supervisor.workers[0]
+        assert worker._procs, "expected a live container"
+        os.kill(pid, signal.SIGTERM)
+        deadline = time.time() + 20
+        while time.time() < deadline and not os.path.exists(marker):
+            time.sleep(0.3)
+    assert os.path.exists(marker), "@exit hook must run on SIGTERM"
+    terminated = [
+        t
+        for t in supervisor.state.tasks.values()
+        if t.result is not None and t.result.status == api_pb2.GENERIC_STATUS_TERMINATED
+    ]
+    assert terminated, "graceful drain must report TaskResult TERMINATED"
+
+
+def test_sigkill_reports_failure_rc(supervisor):
+    """SIGKILL (no chance to drain): the worker reports the container's
+    death so the server releases its bookkeeping."""
+    import modal_tpu
+    from modal_tpu.proto import api_pb2
+
+    app = modal_tpu.App("sig-kill")
+
+    def getpid():
+        return os.getpid()
+
+    f = app.function(serialized=True)(getpid)
+    with app.run():
+        pid = f.remote()
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.time() + 20
+        failed = []
+        while time.time() < deadline and not failed:
+            failed = [
+                t
+                for t in supervisor.state.tasks.values()
+                if t.state in (api_pb2.TASK_STATE_FAILED,) and t.result is not None
+            ]
+            time.sleep(0.3)
+    assert failed, "worker must report the SIGKILLed container"
+    assert "exited with code" in failed[0].result.exception
