@@ -1,0 +1,120 @@
+"""Expert parallelism: switch-style MoE FFN with experts sharded over an
+`expert` mesh axis.
+
+The reference has no in-model parallelism (SURVEY §2d); this completes the
+workload layer's parallelism forms (DP/FSDP/TP/SP + PP in pipeline.py + EP
+here). TPU-first design:
+
+- **Static shapes**: capacity-based top-1 routing (Switch Transformer
+  formulation) — every expert processes exactly `capacity` slots, overflow
+  tokens are dropped (and counted); no data-dependent shapes under jit.
+- **Sharding-driven collectives**: expert weights and the dispatched
+  [E, C, D] activations carry `P('expert')` shardings; XLA inserts the
+  all-to-alls from sharding propagation (the scaling-book recipe: annotate,
+  let the compiler place collectives on ICI) — no hand-written dispatch
+  loops.
+- The load-balancing auxiliary loss (mean fraction x mean router prob per
+  expert, scaled by E) keeps routing trainable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(
+    key: jax.Array, dim: int, ffn_dim: int, n_experts: int, dtype=jnp.float32
+) -> dict:
+    k_r, k_in, k_out = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(stddev=0.02)
+    return {
+        "router": init(k_r, (dim, n_experts), dtype),
+        "w_in": init(k_in, (n_experts, dim, ffn_dim), dtype),
+        "w_out": init(k_out, (n_experts, ffn_dim, dim), dtype),
+    }
+
+
+def moe_param_shardings(mesh: Mesh, axis_name: str = "expert") -> dict:
+    return {
+        "router": NamedSharding(mesh, P()),
+        "w_in": NamedSharding(mesh, P(axis_name)),
+        "w_out": NamedSharding(mesh, P(axis_name)),
+    }
+
+
+def moe_ffn(
+    x: jax.Array,  # [T, D] tokens
+    params: dict,
+    capacity_factor: float = 1.25,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 switch MoE. Returns (y [T, D], aux_loss, dropped_fraction)."""
+    t, d = x.shape
+    e = params["router"].shape[1]
+    capacity = max(1, int(capacity_factor * t / e))
+
+    logits = x @ params["router"]  # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [T, E]
+
+    # slot assignment: position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E], -0 for others
+    keep = (pos < capacity) * onehot  # [T, E] — overflow dropped
+    slot = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = keep[:, :, None] * slot[:, None, :]  # [T, E, C]
+
+    # all-to-all happens HERE via sharding propagation: x is data-sharded,
+    # expert_in is expert-sharded
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, D]
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"]))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])  # [E, C, D]
+    combine = dispatch * gate[:, None, None]  # [T, E, C]
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # Switch load-balancing loss: E * sum_e frac_tokens_e * mean_prob_e
+    frac_tokens = jnp.mean(onehot, axis=0)
+    mean_probs = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(frac_tokens * mean_probs)
+    dropped = 1.0 - jnp.sum(keep) / t
+    return y.astype(x.dtype), aux_loss, dropped
+
+
+def moe_demo(
+    n_experts: int = 4,
+    dim: int = 64,
+    ffn_dim: int = 128,
+    tokens: int = 256,
+    axis_name: str = "expert",
+) -> dict:
+    """Expert-parallel step on a real mesh: weights sharded P('expert'),
+    loss+grad jitted with those shardings (XLA places the all-to-alls).
+    Used by tests + the driver's multichip dryrun."""
+    import numpy as np
+
+    n_dev = min(n_experts, len(jax.devices()))
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]).reshape(n_dev), (axis_name,))
+    shardings = moe_param_shardings(mesh, axis_name)
+    with mesh:
+        params = jax.jit(
+            lambda k: init_moe_params(k, dim, ffn_dim, n_experts), out_shardings=shardings
+        )(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (tokens, dim))
+
+        def loss_fn(p, x):
+            y, aux, dropped = moe_ffn(x, p)
+            return jnp.mean(y**2) + 0.01 * aux, (aux, dropped)
+
+        (loss, (aux, dropped)), grads = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))(params, x)
+        grad_l1 = jax.tree_util.tree_reduce(lambda a, g: a + jnp.sum(jnp.abs(g)), grads, 0.0)
+    return {
+        "loss": float(loss),
+        "aux_loss": float(aux),
+        "dropped_frac": float(dropped),
+        "grad_l1": float(grad_l1),
+    }

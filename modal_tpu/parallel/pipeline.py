@@ -1,0 +1,161 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a `pipe`
+mesh axis.
+
+The reference has no in-model parallelism at all (SURVEY §2d — it provisions
+gangs and hands user code rank+peers); this is workload-layer capability the
+TPU build adds. TPU-first design per the scaling-book pipelining recipe:
+
+- The stacked layer params (leading n_layers axis) are sharded over the
+  `pipe` axis: stage p holds layers [p*L/P, (p+1)*L/P) — no parameter
+  duplication.
+- Microbatches flow through stages with `lax.ppermute` (one ICI hop per
+  tick). A scan over T = M + P - 1 ticks keeps shapes static: every tick,
+  every stage runs its layer block on its current activation (uniform
+  compute, XLA-friendly), then activations rotate one stage forward.
+- Stage 0 injects microbatch t at tick t; stage P-1 collects the finished
+  microbatch at ticks >= P-1. `jax.grad` differentiates straight through
+  the ppermutes, so the backward pipeline is the transposed schedule XLA
+  derives — no hand-written backward pass.
+
+Composes with the data axes: batch dims can still be sharded over
+data/fsdp; `pipe` partitions only the layer dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_loss(
+    params: dict,
+    cfg,
+    tokens: jax.Array,  # [B, S]
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Next-token loss with the layer stack pipelined over `axis_name`.
+
+    `params` follows models.llama.init_params (stacked layers); embed and
+    lm_head stay replicated (small relative to the layer stack at the
+    depths where pipelining pays)."""
+    from ..models.llama import _layer_forward, rms_norm, rope_frequencies
+
+    n_stages = mesh.shape[axis_name]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"pipe={n_stages} must divide n_layers={cfg.n_layers}")
+    b, s = tokens.shape
+    if b % num_microbatches:
+        raise ValueError(f"microbatches {num_microbatches} must divide batch {b}")
+    mb = b // num_microbatches
+    inv_freq = rope_frequencies(cfg)
+
+    # embed outside the pipeline (replicated, cheap): [M, mb, S, D]
+    x = params["embed"][tokens].reshape(num_microbatches, mb, s, cfg.dim)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
+
+    def stage_block(layers_local, act):
+        def body(x_carry, layer):
+            out, _ = _layer_forward(cfg, x_carry, layer, positions, None, inv_freq, None, None, None)
+            return out, None
+
+        act, _ = lax.scan(body, act, layers_local)
+        return act
+
+    def pipelined(layers_local, x_all):
+        # inside shard_map: layers_local is this stage's [L/P, ...] block,
+        # x_all is the replicated microbatch stack
+        stage = lax.axis_index(axis_name)
+        ticks = num_microbatches + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            act, outputs = carry
+            inject = lax.dynamic_index_in_dim(
+                x_all, jnp.minimum(t, num_microbatches - 1), axis=0, keepdims=False
+            )
+            act = jnp.where(stage == 0, inject, act)
+            act = stage_block(layers_local, act)
+            # last stage finishes microbatch (t - P + 1) at tick t
+            out_idx = t - (n_stages - 1)
+            outputs = lax.cond(
+                out_idx >= 0,
+                lambda o: lax.dynamic_update_index_in_dim(o, act, jnp.maximum(out_idx, 0), axis=0),
+                lambda o: o,
+                outputs,
+            )
+            # rotate one stage forward (ICI neighbor hop)
+            act = lax.ppermute(act, axis_name, perm)
+            return (act, outputs), None
+
+        act0 = jnp.zeros((mb, s, cfg.dim), x_all.dtype)
+        outputs0 = jnp.zeros((num_microbatches, mb, s, cfg.dim), x_all.dtype)
+        (_, outputs), _ = lax.scan(tick, (act0, outputs0), jnp.arange(ticks))
+        # only the LAST stage's collection is real; mask + psum replicates
+        # the result across the axis (as out_specs=P() requires)
+        outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+        return lax.psum(outputs, axis_name)
+
+    layer_spec = jax.tree_util.tree_map(lambda _: P(axis_name), params["layers"])
+    out = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(layer_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(params["layers"], x)
+
+    # head + loss outside the pipeline
+    h = rms_norm(out.reshape(b, s, cfg.dim), params["final_norm"], cfg.norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def pipeline_param_shardings(mesh: Mesh, cfg, axis_name: str = "pipe") -> dict:
+    """NamedShardings: stacked layers split across pipe stages; the small
+    embed/head tensors replicated."""
+    from ..models.llama import init_params_abstract
+
+    abstract = init_params_abstract(cfg)
+    return {
+        "embed": NamedSharding(mesh, P()),
+        "final_norm": NamedSharding(mesh, P()),
+        "lm_head": NamedSharding(mesh, P()),
+        "layers": jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P(axis_name)), abstract["layers"]
+        ),
+    }
+
+
+def pipeline_demo(
+    cfg_name: str = "tiny",
+    n_stages: int = 2,
+    num_microbatches: int = 4,
+    batch: int = 8,
+    seq_len: int = 64,
+) -> dict:
+    """Build a pipe mesh, shard the layer stack, take one pipelined
+    loss+grad step (used by tests + the driver's multichip dryrun)."""
+    import numpy as np
+
+    from ..models.llama import get_config, init_params
+
+    cfg = get_config(cfg_name)
+    devices = np.asarray(jax.devices()[:n_stages]).reshape(n_stages)
+    mesh = Mesh(devices, ("pipe",))
+    shardings = pipeline_param_shardings(mesh, cfg)
+    params = jax.jit(lambda k: init_params(cfg, k), out_shardings=shardings)(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq_len), 0, cfg.vocab_size, jnp.int32
+    )
+    loss_fn = functools.partial(pipeline_loss, cfg=cfg, mesh=mesh, num_microbatches=num_microbatches)
+    loss, grads = jax.jit(jax.value_and_grad(lambda p: loss_fn(p, tokens=tokens)))(params)
+    grad_l1 = jax.tree_util.tree_reduce(lambda a, g: a + jnp.sum(jnp.abs(g)), grads, 0.0)
+    return {"loss": float(loss), "grad_l1": float(grad_l1)}

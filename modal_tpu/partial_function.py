@@ -276,16 +276,16 @@ def find_callables_for_obj(user_obj: Any, flags: int) -> dict[str, Callable]:
     }
 
 
-def _web_decorator(webhook_type_name: str, decorator_name: str, method: Optional[str] = None):
+def _web_decorator(webhook_type_name: str, method: Optional[str] = None):
     """Shared factory for the web decorators (they differ only in
     webhook_type and the optional HTTP-method param)."""
     from .proto import api_pb2
 
-    params = _PartialFunctionParams(
-        webhook_type=getattr(api_pb2, webhook_type_name), web_method=method
-    )
-
     def wrapper(raw_f: Callable) -> _PartialFunction:
+        # fresh params per decorated function — no shared mutable state
+        params = _PartialFunctionParams(
+            webhook_type=getattr(api_pb2, webhook_type_name), web_method=method
+        )
         if isinstance(raw_f, _PartialFunction):
             if raw_f.params.webhook_type is not None:
                 raise InvalidError(f"{raw_f.name} already has a web decorator")
@@ -305,7 +305,7 @@ def web_endpoint(
     dependency-free JSON adapter — runtime/asgi.py function_to_asgi)."""
     if _warn_parentheses_missing is not None:
         raise InvalidError("Use @modal_tpu.web_endpoint() with parentheses.")
-    return _web_decorator("WEB_ENDPOINT_TYPE_FUNCTION", "web_endpoint", method=method)
+    return _web_decorator("WEB_ENDPOINT_TYPE_FUNCTION", method=method)
 
 
 def asgi_app(
@@ -315,7 +315,7 @@ def asgi_app(
     (reference @modal.asgi_app, _runtime/asgi.py)."""
     if _warn_parentheses_missing is not None:
         raise InvalidError("Use @modal_tpu.asgi_app() with parentheses.")
-    return _web_decorator("WEB_ENDPOINT_TYPE_ASGI_APP", "asgi_app")
+    return _web_decorator("WEB_ENDPOINT_TYPE_ASGI_APP")
 
 
 def wsgi_app(
@@ -325,4 +325,4 @@ def wsgi_app(
     the threaded WSGI bridge (reference @modal.wsgi_app / vendored a2wsgi)."""
     if _warn_parentheses_missing is not None:
         raise InvalidError("Use @modal_tpu.wsgi_app() with parentheses.")
-    return _web_decorator("WEB_ENDPOINT_TYPE_WSGI_APP", "wsgi_app")
+    return _web_decorator("WEB_ENDPOINT_TYPE_WSGI_APP")

@@ -49,20 +49,9 @@ class ModalTPUServicer:
     def __init__(self, state: ServerState):
         self.s = state
         self.scheduler = None  # wired by the supervisor (sandbox placement)
-        # failure-injection knobs (reference test servicer,
-        # py/test/conftest.py:715-740: fail_get_inputs,
-        # fail_put_inputs_with_grpc_error, rate_limit_sleep_duration):
-        # counters of how many upcoming calls to fail with UNAVAILABLE
-        self.fail_get_inputs = 0
-        self.fail_put_outputs = 0
-        self.fail_put_inputs = 0
-        self.fail_get_outputs = 0
+        # real throttling control surfaced to containers on every GetInputs
+        # response (reference rate_limit_sleep_duration)
         self.rate_limit_sleep_duration = 0.0
-
-    async def _maybe_fail(self, context, knob: str) -> None:
-        if getattr(self, knob) > 0:
-            setattr(self, knob, getattr(self, knob) - 1)
-            await context.abort(grpc.StatusCode.UNAVAILABLE, f"injected fault: {knob}")
 
     # ------------------------------------------------------------------
     # Misc
@@ -536,7 +525,6 @@ class ModalTPUServicer:
         return resp
 
     async def FunctionPutInputs(self, request, context) -> api_pb2.FunctionPutInputsResponse:
-        await self._maybe_fail(context, "fail_put_inputs")
         fn = self.s.functions.get(request.function_id)
         call = self.s.function_calls.get(request.function_call_id)
         if fn is None or call is None:
@@ -591,7 +579,6 @@ class ModalTPUServicer:
         return api_pb2.MapCheckInputsResponse(lost_idxs=lost)
 
     async def FunctionGetOutputs(self, request: api_pb2.FunctionGetOutputsRequest, context) -> api_pb2.FunctionGetOutputsResponse:
-        await self._maybe_fail(context, "fail_get_outputs")
         call = self.s.function_calls.get(request.function_call_id)
         if call is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"call {request.function_call_id} not found")
@@ -742,7 +729,6 @@ class ModalTPUServicer:
         return resp
 
     async def FunctionGetInputs(self, request: api_pb2.FunctionGetInputsRequest, context) -> api_pb2.FunctionGetInputsResponse:
-        await self._maybe_fail(context, "fail_get_inputs")
         fn = self.s.functions.get(request.function_id)
         task = self.s.tasks.get(request.task_id)
         if fn is None or task is None:
@@ -842,7 +828,6 @@ class ModalTPUServicer:
                     pass
 
     async def FunctionPutOutputs(self, request: api_pb2.FunctionPutOutputsRequest, context) -> api_pb2.FunctionPutOutputsResponse:
-        await self._maybe_fail(context, "fail_put_outputs")
         touched: set[str] = set()
         pushing_task = self.s.tasks.get(request.task_id) if request.task_id else None
         for item in request.outputs:

@@ -32,6 +32,7 @@ class LocalSupervisor:
         state_dir: Optional[str] = None,
         worker_chips: Optional[int] = None,
         worker_tpu_type: Optional[str] = None,
+        servicer_cls: type = ModalTPUServicer,  # tests inject fault-wrapping subclasses
     ):
         self.num_workers = num_workers
         self.port = port
@@ -39,7 +40,7 @@ class LocalSupervisor:
         self.worker_chips = worker_chips
         self.worker_tpu_type = worker_tpu_type
         self.state = ServerState(self.state_dir)
-        self.servicer = ModalTPUServicer(self.state)
+        self.servicer = servicer_cls(self.state)
         self.scheduler = Scheduler(self.state, self.servicer)
         self.servicer.scheduler = self.scheduler
         self.blob_server = BlobServer(self.state)

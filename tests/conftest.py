@@ -57,6 +57,47 @@ def tmp_state_dir(tmp_path, monkeypatch):
     return tmp_path / "state"
 
 
+def _make_fault_injecting_servicer():
+    """Test-only servicer subclass with failure-injection knobs (reference
+    MockClientServicer pattern, py/test/conftest.py:715-740): counters of
+    upcoming data-plane calls to fail with UNAVAILABLE. The production
+    servicer stays clean — tests flip `supervisor.servicer.fail_*`."""
+    import grpc as _grpc
+
+    from modal_tpu.server.services import ModalTPUServicer
+
+    class FaultInjectingServicer(ModalTPUServicer):
+        def __init__(self, state):
+            super().__init__(state)
+            self.fail_get_inputs = 0
+            self.fail_put_outputs = 0
+            self.fail_put_inputs = 0
+            self.fail_get_outputs = 0
+
+        async def _maybe_fail(self, context, knob: str) -> None:
+            if getattr(self, knob) > 0:
+                setattr(self, knob, getattr(self, knob) - 1)
+                await context.abort(_grpc.StatusCode.UNAVAILABLE, f"injected fault: {knob}")
+
+        async def FunctionGetInputs(self, request, context):
+            await self._maybe_fail(context, "fail_get_inputs")
+            return await super().FunctionGetInputs(request, context)
+
+        async def FunctionPutOutputs(self, request, context):
+            await self._maybe_fail(context, "fail_put_outputs")
+            return await super().FunctionPutOutputs(request, context)
+
+        async def FunctionPutInputs(self, request, context):
+            await self._maybe_fail(context, "fail_put_inputs")
+            return await super().FunctionPutInputs(request, context)
+
+        async def FunctionGetOutputs(self, request, context):
+            await self._maybe_fail(context, "fail_get_outputs")
+            return await super().FunctionGetOutputs(request, context)
+
+    return FaultInjectingServicer
+
+
 @pytest.fixture
 def supervisor(tmp_path, monkeypatch):
     """An in-process control plane + 1 worker (real gRPC on localhost),
@@ -71,7 +112,11 @@ def supervisor(tmp_path, monkeypatch):
     # worker_chips skips the slow jax-probe subprocess and simulates an
     # 8-chip host; containers run CPU jax with forced device counts.
     sup = LocalSupervisor(
-        num_workers=1, state_dir=str(tmp_path / "state"), worker_chips=8, worker_tpu_type="local-sim"
+        num_workers=1,
+        state_dir=str(tmp_path / "state"),
+        worker_chips=8,
+        worker_tpu_type="local-sim",
+        servicer_cls=_make_fault_injecting_servicer(),
     )
     synchronizer.run(sup.start())
     monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{sup.port}")
