@@ -76,13 +76,15 @@ def _make_app(tpu_type: str, timeout_s: int):
         import jax.numpy as jnp
 
         from modal_tpu.models.llama import KVCache, get_config, init_params
-        from modal_tpu.models.sampling import benchmark_decode, decode_step, prefill
+        from modal_tpu.models.sampling import benchmark_decode, decode_tokens, prefill
 
         cfg = get_config(model_name)
         cache_len = min(cfg.max_seq_len, prompt_len + gen_len + 8)
         if cmd == "warmup":
-            # cold path: weights on device + prefill + ONE decode step.
-            # The server's first_output_at for this call IS first-step time.
+            # cold path: weights on device + prefill + the FUSED decode scan
+            # (the SAME program the measure phase times, so cold numbers
+            # describe the real decode path). The server's first_output_at
+            # for this call IS cold-start-to-first-step.
             t0 = _time.perf_counter()
             params = init_params(cfg, jax.random.PRNGKey(0))
             jax.block_until_ready(params)
@@ -95,9 +97,9 @@ def _make_app(tpu_type: str, timeout_s: int):
             prefill_s = _time.perf_counter() - t0
             next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
             t0 = _time.perf_counter()
-            logits, cache = decode_step(params, cfg, next_tok, cache)
-            logits.block_until_ready()
-            first_decode_s = _time.perf_counter() - t0
+            toks, _, cache = decode_tokens(params, cfg, next_tok, cache, gen_len)
+            toks.block_until_ready()
+            first_sequence_s = _time.perf_counter() - t0
             _BENCH_STATE["params"] = params
             devices = jax.devices()
             return {
@@ -106,7 +108,7 @@ def _make_app(tpu_type: str, timeout_s: int):
                 "params_b": cfg.param_count() / 1e9,
                 "weights_init_s": init_s,
                 "prefill_compile_s": prefill_s,
-                "first_decode_step_s": first_decode_s,
+                "first_sequence_s": first_sequence_s,
             }
         # warm path: steady-state throughput on the same container
         params = _BENCH_STATE["params"]

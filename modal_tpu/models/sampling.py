@@ -1,8 +1,12 @@
-"""Decode loops: prefill + single-token steps with a static KV cache.
+"""Decode: prefill + fused greedy generation with a static KV cache.
 
-TPU-first: the decode step is one fixed-shape jitted function (cache donated,
-so XLA updates HBM in place); the python loop only feeds tokens. Greedy and
-temperature sampling.
+TPU-first: generation runs as ONE compiled program (`lax.scan` over decode
+steps, cache donated so XLA updates HBM in place) — a single dispatch for
+the whole sequence instead of a host↔device round trip per token (the
+difference between usable and unusable throughput over a remote/tunneled
+chip). `greedy_generate` decodes in fixed-size chunks so ONE executable
+serves any generation length (no per-length recompiles); `decode_step`
+remains for callers that need token-at-a-time streaming.
 """
 
 from __future__ import annotations
@@ -13,6 +17,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .llama import KVCache, LlamaConfig, forward
 
@@ -32,6 +37,35 @@ def decode_step(params: dict, cfg: LlamaConfig, token: jax.Array, cache: KVCache
     return logits[:, -1, :], cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "num_tokens"), donate_argnames=("cache",))
+def decode_tokens(
+    params: dict,
+    cfg: LlamaConfig,
+    first_token: jax.Array,  # [B, 1]
+    cache: KVCache,
+    num_tokens: int,
+):
+    """Generate `num_tokens` greedily inside ONE compiled program
+    (`lax.scan` over decode steps). One dispatch for the whole generation —
+    this is what makes tunneled/remote TPU decode fast: per-step python
+    dispatch costs a host↔device round trip per token, the scan costs one.
+
+    Returns (tokens [B, num_tokens], final_token [B, 1], cache)."""
+
+    def step(carry, _):
+        tok, c = carry
+        logits, c = forward(params, cfg, tok, cache=c)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1, keepdims=True).astype(jnp.int32)
+        return (nxt, c), tok
+
+    (final_tok, cache), toks = lax.scan(step, (first_token, cache), length=num_tokens)
+    # toks: [T, B, 1] — emitted tokens INCLUDE first_token, exclude final
+    return toks[:, :, 0].T, final_tok, cache
+
+
+DECODE_CHUNK = 64  # one compiled program serves any length (pad + truncate)
+
+
 def greedy_generate(
     params: dict,
     cfg: LlamaConfig,
@@ -39,17 +73,30 @@ def greedy_generate(
     max_new_tokens: int,
     cache_len: Optional[int] = None,
 ) -> jax.Array:
-    """Greedy decode. Returns [B, S + max_new_tokens]."""
+    """Greedy decode. Returns [B, S + max_new_tokens].
+
+    Decodes in DECODE_CHUNK-token fused scans: every chunk reuses the same
+    compiled executable, so varying generation lengths never recompile
+    (waste is at most CHUNK-1 surplus steps on the final chunk, truncated
+    from the output). Falls back to one exact-length scan when the cache
+    has no room for the padding."""
     b, s = prompt.shape
-    cache = KVCache.create(cfg, b, cache_len or cfg.max_seq_len)
+    n_chunks = -(-max_new_tokens // DECODE_CHUNK)
+    padded = n_chunks * DECODE_CHUNK
+    cache_len = cache_len or cfg.max_seq_len
+    cache = KVCache.create(cfg, b, cache_len)
     logits, cache = prefill(params, cfg, prompt, cache)
-    tokens = [prompt]
     next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
-    for _ in range(max_new_tokens):
-        tokens.append(next_tok)
-        logits, cache = decode_step(params, cfg, next_tok, cache)
-        next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
-    return jnp.concatenate(tokens, axis=1)
+    if s + padded > cache_len:
+        # not enough cache for chunk padding: single exact-length program
+        toks, _final, _cache = decode_tokens(params, cfg, next_tok, cache, max_new_tokens)
+        return jnp.concatenate([prompt, toks], axis=1)
+    pieces = []
+    for _ in range(n_chunks):
+        toks, next_tok, cache = decode_tokens(params, cfg, next_tok, cache, DECODE_CHUNK)
+        pieces.append(toks)
+    out = jnp.concatenate(pieces, axis=1)[:, :max_new_tokens]
+    return jnp.concatenate([prompt, out], axis=1)
 
 
 def benchmark_decode(
@@ -71,18 +118,19 @@ def benchmark_decode(
     prefill_compile_s = time.perf_counter() - t0
 
     next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
+    # AOT-compile the FUSED decode program (whole generation = one lax.scan
+    # = one dispatch — per-token python dispatch costs a host↔device round
+    # trip per step, brutal over a tunneled TPU). lower().compile() builds
+    # the executable WITHOUT executing, so no second cache allocation.
     t0 = time.perf_counter()
-    logits, cache = decode_step(params, cfg, next_tok, cache)
-    logits.block_until_ready()
+    compiled_decode = decode_tokens.lower(params, cfg, next_tok, cache, gen_len).compile()
     decode_compile_s = time.perf_counter() - t0
 
-    # timed decode loop (steady state)
-    next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
+    # timed steady-state fused generation (uses the real prefilled cache;
+    # the AOT executable takes only the non-static args)
     t0 = time.perf_counter()
-    for _ in range(gen_len):
-        logits, cache = decode_step(params, cfg, next_tok, cache)
-        next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
-    next_tok.block_until_ready()
+    toks, next_tok, cache = compiled_decode(params, next_tok, cache)
+    toks.block_until_ready()
     decode_s = time.perf_counter() - t0
 
     # timed prefill (warm)

@@ -132,3 +132,27 @@ def test_e2e_tpu_function(supervisor):
         total, n_dev = f.remote(8)
         assert n_dev == 4, f"expected 4 simulated chips, got {n_dev}"
         assert total == float(sum(2 * i for i in range(32)))
+
+
+def test_fused_decode_matches_per_step_loop():
+    """greedy_generate (fused lax.scan chunks, incl. the pad+truncate path
+    for non-chunk-multiple lengths) must be token-identical to a per-step
+    decode_step loop."""
+    from modal_tpu.models.sampling import KVCache, decode_step, greedy_generate, prefill
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, cfg.vocab_size, jnp.int32)
+
+    out = greedy_generate(params, cfg, prompt, 70, cache_len=256)  # 70 % 64 != 0
+
+    cache = KVCache.create(cfg, 2, 256)
+    logits, cache = prefill(params, cfg, prompt, cache)
+    toks = [prompt]
+    nxt = jnp.argmax(logits, -1, keepdims=True).astype(jnp.int32)
+    for _ in range(70):
+        toks.append(nxt)
+        logits, cache = decode_step(params, cfg, nxt, cache)
+        nxt = jnp.argmax(logits, -1, keepdims=True).astype(jnp.int32)
+    ref = jnp.concatenate(toks, axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
