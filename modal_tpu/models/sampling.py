@@ -149,3 +149,84 @@ def benchmark_decode(
         "decode_tokens_per_s": batch * gen_len / decode_s,
         "ms_per_token": decode_s / gen_len * 1000,
     }
+
+
+def _filter_logits(logits: jax.Array, temperature: float, top_k: int) -> jax.Array:
+    """Temperature scale + top-k mask (shared by the fused scan and the
+    first-token path so both sample the same distribution)."""
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        # lax.top_k: O(V) threshold, not a full-vocab sort in the hot loop
+        kth = lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
+
+
+@partial(jax.jit, static_argnames=("cfg", "num_tokens", "top_k"), donate_argnames=("cache",))
+def sample_tokens(
+    params: dict,
+    cfg: LlamaConfig,
+    first_token: jax.Array,  # [B, 1]
+    cache: KVCache,
+    num_tokens: int,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+):
+    """Temperature / top-k sampling, fused like `decode_tokens` (one
+    compiled scan = one dispatch for the whole generation)."""
+
+    def step(carry, step_key):
+        tok, c = carry
+        logits, c = forward(params, cfg, tok, cache=c)
+        logits = _filter_logits(logits[:, -1, :], temperature, top_k)
+        nxt = jax.random.categorical(step_key, logits, axis=-1)[:, None].astype(jnp.int32)
+        return (nxt, c), tok
+
+    keys = jax.random.split(key, num_tokens)
+    (final_tok, cache), toks = lax.scan(step, (first_token, cache), keys)
+    return toks[:, :, 0].T, final_tok, cache
+
+
+def sample_generate(
+    params: dict,
+    cfg: LlamaConfig,
+    prompt: jax.Array,  # [B, S] int32
+    max_new_tokens: int,
+    *,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    cache_len: Optional[int] = None,
+) -> jax.Array:
+    """Stochastic decode (temperature + optional top-k). Returns
+    [B, S + max_new_tokens]. Chunked like `greedy_generate` so one compiled
+    executable serves any generation length."""
+    b, s = prompt.shape
+    cache_len = cache_len or cfg.max_seq_len
+    if s + max_new_tokens > cache_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds cache_len "
+            f"({cache_len}) — the KV cache would overflow"
+        )
+    n_chunks = -(-max_new_tokens // DECODE_CHUNK)
+    padded = n_chunks * DECODE_CHUNK
+    cache = KVCache.create(cfg, b, cache_len)
+    logits, cache = prefill(params, cfg, prompt, cache)
+    first_key, gen_key = jax.random.split(key)
+    first_logits = _filter_logits(logits, temperature, top_k)
+    next_tok = jax.random.categorical(first_key, first_logits, axis=-1)[:, None].astype(jnp.int32)
+    if s + padded > cache_len:
+        # no room for chunk padding: one exact-length program
+        toks, _final, _cache = sample_tokens(
+            params, cfg, next_tok, cache, max_new_tokens, gen_key, temperature, top_k
+        )
+        return jnp.concatenate([prompt, toks], axis=1)
+    pieces = []
+    for chunk_key in jax.random.split(gen_key, n_chunks):
+        toks, next_tok, cache = sample_tokens(
+            params, cfg, next_tok, cache, DECODE_CHUNK, chunk_key, temperature, top_k
+        )
+        pieces.append(toks)
+    out = jnp.concatenate(pieces, axis=1)[:, :max_new_tokens]
+    return jnp.concatenate([prompt, out], axis=1)

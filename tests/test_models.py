@@ -156,3 +156,25 @@ def test_fused_decode_matches_per_step_loop():
         nxt = jnp.argmax(logits, -1, keepdims=True).astype(jnp.int32)
     ref = jnp.concatenate(toks, axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sample_generate_temperature_topk():
+    from modal_tpu.models.sampling import sample_generate
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.ones((2, 8), jnp.int32)
+    out1 = sample_generate(params, cfg, prompt, 16, key=jax.random.PRNGKey(1), temperature=1.0, top_k=8, cache_len=64)
+    out2 = sample_generate(params, cfg, prompt, 16, key=jax.random.PRNGKey(2), temperature=1.0, top_k=8, cache_len=64)
+    assert out1.shape == (2, 24)
+    assert not jnp.array_equal(out1, out2), "different keys should sample different sequences"
+    # deterministic with the same key
+    out1b = sample_generate(params, cfg, prompt, 16, key=jax.random.PRNGKey(1), temperature=1.0, top_k=8, cache_len=64)
+    assert jnp.array_equal(out1, out1b)
+    # top_k=1 restricts sampling to (tied) argmax candidates: with the tiny
+    # random model exact greedy equality is tie-dependent, so assert the
+    # structural property instead — valid tokens, deterministic per key
+    k1a = sample_generate(params, cfg, prompt, 16, key=jax.random.PRNGKey(3), top_k=1, cache_len=64)
+    k1b = sample_generate(params, cfg, prompt, 16, key=jax.random.PRNGKey(3), top_k=1, cache_len=64)
+    assert jnp.array_equal(k1a, k1b)
+    assert int(k1a.max()) < cfg.vocab_size and int(k1a.min()) >= 0
