@@ -133,6 +133,48 @@ def deploy(ref: str, name: Optional[str], env: Optional[str], tag: str) -> None:
 
 
 @cli.command()
+@click.option("--cmd", "-c", "command", default=None, help="Run one command instead of an interactive shell.")
+@click.option("--tpu", default=None, help="TPU slice for the shell sandbox, e.g. v5e-1.")
+def shell(command: Optional[str], tpu: Optional[str]) -> None:
+    """Open a shell (or run one command) in a fresh sandbox (reference
+    cli/shell.py — line-based here, no PTY)."""
+    from ..sandbox import Sandbox
+
+    def run_and_echo(sb, line: str) -> int:
+        p = sb.exec("sh", "-c", line)
+        rc = p.wait()
+        out = p.stdout.read()
+        err = p.stderr.read()
+        if out:
+            sys.stdout.write(out)
+            sys.stdout.flush()
+        if err:
+            sys.stderr.write(err)
+            sys.stderr.flush()
+        return rc
+
+    # timeout matches the keep-alive sleep: the default 600s would kill an
+    # interactive session mid-use
+    sb = Sandbox.create("sleep", "86400", tpu=tpu, timeout=86400)
+    try:
+        if command:
+            raise SystemExit(run_and_echo(sb, command))
+        click.echo("modal-tpu shell (line-based; 'exit' to quit)", err=True)
+        while True:
+            try:
+                line = input("$ ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            if line.strip() in ("exit", "quit"):
+                break
+            if not line.strip():
+                continue
+            run_and_echo(sb, line)
+    finally:
+        sb.terminate()
+
+
+@cli.command()
 @click.argument("ref")
 @click.option("--name", default=None)
 def serve(ref: str, name: Optional[str]) -> None:
@@ -242,6 +284,35 @@ def app_logs(app_id: str, follow: bool, task_id: str) -> None:
         )
     except KeyboardInterrupt:
         pass
+
+
+@app_group.command("imports")
+@click.argument("task_id")
+@click.option("--top", default=15, help="Show the N slowest top-level imports.")
+@click.option(
+    "--state-dir",
+    default=None,
+    help="Worker state dir holding the trace (defaults to the local config "
+    "state_dir — this command reads worker-LOCAL files, so point it at the "
+    "server's --state-dir when that differs).",
+)
+def app_imports(task_id: str, top: int, state_dir: Optional[str]) -> None:
+    """Slowest imports of a container (cold-start attribution; requires
+    MODAL_TPU_IMPORT_TRACE=1 when the app ran)."""
+    import os
+
+    from ..config import config as _config
+    from ..runtime.telemetry import summarize
+
+    root = state_dir or _config["state_dir"]
+    path = os.path.join(root, "tasks", task_id, "imports.jsonl")
+    if not os.path.exists(path):
+        raise click.ClickException(
+            f"no import trace at {path} (run with MODAL_TPU_IMPORT_TRACE=1; "
+            "pass --state-dir if the server uses a different state dir)"
+        )
+    for event in summarize(path, top=top):
+        click.echo(f"{event['duration_s']*1000:10.1f} ms  {event['module']}")
 
 
 @app_group.command("history")

@@ -99,3 +99,29 @@ def test_cli_secret_lifecycle(cli_runner):
     assert "cli-secret" in cli_runner("secret", "list")
     cli_runner("secret", "delete", "cli-secret")
     assert "cli-secret" not in cli_runner("secret", "list")
+
+
+def test_cli_shell_single_command(cli_runner):
+    """`shell --cmd` runs one command in a fresh sandbox via the command
+    router and exits with its code (reference cli/shell.py, non-PTY)."""
+    out = cli_runner("shell", "--cmd", "echo from-shell; echo err-side >&2")
+    assert "from-shell" in out
+
+    from modal_tpu.cli.entry_point import cli
+
+    result = CliRunner().invoke(cli, ["shell", "--cmd", "exit 7"])
+    assert result.exit_code == 7
+
+
+def test_cli_app_imports(cli_runner, app_script, supervisor, monkeypatch):
+    monkeypatch.setenv("MODAL_TPU_IMPORT_TRACE", "1")
+    cli_runner("run", f"{app_script}::main")
+    import os
+
+    tasks_dir = os.path.join(supervisor.state_dir, "tasks")
+    task_id = next(
+        d for d in os.listdir(tasks_dir) if os.path.exists(os.path.join(tasks_dir, d, "imports.jsonl"))
+    )
+    monkeypatch.setenv("MODAL_TPU_STATE_DIR", supervisor.state_dir)
+    out = cli_runner("app", "imports", task_id)
+    assert "ms" in out and "modal_tpu" in out
