@@ -34,6 +34,7 @@ class TaskRouterClient:
         self._control_stub = control_stub
         self.sandbox_id = sandbox_id
         self.task_id: str = ""
+        self._metadata: list[tuple[str, str]] = []  # x-task-token bearer auth
         self._channel: Optional[grpc.aio.Channel] = None
         self._stub: Optional[TaskRouterStub] = None
         self._lock = asyncio.Lock()
@@ -55,6 +56,9 @@ class TaskRouterClient:
                         api_pb2.SandboxGetCommandRouterAccessRequest(sandbox_id=self.sandbox_id)
                     )
                     self.task_id = access.task_id
+                    self._metadata = (
+                        [("x-task-token", access.router_token)] if access.router_token else []
+                    )
                     self._channel = create_channel(f"grpc://{access.router_address}")
                     self._stub = TaskRouterStub(self._channel)
                     try:
@@ -62,7 +66,8 @@ class TaskRouterClient:
                         # registered (NOT_FOUND here IS transient — the
                         # worker may not have registered the task yet)
                         await self._stub.TaskFsOp(
-                            api_pb2.TaskFsOpRequest(task_id=self.task_id, op="stat", path=".")
+                            api_pb2.TaskFsOpRequest(task_id=self.task_id, op="stat", path="."),
+                            metadata=self._metadata,
                         )
                     except grpc.aio.AioRpcError as probe_exc:
                         if probe_exc.code() not in (
@@ -122,6 +127,7 @@ class TaskRouterClient:
                 timeout_secs=timeout_secs,
                 exec_id=exec_id,
             ),
+            metadata=self._metadata,
         )
         return resp.exec_id
 
@@ -136,7 +142,8 @@ class TaskRouterClient:
                 async for chunk in stub.TaskExecStdioRead(
                     api_pb2.TaskExecStdioReadRequest(
                         exec_id=exec_id, file_descriptor=fd, offset=offset, timeout=55.0
-                    )
+                    ),
+                    metadata=self._metadata,
                 ):
                     if chunk.data:
                         # server streams from our offset; drop any overlap
@@ -167,6 +174,7 @@ class TaskRouterClient:
                 api_pb2.TaskExecPutInputRequest(
                     exec_id=exec_id, data=chunk, offset=offset + sent, eof=eof and is_last
                 ),
+                metadata=self._metadata,
             )
             acked = resp.acked_offset
             sent += len(chunk)
@@ -186,6 +194,7 @@ class TaskRouterClient:
                 stub.TaskExecWait,
                 api_pb2.TaskExecWaitRequest(exec_id=exec_id, timeout=window),
                 attempt_timeout=window + 10.0,
+                metadata=self._metadata,
             )
             if resp.completed:
                 return resp.returncode
@@ -205,5 +214,5 @@ class TaskRouterClient:
             # a retry after a lost response would append bytes twice, fail a
             # completed mv/rm with NOT_FOUND, or fail a completed mkdir with
             # EEXIST — no transparent retries for these
-            return await stub.TaskFsOp(req)
-        return await retry_transient_errors(stub.TaskFsOp, req)
+            return await stub.TaskFsOp(req, metadata=self._metadata)
+        return await retry_transient_errors(stub.TaskFsOp, req, metadata=self._metadata)

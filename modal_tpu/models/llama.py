@@ -224,12 +224,14 @@ def _layer_forward(
     cache_offset: Optional[jax.Array],
     attn_impl: Optional[Any] = None,  # custom attention (ring/pallas); (q,k,v,mask)->out
 ) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
+    from .quant import qmm
+
     b, s, d = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ layer["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ layer["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    q = qmm(h, layer["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = qmm(h, layer["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+    v = qmm(h, layer["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
@@ -246,11 +248,11 @@ def _layer_forward(
     n_rep = cfg.n_heads // cfg.n_kv_heads
     attn_fn = attn_impl or attention
     attn_out = attn_fn(q, repeat_kv(k_att, n_rep), repeat_kv(v_att, n_rep), mask)
-    x = x + attn_out.reshape(b, s, cfg.n_heads * hd) @ layer["wo"]
+    x = x + qmm(attn_out.reshape(b, s, cfg.n_heads * hd), layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu((h @ layer["w_gate"]).astype(jnp.float32)).astype(x.dtype) * (h @ layer["w_up"])
-    x = x + gated @ layer["w_down"]
+    gated = jax.nn.silu(qmm(h, layer["w_gate"]).astype(jnp.float32)).astype(x.dtype) * qmm(h, layer["w_up"])
+    x = x + qmm(gated, layer["w_down"])
     return x, new_cache
 
 
@@ -271,7 +273,9 @@ def forward(
         base = cache.length if cache is not None else jnp.zeros((), jnp.int32)
         positions = base + jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
-    x = params["embed"][tokens]  # gather: [B, S, D]
+    from .quant import qembed, qmm
+
+    x = qembed(params["embed"], tokens)  # gather: [B, S, D]
     inv_freq = rope_frequencies(cfg)
 
     if cache is None:
@@ -320,7 +324,7 @@ def forward(
         new_cache = KVCache(k=stacked_kv[0], v=stacked_kv[1], length=offset + s)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 

@@ -84,6 +84,10 @@ def greedy_generate(
     n_chunks = -(-max_new_tokens // DECODE_CHUNK)
     padded = n_chunks * DECODE_CHUNK
     cache_len = cache_len or cfg.max_seq_len
+    if s + max_new_tokens > cache_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds cache_len ({cache_len})"
+        )
     cache = KVCache.create(cfg, b, cache_len)
     logits, cache = prefill(params, cfg, prompt, cache)
     next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)

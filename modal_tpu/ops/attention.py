@@ -30,6 +30,22 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
+# The kernels stage the full K/V (forward, dQ) or Q/dO (dK/dV) for one
+# (batch, head) into VMEM per grid step. Budget those full-sequence operands
+# to a fraction of VMEM (~128 MiB on v5e, 16 MiB on v4-gen cores — use a
+# conservative floor) so very long sequences fall back to the einsum path
+# instead of failing to compile. Overridable for chips with more VMEM.
+VMEM_STAGED_BUDGET_BYTES = 24 * 1024 * 1024
+
+
+def _fits_vmem_budget(q: jax.Array, k: jax.Array) -> bool:
+    skv, d = k.shape[1], k.shape[3]
+    s = q.shape[1]
+    itemsize = jnp.dtype(q.dtype).itemsize
+    # fwd/dQ: K+V staged [skv, d]; dK/dV: Q+dO staged [s, d] (+ fp32 lse/delta)
+    staged = 2 * max(s, skv) * d * itemsize + 2 * max(s, skv) * 4
+    return staged <= VMEM_STAGED_BUDGET_BYTES
+
 
 def _flash_kernel(
     q_ref,  # [block_q, head_dim]
@@ -372,6 +388,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax
         and mask is None
         and q.shape[1] >= DEFAULT_BLOCK_Q
         and q.shape[1] % DEFAULT_BLOCK_Q == 0
+        and _fits_vmem_budget(q, k)
     ):
         # custom_vjp: differentiable, so the training path can use it too
         return flash_attention_causal(q, k, v)

@@ -61,9 +61,68 @@ def _array_bytes(arr) -> tuple[bytes, dict]:
 
     np_arr = np.asarray(arr)
     meta = {"shape": list(np_arr.shape), "dtype": _dtype_str(np_arr.dtype)}
+    sharding_meta = _sharding_meta(arr)
+    if sharding_meta is not None:
+        meta["sharding"] = sharding_meta
     if np_arr.dtype.name == "bfloat16":
         return np_arr.view(np.uint16).tobytes(), meta
     return np_arr.tobytes(), meta
+
+
+def _sharding_meta(arr) -> Optional[dict]:
+    """Describe a jax.Array's sharding so restore can reproduce the layout.
+
+    NamedSharding (the only layout the SDK's train/serve paths produce) is
+    recorded as mesh axes + partition spec. A multi-device sharding of any
+    other flavor can't be reproduced faithfully, so saving raises — the
+    snapshot is abandoned rather than silently restored onto one device."""
+    import jax
+
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:  # plain numpy
+        return None
+    n_dev = len(sharding.device_set)
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        mesh = sharding.mesh
+        spec = [list(e) if isinstance(e, tuple) else e for e in tuple(sharding.spec)]
+        return {
+            "kind": "named",
+            "axis_names": list(mesh.axis_names),
+            "mesh_shape": list(mesh.devices.shape),
+            "spec": spec,
+        }
+    if n_dev <= 1:
+        return None  # default single-device placement; device_put() suffices
+    raise ValueError(
+        f"cannot snapshot array with non-named {n_dev}-device sharding ({type(sharding).__name__})"
+    )
+
+
+def _restore_sharding(meta: Optional[dict]):
+    """Rebuild the recorded sharding on the current process's devices, or
+    raise _ShardingUnavailable when the device pool can't host it (the
+    snapshot stays on disk for a correctly-sized boot)."""
+    import jax
+    import numpy as np
+
+    if meta is None:
+        return None
+    n_needed = int(np.prod(meta["mesh_shape"])) if meta["mesh_shape"] else 1
+    devices = jax.devices()
+    if len(devices) < n_needed:
+        raise _ShardingUnavailable(
+            f"snapshot leaf sharded over {n_needed} devices; only {len(devices)} present"
+        )
+    mesh_devices = np.asarray(devices[:n_needed]).reshape(meta["mesh_shape"])
+    mesh = jax.sharding.Mesh(mesh_devices, tuple(meta["axis_names"]))
+    spec = jax.sharding.PartitionSpec(
+        *[tuple(e) if isinstance(e, list) else e for e in meta["spec"]]
+    )
+    return jax.sharding.NamedSharding(mesh, spec)
+
+
+class _ShardingUnavailable(RuntimeError):
+    """Restore can't host the snapshotted sharding here; keep the snapshot."""
 
 
 def _dtype_str(dt) -> str:
@@ -154,7 +213,11 @@ def restore_snapshot(function_def: api_pb2.Function, user_instance: Any) -> bool
             for meta in entry["leaves"]:
                 if meta["kind"] == "array":
                     arr = _array_from_file(os.path.join(snap_dir, meta["file"]), meta)
-                    leaves.append(jax.device_put(arr))
+                    sharding = _restore_sharding(meta.get("sharding"))
+                    if sharding is not None:
+                        leaves.append(jax.device_put(arr, sharding))
+                    else:
+                        leaves.append(jax.device_put(arr))
                     del arr  # one leaf of host memory at a time
                 else:
                     leaves.append(deserialize(bytes.fromhex(meta["data"]), None))
@@ -163,6 +226,11 @@ def restore_snapshot(function_def: api_pb2.Function, user_instance: Any) -> bool
             setattr(user_instance, name, value)
         logger.debug(f"warm-state snapshot restored: {key} ({len(staged)} attrs)")
         return True
+    except _ShardingUnavailable as exc:
+        # the snapshot is fine — this boot just has fewer devices than the
+        # boot that saved it; keep it for a correctly-sized container
+        logger.warning(f"warm-state restore skipped ({exc}); running enter hooks")
+        return False
     except Exception as exc:  # noqa: BLE001
         logger.warning(f"warm-state restore failed ({type(exc).__name__}: {exc}); running enter hooks")
         # a snapshot that can't restore is worthless — drop it so the next

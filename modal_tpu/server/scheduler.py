@@ -17,6 +17,7 @@ SURVEY §7 hard part 1). Responsibilities:
 from __future__ import annotations
 
 import asyncio
+import secrets
 import time
 from typing import Optional
 
@@ -205,13 +206,17 @@ class Scheduler:
             rank=rank,
             cluster_id=cluster.cluster_id if cluster else "",
             tpu_chip_ids=chip_ids,
+            router_token=secrets.token_urlsafe(24),
         )
         self.s.tasks[task_id] = task
         fn.task_ids.add(task_id)
         worker.active_tasks.add(task_id)
         args = self._container_arguments(fn, task, cluster)
         assignment = api_pb2.TaskAssignment(
-            task_id=task_id, container_arguments=args, tpu_chip_ids=chip_ids
+            task_id=task_id,
+            container_arguments=args,
+            tpu_chip_ids=chip_ids,
+            router_token=task.router_token,
         )
         await worker.events.put(api_pb2.WorkerPollResponse(assignment=assignment))
         logger.debug(f"scheduled task {task_id} for {fn.tag} on {worker.worker_id} chips={chip_ids}")
@@ -328,6 +333,7 @@ class Scheduler:
             state=api_pb2.TASK_STATE_WORKER_ASSIGNED,
             worker_id=worker.worker_id,
             tpu_chip_ids=chip_ids,
+            router_token=secrets.token_urlsafe(24),
         )
         self.s.tasks[task_id] = task
         worker.active_tasks.add(task_id)
@@ -337,6 +343,7 @@ class Scheduler:
             sandbox_def=sandbox.definition,
             sandbox_id=sandbox.sandbox_id,
             tpu_chip_ids=chip_ids,
+            router_token=task.router_token,
         )
         # resolve secret env control-plane-side (same as function tasks)
         for secret_id in sandbox.definition.secret_ids:

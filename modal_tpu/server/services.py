@@ -1346,7 +1346,9 @@ class ModalTPUServicer:
         if worker is None or not worker.router_address:
             await context.abort(grpc.StatusCode.UNAVAILABLE, "worker router unavailable")
         return api_pb2.SandboxGetCommandRouterAccessResponse(
-            router_address=worker.router_address, task_id=task.task_id
+            router_address=worker.router_address,
+            task_id=task.task_id,
+            router_token=task.router_token,
         )
 
     async def WorkerPoll(self, request: api_pb2.WorkerPollRequest, context):

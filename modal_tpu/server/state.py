@@ -126,6 +126,7 @@ class TaskState_:
     result: Optional[api_pb2.GenericResult] = None
     tpu_chip_ids: list[int] = field(default_factory=list)
     container_address: str = ""
+    router_token: str = ""  # bearer token for the worker's command router
 
 
 @dataclass

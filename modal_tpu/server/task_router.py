@@ -52,6 +52,7 @@ class ExecState:
     stdin_acked: int = 0
     stdin_eof: bool = False
     returncode: Optional[int] = None
+    token: str = ""  # inherited from the task at start (task may unregister first)
     condition: asyncio.Condition = field(default_factory=asyncio.Condition)
 
     def buf(self, fd: int) -> bytearray:
@@ -68,6 +69,7 @@ class TaskContext:
 
     env: dict[str, str]
     cwd: str
+    token: str = ""  # per-task bearer token; "" = unauthenticated (tests)
 
 
 class TaskRouterServicer:
@@ -84,8 +86,22 @@ class TaskRouterServicer:
 
     # -- worker wiring ------------------------------------------------------
 
-    def register_task(self, task_id: str, env: dict[str, str], cwd: str) -> None:
-        self._tasks[task_id] = TaskContext(env=dict(env), cwd=cwd or os.getcwd())
+    def register_task(self, task_id: str, env: dict[str, str], cwd: str, token: str = "") -> None:
+        self._tasks[task_id] = TaskContext(env=dict(env), cwd=cwd or os.getcwd(), token=token)
+
+    async def _authorize(self, context, token: str) -> None:
+        """Require the per-task bearer token issued with the assignment
+        (x-task-token metadata). Tasks registered without a token — direct
+        servicer use in tests — skip the check. The reference router
+        authenticates per task the same way; without this, any process that
+        can reach the worker port could exec as the worker user."""
+        if not token:
+            return
+        import secrets as _secrets
+
+        md = dict(context.invocation_metadata() or ())
+        if not _secrets.compare_digest(md.get("x-task-token", ""), token):
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, "bad or missing task token")
 
     def unregister_task(self, task_id: str) -> None:
         self._tasks.pop(task_id, None)
@@ -111,6 +127,7 @@ class TaskRouterServicer:
         task = self._tasks.get(request.task_id)
         if task is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not on this worker")
+        await self._authorize(context, task.token)
         exec_id = request.exec_id or f"ex-{uuid.uuid4().hex[:12]}"
         # per-exec_id lock: a retried start racing the original's subprocess
         # spawn must not create a second process
@@ -129,7 +146,7 @@ class TaskRouterServicer:
                 env=env,
                 cwd=cwd or None,
             )
-            st = ExecState(exec_id=exec_id, task_id=request.task_id, proc=proc)
+            st = ExecState(exec_id=exec_id, task_id=request.task_id, proc=proc, token=task.token)
             self._execs[exec_id] = st
         asyncio.create_task(self._pump(st, proc.stdout, 1))
         asyncio.create_task(self._pump(st, proc.stderr, 2))
@@ -177,6 +194,7 @@ class TaskRouterServicer:
         st = self._get_exec(request.exec_id)
         if st is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
+        await self._authorize(context, st.token)
         fd = request.file_descriptor or 1
         offset = request.offset
         deadline = time.monotonic() + (request.timeout or 55.0)
@@ -223,6 +241,7 @@ class TaskRouterServicer:
         st = self._get_exec(request.exec_id)
         if st is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
+        await self._authorize(context, st.token)
         data = request.data
         # offset-dedupe: drop the prefix we've already accepted
         if request.offset < st.stdin_acked:
@@ -247,6 +266,7 @@ class TaskRouterServicer:
         st = self._get_exec(request.exec_id)
         if st is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
+        await self._authorize(context, st.token)
         # honor timeout=0 exactly: poll() means "answer immediately"
         deadline = time.monotonic() + request.timeout
         async with st.condition:
@@ -266,6 +286,7 @@ class TaskRouterServicer:
         task = self._tasks.get(request.task_id)
         if task is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not on this worker")
+        await self._authorize(context, task.token)
         path = request.path
         if not os.path.isabs(path):
             path = os.path.join(task.cwd, path)

@@ -337,7 +337,7 @@ class WorkerAgent:
         self._procs[task_id] = proc
         if self._consume_early_stop(task_id):  # stop raced in during spawn
             proc.kill()
-        self.router.register_task(task_id, env, sandbox_cwd or os.getcwd())
+        self.router.register_task(task_id, env, sandbox_cwd or os.getcwd(), token=assignment.router_token)
 
         async def _heartbeat() -> None:
             # sandboxes heartbeat like function containers so the reaper
@@ -542,7 +542,7 @@ class WorkerAgent:
         logger.debug(f"task {task_id} started pid={proc.pid}")
         if self._consume_early_stop(task_id):  # stop raced in during spawn
             proc.kill()
-        self.router.register_task(task_id, env, container_cwd or os.getcwd())
+        self.router.register_task(task_id, env, container_cwd or os.getcwd(), token=assignment.router_token)
         tail_task = asyncio.create_task(self._stream_logs(task_id, stdout_path, stderr_path, proc))
         returncode = await proc.wait()
         del self._procs[task_id]

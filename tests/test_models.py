@@ -178,3 +178,12 @@ def test_sample_generate_temperature_topk():
     k1b = sample_generate(params, cfg, prompt, 16, key=jax.random.PRNGKey(3), top_k=1, cache_len=64)
     assert jnp.array_equal(k1a, k1b)
     assert int(k1a.max()) < cfg.vocab_size and int(k1a.min()) >= 0
+
+
+def test_greedy_generate_cache_overflow_raises(tiny):
+    """prompt + max_new_tokens beyond the cache must raise, not silently
+    clamp the cache write offset (advisor r2)."""
+    cfg, params = tiny
+    prompt = jnp.ones((1, 12), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds cache_len"):
+        greedy_generate(params, cfg, prompt, max_new_tokens=30, cache_len=16)

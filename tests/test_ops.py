@@ -158,3 +158,17 @@ def test_flash_attention_causal_rejects_mismatched_seq():
     k = jnp.zeros((1, 256, 2, 32))
     with pytest.raises(ValueError, match="Sq == Sk"):
         flash_attention_causal(q, k, k, 128, 128, True)
+
+
+def test_flash_vmem_budget_guard():
+    """Sequences whose staged K/V would blow VMEM must take the einsum
+    fallback instead of failing to compile (advisor r2)."""
+    import jax.numpy as jnp
+
+    from modal_tpu.ops import attention as att
+
+    q_small = jnp.zeros((1, 1024, 4, 128), jnp.bfloat16)
+    assert att._fits_vmem_budget(q_small, q_small)
+    # 64k tokens × 128 dim × bf16 × (K+V) = 32 MiB > 24 MiB budget
+    q_huge = jnp.zeros((1, 65536, 4, 128), jnp.bfloat16)
+    assert not att._fits_vmem_budget(q_huge, q_huge)

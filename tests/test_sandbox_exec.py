@@ -55,16 +55,20 @@ def test_exec_stdin_offset_dedupe(supervisor):
 
         async def _dup():
             stub = await router.connect()
+            md = router._metadata  # per-task bearer token
             r1 = await stub.TaskExecPutInput(
-                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"abc", offset=0)
+                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"abc", offset=0),
+                metadata=md,
             )
             # duplicate retry of the same bytes: acked stays 3
             r2 = await stub.TaskExecPutInput(
-                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"abc", offset=0)
+                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"abc", offset=0),
+                metadata=md,
             )
             # partial-overlap retry: only the new suffix lands
             r3 = await stub.TaskExecPutInput(
-                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"bcdef", offset=1, eof=True)
+                api_pb2.TaskExecPutInputRequest(exec_id=p.exec_id, data=b"bcdef", offset=1, eof=True),
+                metadata=md,
             )
             return r1.acked_offset, r2.acked_offset, r3.acked_offset
 
@@ -169,5 +173,38 @@ def test_sandbox_open_file_handle(supervisor, tmp_path):
         g.close()
         with pytest.raises(FileNotFoundError):
             sb.open("missing.txt", "r")
+    finally:
+        sb.terminate()
+
+
+def test_router_rejects_missing_or_bad_token(supervisor):
+    """Router RPCs require the per-task bearer token issued with the
+    assignment (advisor r2): a client dialing the worker port without the
+    token must get PERMISSION_DENIED, not an exec."""
+    import grpc
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.proto import api_pb2
+
+    sb = _make_sandbox(modal_tpu, "sleep", "30")
+    try:
+        # legit exec works (token flows via SandboxGetCommandRouterAccess)
+        p = sb.exec("echo", "hi")
+        assert p.wait() == 0
+
+        raw = sb._get_router()
+
+        async def _no_token_call():
+            stub = await raw.connect()
+            try:
+                await stub.TaskFsOp(
+                    api_pb2.TaskFsOpRequest(task_id=raw.task_id, op="stat", path=".")
+                )
+            except grpc.aio.AioRpcError as exc:
+                return exc.code()
+            return None
+
+        code = synchronizer.run(_no_token_call())
+        assert code == grpc.StatusCode.PERMISSION_DENIED
     finally:
         sb.terminate()
