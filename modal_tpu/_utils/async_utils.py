@@ -84,6 +84,16 @@ class Synchronizer:
             fut.cancel()
             raise
 
+    def spawn(self, coro: Awaitable[T]) -> "concurrent.futures.Future[T]":
+        """Schedule a coroutine on the synchronizer loop WITHOUT blocking;
+        the caller (a non-loop thread) overlaps its own work with the IO and
+        collects via `.result()` — e.g. models/weights.py streams the next
+        tensor fetch while jax places the current one."""
+        if self.in_loop_thread():
+            raise RuntimeError("spawn() must be called from outside the synchronizer loop")
+        loop = self._ensure_loop()
+        return asyncio.run_coroutine_threadsafe(coro, loop)
+
     def run_generator(self, agen: AsyncGenerator[T, None]) -> typing.Generator[T, None, None]:
         """Bridge an async generator to a sync generator, preserving laziness."""
         loop = self._ensure_loop()

@@ -70,7 +70,13 @@ async def initialize_clustered(container_args: api_pb2.ContainerArguments, clien
 async def run_lifecycle_hooks(hooks: list, name: str) -> None:
     for hook in hooks:
         logger.debug(f"running {name} hook {getattr(hook, '__name__', hook)}")
-        res = hook()
+        if inspect.iscoroutinefunction(hook):
+            await hook()
+            continue
+        # Sync hooks run OFF the synchronizer loop (like function bodies,
+        # call_user_code above) so they can use the blocking SDK surface —
+        # e.g. an @enter that streams weights from a Volume.
+        res = await asyncio.to_thread(hook)
         if inspect.isawaitable(res):
             await res
 

@@ -142,9 +142,8 @@ class _Volume(_Object, type_prefix="vo"):
         )
         return [FileEntry._from_proto(f) for f in resp.files]
 
-    @live_method_gen
-    async def read_file(self, path: str) -> AsyncGenerator[bytes, None]:
-        """Stream a file's content block-by-block with parallel prefetch."""
+    async def _get_file_meta(self, path: str) -> api_pb2.VolumeGetFile2Response:
+        """Block list + block size for one file; NotFoundError if missing."""
         try:
             resp = await retry_transient_errors(
                 self.client.stub.VolumeGetFile2,
@@ -154,6 +153,12 @@ class _Volume(_Object, type_prefix="vo"):
             raise NotFoundError(f"file {path!r} not found in volume") from None
         if not resp.file.path:
             raise NotFoundError(f"file {path!r} not found in volume")
+        return resp
+
+    @live_method_gen
+    async def read_file(self, path: str) -> AsyncGenerator[bytes, None]:
+        """Stream a file's content block-by-block with parallel prefetch."""
+        resp = await self._get_file_meta(path)
         blocks = list(resp.file.block_sha256_hex)
 
         async def _get(sha: str) -> bytes:
@@ -180,6 +185,45 @@ class _Volume(_Object, type_prefix="vo"):
             fileobj.write(chunk)
             total += len(chunk)
         return total
+
+    @live_method
+    async def read_file_range(self, path: str, offset: int, length: int) -> bytes:
+        """Read `length` bytes at `offset` fetching ONLY the needed byte
+        ranges (sub-block offset/length on the first and last block) — the
+        primitive behind checkpoint→HBM streaming (models/weights.py reads
+        one tensor's bytes out of a multi-GiB safetensors shard without
+        materializing the file). `length == 0` still validates existence
+        (raises NotFoundError) — used as a metadata-only stat."""
+        if offset < 0 or length < 0:
+            raise ValueError(f"negative offset/length ({offset}, {length})")
+        resp = await self._get_file_meta(path)
+        if length == 0:
+            return b""
+        block_size = resp.block_size or BLOCK_SIZE
+        blocks = list(resp.file.block_sha256_hex)
+        first = offset // block_size
+        last = min((offset + length - 1) // block_size, len(blocks) - 1)
+        if first >= len(blocks):
+            return b""
+        sem = asyncio.Semaphore(BLOCK_PARALLELISM)
+        end = offset + length  # absolute; may exceed EOF (clamped per block)
+
+        async def _get(i: int) -> bytes:
+            # sub-block range: only the overlapping bytes travel
+            block_lo = i * block_size
+            lo = max(offset - block_lo, 0)
+            hi = min(end - block_lo, block_size)
+            async with sem:
+                r = await retry_transient_errors(
+                    self.client.stub.VolumeBlockGet,
+                    api_pb2.VolumeBlockGetRequest(
+                        sha256_hex=blocks[i], offset=lo, length=hi - lo
+                    ),
+                )
+                return r.data
+
+        datas = await asyncio.gather(*[_get(i) for i in range(first, last + 1)])
+        return b"".join(datas)
 
     @live_method
     async def remove_file(self, path: str, recursive: bool = False) -> None:
