@@ -42,18 +42,32 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     max_seq_len: int = 8192
     dtype: Any = jnp.bfloat16
+    # MoE (expert-parallel FFN, switch-style top-1 routing — parallel/moe.py).
+    # 0 = dense SwiGLU FFN. When > 0 each layer's FFN is n_experts experts of
+    # width ffn_dim with a load-balancing aux loss.
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
     def param_count(self) -> int:
         embed = self.vocab_size * self.dim
+        if self.is_moe:
+            ffn = self.dim * self.n_experts + 2 * self.n_experts * self.dim * self.ffn_dim
+        else:
+            ffn = 3 * self.dim * self.ffn_dim  # w1, w2, w3
         per_layer = (
             self.dim * self.n_heads * self.head_dim  # wq
             + 2 * self.dim * self.n_kv_heads * self.head_dim  # wk, wv
             + self.n_heads * self.head_dim * self.dim  # wo
-            + 3 * self.dim * self.ffn_dim  # w1, w2, w3
+            + ffn
             + 2 * self.dim  # norms
         )
         return embed * 2 + per_layer * self.n_layers + self.dim
@@ -81,6 +95,16 @@ CONFIGS: dict[str, LlamaConfig] = {
         name="llama3-70b", vocab_size=128_256, dim=8192, n_layers=80, n_heads=64,
         n_kv_heads=8, ffn_dim=28672, max_seq_len=8192,
     ),
+    # MoE variants: switch-style top-1 expert FFNs (Mixtral-scale proxy at
+    # the top; tiny-moe for tests/dryrun)
+    "tiny-moe": LlamaConfig(
+        name="tiny-moe", vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=256, max_seq_len=256, n_experts=4,
+    ),
+    "llama3-8x7b-proxy": LlamaConfig(
+        name="llama3-8x7b-proxy", vocab_size=128_256, dim=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, ffn_dim=14336, max_seq_len=8192, n_experts=8,
+    ),
 }
 
 
@@ -103,17 +127,27 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
 
     def layer_init(k: jax.Array) -> dict:
         ks = jax.random.split(k, 7)
-        return {
+        layer = {
             "attn_norm": jnp.ones((cfg.dim,), cfg.dtype),
             "wq": init(ks[0], (cfg.dim, cfg.n_heads * hd), cfg.dtype),
             "wk": init(ks[1], (cfg.dim, cfg.n_kv_heads * hd), cfg.dtype),
             "wv": init(ks[2], (cfg.dim, cfg.n_kv_heads * hd), cfg.dtype),
             "wo": init(ks[3], (cfg.n_heads * hd, cfg.dim), cfg.dtype),
             "mlp_norm": jnp.ones((cfg.dim,), cfg.dtype),
-            "w_gate": init(ks[4], (cfg.dim, cfg.ffn_dim), cfg.dtype),
-            "w_up": init(ks[5], (cfg.dim, cfg.ffn_dim), cfg.dtype),
-            "w_down": init(ks[6], (cfg.ffn_dim, cfg.dim), cfg.dtype),
         }
+        if cfg.is_moe:
+            layer.update({
+                "router": init(ks[4], (cfg.dim, cfg.n_experts), cfg.dtype),
+                "w_in": init(ks[5], (cfg.n_experts, cfg.dim, cfg.ffn_dim), cfg.dtype),
+                "w_out": init(ks[6], (cfg.n_experts, cfg.ffn_dim, cfg.dim), cfg.dtype),
+            })
+        else:
+            layer.update({
+                "w_gate": init(ks[4], (cfg.dim, cfg.ffn_dim), cfg.dtype),
+                "w_up": init(ks[5], (cfg.dim, cfg.ffn_dim), cfg.dtype),
+                "w_down": init(ks[6], (cfg.ffn_dim, cfg.dim), cfg.dtype),
+            })
+        return layer
 
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
     layers = jax.vmap(layer_init)(layer_keys)  # stacked: leading axis n_layers
@@ -223,7 +257,9 @@ def _layer_forward(
     cache_kv: Optional[tuple[jax.Array, jax.Array]],  # ([B, max, n_kv, hd], ...)
     cache_offset: Optional[jax.Array],
     attn_impl: Optional[Any] = None,  # custom attention (ring/pallas); (q,k,v,mask)->out
-) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
+) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]], jax.Array]:
+    """Returns (x, new_cache, aux) — aux is the MoE load-balancing loss for
+    this layer (0.0 for dense FFN layers)."""
     from .quant import qmm
 
     b, s, d = x.shape
@@ -251,9 +287,21 @@ def _layer_forward(
     x = x + qmm(attn_out.reshape(b, s, cfg.n_heads * hd), layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(qmm(h, layer["w_gate"]).astype(jnp.float32)).astype(x.dtype) * qmm(h, layer["w_up"])
-    x = x + qmm(gated, layer["w_down"])
-    return x, new_cache
+    if cfg.is_moe:
+        from ..parallel.moe import moe_ffn
+
+        y, aux, _dropped = moe_ffn(
+            h.reshape(b * s, d),
+            {"router": layer["router"], "w_in": layer["w_in"], "w_out": layer["w_out"]},
+            cfg.capacity_factor,
+            act=jax.nn.silu,
+        )
+        x = x + y.reshape(b, s, d)
+    else:
+        gated = jax.nn.silu(qmm(h, layer["w_gate"]).astype(jnp.float32)).astype(x.dtype) * qmm(h, layer["w_up"])
+        x = x + qmm(gated, layer["w_down"])
+        aux = jnp.zeros((), jnp.float32)
+    return x, new_cache, aux
 
 
 def forward(
@@ -268,6 +316,21 @@ def forward(
     """Full forward pass. Without cache: causal training/prefill forward.
     With cache: writes K/V at cache.length and attends over the cache
     (prefill chunks or single-token decode). Returns (logits, new_cache)."""
+    logits, new_cache, _ = forward_with_aux(params, cfg, tokens, positions, cache, attn_impl, remat)
+    return logits, new_cache
+
+
+def forward_with_aux(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] int32
+    positions: Optional[jax.Array] = None,  # [B, S]
+    cache: Optional[KVCache] = None,
+    attn_impl: Optional[Any] = None,
+    remat: bool = False,
+) -> tuple[jax.Array, Optional[KVCache], jax.Array]:
+    """`forward` plus the mean per-layer MoE load-balancing aux loss (0.0
+    for dense configs) — the training loss adds cfg.moe_aux_coef * aux."""
     b, s = tokens.shape
     if positions is None:
         base = cache.length if cache is not None else jnp.zeros((), jnp.int32)
@@ -290,11 +353,12 @@ def forward(
 
             attn_impl = flash_attention
 
-        def body(x_carry, layer):
-            x_out, _ = _layer_forward(
+        def body(carry, layer):
+            x_carry, aux_acc = carry
+            x_out, _, aux = _layer_forward(
                 cfg, x_carry, layer, positions, None, inv_freq, None, None, attn_impl
             )
-            return x_out, None
+            return (x_out, aux_acc + aux), None
 
         if remat:
             # Checkpoint the scan BODY, not the whole forward: the backward
@@ -302,7 +366,7 @@ def forward(
             # carries, so peak residency is one layer's activations instead of
             # all n_layers at once.
             body = jax.checkpoint(body, prevent_cse=False)
-        x, _ = lax.scan(body, x, params["layers"])
+        (x, aux_sum), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
         new_cache = None
     else:
         max_len = cache.k.shape[2]
@@ -313,19 +377,22 @@ def forward(
         visible = kv_pos <= q_pos
         mask = jnp.where(visible, 0.0, -jnp.inf).astype(jnp.float32)
 
-        def body(x_carry, layer_and_cache):
+        def body(carry, layer_and_cache):
+            x_carry, aux_acc = carry
             layer, ck, cv = layer_and_cache
-            x_out, new_kv = _layer_forward(
+            x_out, new_kv, aux = _layer_forward(
                 cfg, x_carry, layer, positions, mask, inv_freq, (ck, cv), offset
             )
-            return x_out, new_kv
+            return (x_out, aux_acc + aux), new_kv
 
-        x, stacked_kv = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+        (x, aux_sum), stacked_kv = lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), (params["layers"], cache.k, cache.v)
+        )
         new_cache = KVCache(k=stacked_kv[0], v=stacked_kv[1], length=offset + s)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = qmm(x, params["lm_head"]).astype(jnp.float32)
-    return logits, new_cache
+    return logits, new_cache, aux_sum / cfg.n_layers
 
 
 # ---------------------------------------------------------------------------

@@ -25,9 +25,11 @@ import jax
 import jax.numpy as jnp
 
 # params dict leaves that are matmul weights (quantizable); everything else
-# (norm gains, scalars) stays bf16.
+# (norm gains, scalars) stays bf16. MoE expert weights included — their
+# einsums dequantize on read (parallel/moe.py _qeinsum).
 _WEIGHT_KEYS = frozenset(
-    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "embed", "lm_head"}
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "embed", "lm_head",
+     "router", "w_in", "w_out"}
 )
 
 

@@ -277,6 +277,9 @@ def export_checkpoint(
     per-layer unstack) plus OS page cache. Returns the index dict."""
     import jax
 
+    if getattr(cfg, "is_moe", False):
+        raise NotImplementedError("MoE checkpoints (expert weights) use a different HF layout; dense only")
+
     # (hf_name, fetch, nbytes) in deterministic order; fetch is lazy so only
     # one tensor is ever materialized host-side. Sizes come from the leaf
     # shapes — no fetch needed to plan the shards.
@@ -441,6 +444,9 @@ class _LoadPlan:
         import jax
         import jax.numpy as jnp
         from jax import lax
+
+        if getattr(cfg, "is_moe", False):
+            raise NotImplementedError("MoE checkpoints (expert weights) use a different HF layout; dense only")
 
         self.idx = idx
         self.cfg = cfg

@@ -5,10 +5,12 @@ placed the gang on (TPUSliceInfo → mesh axes), honoring user mesh hints
 (`@app.function(tpu="v5p-64", mesh={"data": 2, "fsdp": 16, "model": 2})`).
 Axis convention (scaling-book style):
 
-  data  — pure data parallel (params replicated)
-  fsdp  — data parallel with sharded params/optimizer (ZeRO-3)
-  model — tensor parallel (heads/ffn sharded; activations all-reduced)
-  seq   — sequence/context parallel (ring attention; M6)
+  data   — pure data parallel (params replicated)
+  pipe   — pipeline parallel (layer stack split across stages, GPipe ticks)
+  expert — expert parallel (MoE experts sharded; all-to-all dispatch)
+  fsdp   — data parallel with sharded params/optimizer (ZeRO-3)
+  model  — tensor parallel (heads/ffn sharded; activations all-reduced)
+  seq    — sequence/context parallel (ring attention; M6)
 
 On a pod slice, [fsdp, model] map to intra-slice ICI dimensions and [data]
 to the cross-slice/DCN dimension, so collectives ride the fastest links
@@ -25,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-AXIS_ORDER = ("data", "fsdp", "seq", "model")
+AXIS_ORDER = ("data", "pipe", "expert", "fsdp", "seq", "model")
 
 
 def build_mesh(
@@ -38,6 +40,9 @@ def build_mesh(
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     axes = dict(axes or {})
+    unknown = set(axes) - set(AXIS_ORDER)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
     sized = {k: v for k, v in axes.items() if v and v > 1}
     prod = math.prod(sized.values()) if sized else 1
     if prod > n or n % prod != 0:

@@ -20,7 +20,7 @@ here). TPU-first design:
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -47,17 +47,34 @@ def moe_param_shardings(mesh: Mesh, axis_name: str = "expert") -> dict:
     }
 
 
+def _qeinsum(eq: str, x: jax.Array, w) -> jax.Array:
+    """einsum for plain or int8-quantized weights (models/quant.py layout:
+    {"q": int8, "s": per-out-channel scale}); the int8→bf16 convert fuses
+    into the dot operand read, the scale applies to the smaller output."""
+    from ..models.quant import is_quantized
+
+    if is_quantized(w):
+        y = jnp.einsum(eq, x, w["q"].astype(x.dtype))
+        return y * w["s"].astype(x.dtype)
+    return jnp.einsum(eq, x, w)
+
+
 def moe_ffn(
     x: jax.Array,  # [T, D] tokens
     params: dict,
     capacity_factor: float = 1.25,
+    act: Optional[Callable] = None,  # activation; default gelu (llama passes silu)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Top-1 switch MoE. Returns (y [T, D], aux_loss, dropped_fraction)."""
+    act = act or jax.nn.gelu
     t, d = x.shape
-    e = params["router"].shape[1]
+    router = params["router"]
+    e = (router["q"] if isinstance(router, dict) else router).shape[-1]
     capacity = max(1, int(capacity_factor * t / e))
 
-    logits = x @ params["router"]  # [T, E]
+    from ..models.quant import qmm
+
+    logits = qmm(x, params["router"])  # [T, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     expert = jnp.argmax(probs, axis=-1)  # [T]
     gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
@@ -70,11 +87,14 @@ def moe_ffn(
     dispatch = keep[:, :, None] * slot[:, None, :]  # [T, E, C]
 
     # all-to-all happens HERE via sharding propagation: x is data-sharded,
-    # expert_in is expert-sharded
+    # expert_in is expert-sharded. dispatch holds exact 0/1 values so it
+    # casts to x.dtype losslessly — keeps the dominant-FLOP einsums in bf16
+    # (f32 routing math stays above in probs/gate/aux).
+    dispatch = dispatch.astype(x.dtype)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, D]
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"]))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])  # [E, C, D]
-    combine = dispatch * gate[:, None, None]  # [T, E, C]
+    h = act(_qeinsum("ecd,edf->ecf", expert_in, params["w_in"]))
+    expert_out = _qeinsum("ecf,efd->ecd", h, params["w_out"])  # [E, C, D]
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]  # [T, E, C]
     y = jnp.einsum("tec,ecd->td", combine, expert_out)
 
     # Switch load-balancing loss: E * sum_e frac_tokens_e * mean_prob_e

@@ -43,9 +43,19 @@ def pipeline_loss(
 
     `params` follows models.llama.init_params (stacked layers); embed and
     lm_head stay replicated (small relative to the layer stack at the
-    depths where pipelining pays)."""
+    depths where pipelining pays).
+
+    Composes with FSDP/TP: only `axis_name` is manual inside the shard_map
+    (jax `axis_names=`); data/fsdp/model stay automatic, so weight dims
+    sharded over fsdp/model keep their shardings and XLA inserts the
+    all-gathers under the stage scan as usual."""
     from ..models.llama import _layer_forward, rms_norm, rope_frequencies
 
+    if cfg.is_moe:
+        raise NotImplementedError(
+            "pipeline parallelism with MoE layers is not supported yet; "
+            "use expert parallelism (mesh expert axis) without pipe"
+        )
     n_stages = mesh.shape[axis_name]
     if cfg.n_layers % n_stages:
         raise ValueError(f"pipe={n_stages} must divide n_layers={cfg.n_layers}")
@@ -55,13 +65,17 @@ def pipeline_loss(
     mb = b // num_microbatches
     inv_freq = rope_frequencies(cfg)
 
-    # embed outside the pipeline (replicated, cheap): [M, mb, S, D]
-    x = params["embed"][tokens].reshape(num_microbatches, mb, s, cfg.dim)
+    # embed outside the pipeline (replicated, cheap): [M, mb, S, D].
+    # f32 at the shard_map boundary: every pipe-axis psum (forward collect
+    # AND the autodiff-generated cotangent psums for replicated inputs) must
+    # be f32 — XLA's bf16 AllReducePromotion pass crashes under partial-auto
+    # shard_map (CloneAllReduce "Invalid binary instruction opcode copy").
+    x = params["embed"][tokens].reshape(num_microbatches, mb, s, cfg.dim).astype(jnp.float32)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
 
     def stage_block(layers_local, act):
         def body(x_carry, layer):
-            out, _ = _layer_forward(cfg, x_carry, layer, positions, None, inv_freq, None, None, None)
+            out, _, _aux = _layer_forward(cfg, x_carry, layer, positions, None, inv_freq, None, None, None)
             return out, None
 
         act, _ = lax.scan(body, act, layers_local)
@@ -78,14 +92,16 @@ def pipeline_loss(
             act, outputs = carry
             inject = lax.dynamic_index_in_dim(
                 x_all, jnp.minimum(t, num_microbatches - 1), axis=0, keepdims=False
-            )
+            ).astype(cfg.dtype)
             act = jnp.where(stage == 0, inject, act)
             act = stage_block(layers_local, act)
             # last stage finishes microbatch (t - P + 1) at tick t
             out_idx = t - (n_stages - 1)
             outputs = lax.cond(
                 out_idx >= 0,
-                lambda o: lax.dynamic_update_index_in_dim(o, act, jnp.maximum(out_idx, 0), axis=0),
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, act.astype(jnp.float32), jnp.maximum(out_idx, 0), axis=0
+                ),
                 lambda o: o,
                 outputs,
             )
@@ -93,11 +109,12 @@ def pipeline_loss(
             act = lax.ppermute(act, axis_name, perm)
             return (act, outputs), None
 
-        act0 = jnp.zeros((mb, s, cfg.dim), x_all.dtype)
-        outputs0 = jnp.zeros((num_microbatches, mb, s, cfg.dim), x_all.dtype)
+        act0 = jnp.zeros((mb, s, cfg.dim), cfg.dtype)
+        outputs0 = jnp.zeros((num_microbatches, mb, s, cfg.dim), jnp.float32)
         (_, outputs), _ = lax.scan(tick, (act0, outputs0), jnp.arange(ticks))
         # only the LAST stage's collection is real; mask + psum replicates
-        # the result across the axis (as out_specs=P() requires)
+        # the result across the axis (as out_specs=P() requires); f32 per the
+        # boundary rule above
         outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
         return lax.psum(outputs, axis_name)
 
@@ -107,6 +124,7 @@ def pipeline_loss(
         mesh=mesh,
         in_specs=(layer_spec, P()),
         out_specs=P(),
+        axis_names={axis_name},  # only pipe is manual; fsdp/model stay auto
         check_vma=False,
     )(params["layers"], x)
 

@@ -33,6 +33,15 @@ _LAYER_RULES: dict[str, P] = {
     "w_down": P("model", "fsdp"),
 }
 
+# MoE FFN (cfg.n_experts > 0): experts sharded over the `expert` axis,
+# within-expert weights over fsdp/model — the all-to-all dispatch is placed
+# by XLA from these specs (parallel/moe.py)
+_MOE_LAYER_RULES: dict[str, P] = {
+    "router": P(None, None),
+    "w_in": P("expert", "fsdp", "model"),
+    "w_out": P("expert", "model", "fsdp"),
+}
+
 _TOP_RULES: dict[str, P] = {
     "embed": P("model", "fsdp"),     # vocab sharded over model, dim over fsdp
     "final_norm": P(None),
@@ -40,9 +49,17 @@ _TOP_RULES: dict[str, P] = {
 }
 
 
-def param_specs(cfg: LlamaConfig) -> dict:
-    """PartitionSpec pytree matching init_params' structure."""
-    layers = {k: P(None, *spec) for k, spec in _LAYER_RULES.items()}
+def param_specs(cfg: LlamaConfig, pipe: bool = False) -> dict:
+    """PartitionSpec pytree matching init_params' structure. With
+    `pipe=True`, the stacked layer axis is sharded over the `pipe` mesh axis
+    (each pipeline stage holds its contiguous block of layers)."""
+    rules = dict(_LAYER_RULES)
+    if cfg.is_moe:
+        for k in ("w_gate", "w_up", "w_down"):
+            rules.pop(k)
+        rules.update(_MOE_LAYER_RULES)
+    stack_axis = "pipe" if pipe else None
+    layers = {k: P(stack_axis, *spec) for k, spec in rules.items()}
     return {
         "embed": _TOP_RULES["embed"],
         "layers": layers,
@@ -51,8 +68,10 @@ def param_specs(cfg: LlamaConfig) -> dict:
     }
 
 
-def param_shardings(mesh: Mesh, cfg: LlamaConfig) -> dict:
-    return jax.tree.map(lambda spec: NamedSharding(mesh, spec), param_specs(cfg), is_leaf=lambda x: isinstance(x, P))
+def param_shardings(mesh: Mesh, cfg: LlamaConfig, pipe: bool = False) -> dict:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(cfg, pipe=pipe), is_leaf=lambda x: isinstance(x, P)
+    )
 
 
 def batch_spec() -> P:

@@ -38,6 +38,12 @@ class TrainConfig:
     warmup_steps: int = 2000
     total_steps: int = 100_000
     remat: bool = True  # jax.checkpoint the layer body: memory for FLOPs
+    num_microbatches: int = 0  # pipeline microbatches; 0 = 2 × pipe stages
+
+    def resolve_num_microbatches(self, n_stages: int) -> int:
+        """Single source of truth — make_train_step and train_demo must
+        agree or pipeline_loss rejects the batch at trace time."""
+        return self.num_microbatches or 2 * n_stages
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -61,12 +67,13 @@ def loss_fn(
     # next-token loss — keeps S divisible for sequence parallelism.
     # remat is applied inside forward() to the layer-scan body (true
     # per-layer checkpointing: one layer's residuals live at a time).
-    from ..models.llama import forward
+    # MoE configs add the load-balancing aux loss (keeps routing trainable).
+    from ..models.llama import forward_with_aux
 
-    logits, _ = forward(params, cfg, tokens, attn_impl=attn_impl, remat=remat)
+    logits, _, aux = forward_with_aux(params, cfg, tokens, attn_impl=attn_impl, remat=remat)
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_coef * aux
 
 
 def make_train_step(
@@ -74,13 +81,26 @@ def make_train_step(
     tc: TrainConfig,
     optimizer: optax.GradientTransformation,
     attn_impl: Optional[Callable] = None,
+    pipeline_mesh: Optional[Mesh] = None,
 ) -> Callable:
     """Returns train_step(state, tokens) -> (state, metrics) — jit with
-    donated state."""
+    donated state. With `pipeline_mesh` the loss is the GPipe-microbatched
+    pipeline over its `pipe` axis (parallel/pipeline.py)."""
+    if pipeline_mesh is not None:
+        from .pipeline import pipeline_loss
+
+        n_stages = pipeline_mesh.shape["pipe"]
+        num_micro = tc.resolve_num_microbatches(n_stages)
+
+        def compute_loss(params, tokens):
+            return pipeline_loss(params, cfg, tokens, pipeline_mesh, num_micro)
+    else:
+        def compute_loss(params, tokens):
+            return loss_fn(params, cfg, tokens, tc.remat, attn_impl)
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, tokens: jax.Array):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens, tc.remat, attn_impl)
+        loss, grads = jax.value_and_grad(compute_loss)(state.params, tokens)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
@@ -101,9 +121,12 @@ def create_sharded_state(
     Returns (state, train_step, token_sharding).
     """
     optimizer = make_optimizer(tc)
-    p_shardings = param_shardings(mesh, cfg)
+    pipe = mesh.shape.get("pipe", 1) > 1
+    p_shardings = param_shardings(mesh, cfg, pipe=pipe)
     attn_impl = None
     if mesh.shape.get("seq", 1) > 1:
+        if pipe:
+            raise NotImplementedError("pipe + seq (ring attention inside pipeline) not supported yet")
         from .ring_attention import make_ring_attention_impl
 
         attn_impl = make_ring_attention_impl(mesh, "seq", batch_axes=("data", "fsdp"))
@@ -117,7 +140,9 @@ def create_sharded_state(
     # jit's sharding propagation
     opt_state = jax.jit(optimizer.init)(params)
     state = TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
-    step_fn = make_train_step(cfg, tc, optimizer, attn_impl=attn_impl)
+    step_fn = make_train_step(
+        cfg, tc, optimizer, attn_impl=attn_impl, pipeline_mesh=mesh if pipe else None
+    )
     token_spec = P(("data", "fsdp"), "seq" if mesh.shape.get("seq", 1) > 1 else None)
     return state, step_fn, NamedSharding(mesh, token_spec)
 
@@ -139,6 +164,16 @@ def train_demo(
     with mesh:
         state, step_fn, token_sharding = create_sharded_state(mesh, cfg, tc)
         n_batch = mesh.shape["data"] * mesh.shape["fsdp"] * per_device_batch
+        if mesh.shape.get("pipe", 1) > 1:
+            # round UP to a batch divisible by both the microbatch count and
+            # the (data, fsdp) token sharding — never silently shrink the
+            # requested batch
+            import math
+
+            num_micro = tc.resolve_num_microbatches(mesh.shape["pipe"])
+            group = mesh.shape["data"] * mesh.shape["fsdp"]
+            lcm = group * num_micro // math.gcd(group, num_micro)
+            n_batch = (n_batch + lcm - 1) // lcm * lcm
         tokens = jax.device_put(
             jax.random.randint(jax.random.PRNGKey(1), (n_batch, seq_len), 0, cfg.vocab_size, jnp.int32),
             token_sharding,
