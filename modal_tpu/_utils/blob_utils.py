@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import io
+import os
 from contextlib import asynccontextmanager
 from typing import AsyncIterator, BinaryIO, Optional, Union
 
@@ -30,6 +31,24 @@ MULTIPART_CONCURRENCY = 20
 # Inflight memory budget for map pumping / uploads (reference
 # blob_utils.py:57-59: min 256 MiB, max 2 GiB, <=50% of RAM).
 DEFAULT_BYTE_BUDGET = 256 * 1024 * 1024
+MULTIPART_INFLIGHT_BYTES_MIN = 256 * 1024 * 1024
+MULTIPART_INFLIGHT_BYTES_MAX = 2 * 1024**3
+MULTIPART_INFLIGHT_MEMORY_FRACTION = 0.5
+
+
+def multipart_byte_budget() -> int:
+    """min 256 MiB, max 2 GiB, at most 50% of system RAM (reference
+    blob_utils.py:57-59)."""
+    try:
+        ram = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        ram = 8 * 1024**3
+    return int(
+        min(
+            MULTIPART_INFLIGHT_BYTES_MAX,
+            max(MULTIPART_INFLIGHT_BYTES_MIN, ram * MULTIPART_INFLIGHT_MEMORY_FRACTION),
+        )
+    )
 
 
 class _ByteBudget:
@@ -159,18 +178,24 @@ async def blob_upload(payload: Union[bytes, BinaryIO], stub) -> str:
 
 
 async def _multipart_upload(buf: BinaryIO, mp: api_pb2.MultiPartUpload) -> None:
-    """Parallel part PUTs with bounded concurrency (reference
-    perform_multipart_upload, blob_utils.py:166)."""
+    """Parallel part PUTs, bounded by BOTH the 20-way concurrency cap and
+    the RAM-aware inflight byte budget (reference perform_multipart_upload
+    blob_utils.py:166 + _ByteBudget blob_utils.py:57-66)."""
     sem = asyncio.Semaphore(MULTIPART_CONCURRENCY)
+    budget = _ByteBudget(multipart_byte_budget())
+    lock = asyncio.Lock()  # buf.seek/read must be atomic across part tasks
 
     async def _part(i: int, url: str) -> None:
-        # Read inside the semaphore so resident memory is bounded by
-        # MULTIPART_CONCURRENCY × part_length, not the whole blob.
         async with sem:
-            offset = i * mp.part_length
-            buf.seek(offset)
-            data = buf.read(mp.part_length)
-            await _put_url(url, data)
+            await budget.acquire(mp.part_length)
+            try:
+                async with lock:
+                    buf.seek(i * mp.part_length)
+                    data = buf.read(mp.part_length)
+                await _put_url(url, data)
+                del data
+            finally:
+                await budget.release(mp.part_length)
 
     await asyncio.gather(*[_part(i, url) for i, url in enumerate(mp.upload_urls)])
     if mp.completion_url:

@@ -47,6 +47,7 @@ class _Client:
         self._owner_pid = os.getpid()
         self.image_builder_version: Optional[str] = None
         self.input_plane_url: Optional[str] = None
+        self._auth_token_manager: Optional[Any] = None
 
     def _metadata(self) -> dict[str, str]:
         md = {
@@ -90,6 +91,16 @@ class _Client:
             self._stub_cache[server_url] = ModalTPUStub(channel)
         return self._stub_cache[server_url]
 
+    async def get_input_plane_metadata(self) -> list[tuple[str, str]]:
+        """Per-call metadata for input-plane RPCs: the refreshing JWT
+        (reference client.py:301 get_input_plane_metadata)."""
+        if self._auth_token_manager is None:
+            from ._utils.auth_token_manager import AuthTokenManager
+
+            self._auth_token_manager = AuthTokenManager(self.stub)
+        token = await self._auth_token_manager.get_token()
+        return [("x-modal-tpu-auth-token", token)]
+
     async def hello(self) -> None:
         resp = await retry_transient_errors(
             self.stub.ClientHello,
@@ -127,6 +138,13 @@ class _Client:
                 )
                 client = cls(server_url, client_type, credentials)
                 await client._open()
+                try:
+                    # learn server capabilities (input_plane_url, builder
+                    # version); a failure here surfaces on the first real
+                    # RPC anyway — don't block client creation
+                    await client.hello()
+                except Exception as exc:  # noqa: BLE001
+                    logger.debug(f"client hello failed: {exc}")
                 cls._client_from_env = client
             return cls._client_from_env
 

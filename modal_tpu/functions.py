@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import os
 import time
 import typing
 from dataclasses import dataclass, field
@@ -411,8 +412,18 @@ class _Function(_Object, type_prefix="fu"):
     # Invocation side
     # ------------------------------------------------------------------
 
+    def _use_input_plane(self) -> bool:
+        return bool(
+            self.client.input_plane_url and os.environ.get("MODAL_TPU_DISABLE_INPUT_PLANE") != "1"
+        )
+
     @live_method
     async def _call_function(self, args: tuple, kwargs: dict) -> Any:
+        if self._use_input_plane():
+            # region-local data plane: AttemptStart/Await/Retry with JWT
+            # auth (reference _functions.py:394)
+            ip_invocation = await _InputPlaneInvocation.create(self, args, kwargs, client=self.client)
+            return await ip_invocation.run_function()
         invocation = await _Invocation.create(
             self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
         )
@@ -673,6 +684,94 @@ class _Invocation:
                 yield deserialize_data_format(data, chunk.data_format, self.client)
             else:
                 await asyncio.sleep(0.01)
+
+
+MAX_INTERNAL_FAILURE_COUNT = 9
+
+
+class _InputPlaneInvocation:
+    """Single-input call through the region-local input plane (reference
+    _InputPlaneInvocation, _functions.py:394: AttemptStart/Await/Retry with
+    JWT metadata). Blob offload still goes through the CONTROL plane stub —
+    only the invocation path is regional."""
+
+    def __init__(
+        self,
+        stub,
+        attempt_token: str,
+        client: _Client,
+        input_item: api_pb2.FunctionPutInputsItem,
+        function_id: str,
+        retry_policy: api_pb2.RetryPolicy,
+    ):
+        self.stub = stub
+        self.client = client
+        self.attempt_token = attempt_token
+        self.input_item = input_item
+        self.function_id = function_id
+        self.retry_policy = retry_policy
+
+    @staticmethod
+    async def create(
+        function: "_Function", args: tuple, kwargs: dict, *, client: _Client, method_name: str = ""
+    ) -> "_InputPlaneInvocation":
+        stub = await client.get_stub(client.input_plane_url)
+        item = await _create_input(
+            args, kwargs, client.stub, method_name=method_name or function._use_method_name
+        )
+        metadata = await client.get_input_plane_metadata()
+        response = await retry_transient_errors(
+            stub.AttemptStart,
+            api_pb2.AttemptStartRequest(function_id=function.object_id, input=item),
+            metadata=metadata,
+        )
+        return _InputPlaneInvocation(
+            stub, response.attempt_token, client, item, function.object_id, response.retry_policy
+        )
+
+    async def run_function(self) -> Any:
+        user_retries = RetryManager(self.retry_policy)
+        user_retry_count = 0
+        internal_failure_count = 0
+        while True:
+            metadata = await self.client.get_input_plane_metadata()
+            response = await retry_transient_errors(
+                self.stub.AttemptAwait,
+                api_pb2.AttemptAwaitRequest(
+                    attempt_token=self.attempt_token, timeout=OUTPUTS_TIMEOUT, requested_at=time.time()
+                ),
+                attempt_timeout=OUTPUTS_TIMEOUT + 5.0,
+                max_retries=None,
+                metadata=metadata,
+            )
+            if not response.HasField("output"):
+                continue  # poll window elapsed; keep awaiting
+            result = response.output.result
+            if result.status == api_pb2.GENERIC_STATUS_INTERNAL_FAILURE:
+                # lost input / worker preemption: retry immediately, not
+                # counted against the user retry policy
+                internal_failure_count += 1
+                if internal_failure_count < MAX_INTERNAL_FAILURE_COUNT:
+                    await self._retry_input(metadata)
+                    continue
+            elif result.status not in (api_pb2.GENERIC_STATUS_SUCCESS, api_pb2.GENERIC_STATUS_TIMEOUT):
+                if user_retry_count < self.retry_policy.retries:
+                    user_retry_count += 1
+                    # post-increment: first retry backs off initial_delay
+                    await asyncio.sleep(user_retries.attempt_delay(user_retry_count))
+                    await self._retry_input(metadata)
+                    continue
+            return await _process_result(result, response.output.data_format, self.client.stub, self.client)
+
+    async def _retry_input(self, metadata: list[tuple[str, str]]) -> None:
+        response = await retry_transient_errors(
+            self.stub.AttemptRetry,
+            api_pb2.AttemptRetryRequest(
+                function_id=self.function_id, input=self.input_item, attempt_token=self.attempt_token
+            ),
+            metadata=metadata,
+        )
+        self.attempt_token = response.attempt_token
 
 
 class _FunctionCall(_Object, type_prefix="fc"):

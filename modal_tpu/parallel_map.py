@@ -48,6 +48,147 @@ MAX_INPUTS_OUTSTANDING = 1000
 LOST_INPUT_CHECK_PERIOD = 30.0  # reference MapCheckInputs cadence
 
 
+class _ControlPlaneMapTransport:
+    """Default map wire path: FunctionMap / FunctionPutInputs /
+    FunctionRetryInputs / FunctionGetOutputs on the control plane."""
+
+    def __init__(self, client, function_id: str):
+        self.stub = client.stub
+        self.function_id = function_id
+        import grpc as _grpc
+
+        self._resource_exhausted = [_grpc.StatusCode.RESOURCE_EXHAUSTED]
+
+    async def create_call(self, return_exceptions: bool) -> str:
+        resp = await retry_transient_errors(
+            self.stub.FunctionMap,
+            api_pb2.FunctionMapRequest(
+                function_id=self.function_id,
+                function_call_type=api_pb2.FUNCTION_CALL_TYPE_MAP,
+                invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC,
+                return_exceptions=return_exceptions,
+            ),
+        )
+        return resp.function_call_id
+
+    async def put_batch(self, call_id: str, batch: list[api_pb2.FunctionPutInputsItem]) -> None:
+        await retry_transient_errors(
+            self.stub.FunctionPutInputs,
+            api_pb2.FunctionPutInputsRequest(
+                function_id=self.function_id, function_call_id=call_id, inputs=batch
+            ),
+            max_retries=8,
+            max_delay=15.0,
+            additional_status_codes=self._resource_exhausted,
+        )
+
+    async def retry_input(
+        self, call_id: str, input_id: str, retry_count: int, idx: int,
+        item: Optional[api_pb2.FunctionPutInputsItem],
+    ) -> None:
+        await retry_transient_errors(
+            self.stub.FunctionRetryInputs,
+            api_pb2.FunctionRetryInputsRequest(
+                function_call_jwt=call_id,
+                inputs=[api_pb2.FunctionRetryInputsItem(input_id=input_id, retry_count=retry_count)],
+            ),
+        )
+
+    def discard(self, idx: int) -> None:
+        pass  # no per-input client state on the control plane
+
+    async def get_outputs(self, call_id: str, last_entry_id: str) -> tuple[list, str]:
+        resp = await retry_transient_errors(
+            self.stub.FunctionGetOutputs,
+            api_pb2.FunctionGetOutputsRequest(
+                function_call_id=call_id,
+                timeout=OUTPUTS_TIMEOUT,
+                last_entry_id=last_entry_id,
+                max_values=0,
+                clear_on_success=False,
+                requested_at=time.time(),
+            ),
+            attempt_timeout=OUTPUTS_TIMEOUT + 5.0,
+            max_retries=None,
+        )
+        return list(resp.outputs), resp.last_entry_id or last_entry_id
+
+
+class _InputPlaneMapTransport:
+    """Region-local map wire path (reference parallel_map.py:620):
+    MapStartOrContinue / MapAwait on the input plane with JWT metadata.
+    Attempt tokens (returned per item) drive re-submission of failed
+    attempts; blob traffic and MapCheckInputs stay on the control plane."""
+
+    def __init__(self, client, ip_stub, function_id: str):
+        self.client = client
+        self.stub = ip_stub
+        self.function_id = function_id
+        self.token_by_idx: dict[int, str] = {}
+
+    @staticmethod
+    async def create_for(client, function_id: str) -> "_InputPlaneMapTransport":
+        ip_stub = await client.get_stub(client.input_plane_url)
+        return _InputPlaneMapTransport(client, ip_stub, function_id)
+
+    async def _start_or_continue(
+        self, call_id: str, items: list[api_pb2.MapStartOrContinueItem]
+    ) -> str:
+        metadata = await self.client.get_input_plane_metadata()
+        resp = await retry_transient_errors(
+            self.stub.MapStartOrContinue,
+            api_pb2.MapStartOrContinueRequest(
+                function_id=self.function_id, function_call_id=call_id, items=items
+            ),
+            max_retries=8,
+            max_delay=15.0,
+            metadata=metadata,
+        )
+        for item, token in zip(items, resp.attempt_tokens):
+            self.token_by_idx[item.input.idx] = token
+        return resp.function_call_id
+
+    async def create_call(self, return_exceptions: bool) -> str:
+        return await self._start_or_continue("", [])
+
+    async def put_batch(self, call_id: str, batch: list[api_pb2.FunctionPutInputsItem]) -> None:
+        await self._start_or_continue(
+            call_id, [api_pb2.MapStartOrContinueItem(input=item) for item in batch]
+        )
+
+    async def retry_input(
+        self, call_id: str, input_id: str, retry_count: int, idx: int,
+        item: Optional[api_pb2.FunctionPutInputsItem],
+    ) -> None:
+        if item is None:
+            raise InvalidError(f"input-plane retry for idx {idx} lost its payload")
+        await self._start_or_continue(
+            call_id,
+            [api_pb2.MapStartOrContinueItem(input=item, attempt_token=self.token_by_idx.get(idx, ""))],
+        )
+
+    def discard(self, idx: int) -> None:
+        # tokens are only needed while an input may still be retried — keep
+        # the map bounded by the outstanding window, not total map size
+        self.token_by_idx.pop(idx, None)
+
+    async def get_outputs(self, call_id: str, last_entry_id: str) -> tuple[list, str]:
+        metadata = await self.client.get_input_plane_metadata()
+        resp = await retry_transient_errors(
+            self.stub.MapAwait,
+            api_pb2.MapAwaitRequest(
+                function_call_id=call_id,
+                timeout=OUTPUTS_TIMEOUT,
+                last_entry_id=last_entry_id,
+                requested_at=time.time(),
+            ),
+            attempt_timeout=OUTPUTS_TIMEOUT + 5.0,
+            max_retries=None,
+            metadata=metadata,
+        )
+        return list(resp.outputs), resp.last_entry_id or last_entry_id
+
+
 async def _map_invocation(
     function: "_Function",
     raw_input_gen: AsyncGenerator[tuple[tuple, dict], None],
@@ -64,16 +205,11 @@ async def _map_invocation(
     client = function.client
     stub = client.stub
 
-    map_resp = await retry_transient_errors(
-        stub.FunctionMap,
-        api_pb2.FunctionMapRequest(
-            function_id=function.object_id,
-            function_call_type=api_pb2.FUNCTION_CALL_TYPE_MAP,
-            invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC,
-            return_exceptions=return_exceptions,
-        ),
-    )
-    function_call_id = map_resp.function_call_id
+    if function._use_input_plane():
+        transport: Any = await _InputPlaneMapTransport.create_for(client, function.object_id)
+    else:
+        transport = _ControlPlaneMapTransport(client, function.object_id)
+    function_call_id = await transport.create_call(return_exceptions)
     if function_call_id_out is not None:
         function_call_id_out.append(function_call_id)
 
@@ -98,19 +234,9 @@ async def _map_invocation(
     # backpressure only applies when outputs are consumed — spawn_map never
     # polls outputs, so nothing would ever release the budget
     budget = _ByteBudget(max_items=MAX_INPUTS_OUTSTANDING) if wait_for_outputs else None
-    grpc = __import__("grpc")
 
     async def _put_batch(batch: list[api_pb2.FunctionPutInputsItem]) -> None:
-        req = api_pb2.FunctionPutInputsRequest(
-            function_id=function.object_id, function_call_id=function_call_id, inputs=batch
-        )
-        await retry_transient_errors(
-            stub.FunctionPutInputs,
-            req,
-            max_retries=8,
-            max_delay=15.0,
-            additional_status_codes=[grpc.StatusCode.RESOURCE_EXHAUSTED],
-        )
+        await transport.put_batch(function_call_id, batch)
 
     async def pump_inputs() -> None:
         nonlocal inputs_sent
@@ -155,6 +281,7 @@ async def _map_invocation(
 
     async def _finalize(idx: int) -> None:
         finalized.add(idx)
+        transport.discard(idx)
         entry = unfinished.pop(idx, None)
         if entry is not None and budget is not None:
             await budget.release(entry[1])
@@ -166,17 +293,16 @@ async def _map_invocation(
         next_count = item.retry_count + 1
         delay = retry_mgr.attempt_delay(next_count) if retry_mgr is not None else 0.0
 
-        async def _fire(input_id: str = item.input_id, count: int = next_count) -> None:
+        async def _fire(
+            input_id: str = item.input_id, count: int = next_count, idx: int = item.idx
+        ) -> None:
             nonlocal pending_retries
             try:
                 if delay:
                     await asyncio.sleep(delay)
-                await retry_transient_errors(
-                    stub.FunctionRetryInputs,
-                    api_pb2.FunctionRetryInputsRequest(
-                        function_call_jwt=function_call_id,
-                        inputs=[api_pb2.FunctionRetryInputsItem(input_id=input_id, retry_count=count)],
-                    ),
+                entry = unfinished.get(idx)
+                await transport.retry_input(
+                    function_call_id, input_id, count, idx, entry[0] if entry else None
                 )
             except BaseException as exc:  # noqa: BLE001
                 # a failed re-submission means the input will never produce
@@ -212,21 +338,8 @@ async def _map_invocation(
     async def poll_outputs(tc: TaskContext) -> AsyncGenerator[tuple[int, Any], None]:
         last_entry_id = ""
         while True:
-            resp = await retry_transient_errors(
-                stub.FunctionGetOutputs,
-                api_pb2.FunctionGetOutputsRequest(
-                    function_call_id=function_call_id,
-                    timeout=OUTPUTS_TIMEOUT,
-                    last_entry_id=last_entry_id,
-                    max_values=0,
-                    clear_on_success=False,
-                    requested_at=time.time(),
-                ),
-                attempt_timeout=OUTPUTS_TIMEOUT + 5.0,
-                max_retries=None,
-            )
-            last_entry_id = resp.last_entry_id or last_entry_id
-            for item in resp.outputs:
+            outputs, last_entry_id = await transport.get_outputs(function_call_id, last_entry_id)
+            for item in outputs:
                 if item.idx in finalized:
                     continue  # stale output from a retried attempt
                 retryable = (

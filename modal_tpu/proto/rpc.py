@@ -167,6 +167,14 @@ _RPC_NAMES = [
     "WorkerRegister",
     "WorkerPoll",
     "WorkerHeartbeat",
+    # Input plane (region-local data plane; ref _functions.py:394,
+    # parallel_map.py:620)
+    "AuthTokenGet",
+    "AttemptStart",
+    "AttemptAwait",
+    "AttemptRetry",
+    "MapStartOrContinue",
+    "MapAwait",
     # Misc
     "ClientHello",
     "TokenFlowCreate",

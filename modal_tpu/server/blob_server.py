@@ -21,10 +21,16 @@ class BlobServer:
         self.port = port
         self._runner: Optional[web.AppRunner] = None
 
+    # multipart observability (tests assert genuine part parallelism)
+    inflight_parts: int = 0
+    max_inflight_parts: int = 0
+
     async def start(self) -> str:
         app = web.Application(client_max_size=8 * 1024 * 1024 * 1024)
         app.router.add_put("/blob/{blob_id}", self._put)
         app.router.add_get("/blob/{blob_id}", self._get)
+        app.router.add_put("/blob/{blob_id}/part/{part}", self._put_part)
+        app.router.add_put("/blob/{blob_id}/complete/{n_parts}", self._complete)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -46,6 +52,44 @@ class BlobServer:
             async for chunk in request.content.iter_chunked(1024 * 1024):
                 f.write(chunk)
         os.replace(tmp, path)
+        return web.Response(status=200)
+
+    async def _put_part(self, request: web.Request) -> web.Response:
+        """One multipart part (reference: S3 presigned part PUT,
+        perform_multipart_upload blob_utils.py:166)."""
+        blob_id = request.match_info["blob_id"]
+        part = int(request.match_info["part"])
+        self.inflight_parts += 1
+        self.max_inflight_parts = max(self.max_inflight_parts, self.inflight_parts)
+        try:
+            path = self.state.blob_path(blob_id) + f".part{part}"
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                async for chunk in request.content.iter_chunked(1024 * 1024):
+                    f.write(chunk)
+            os.replace(tmp, path)
+            return web.Response(status=200)
+        finally:
+            self.inflight_parts -= 1
+
+    async def _complete(self, request: web.Request) -> web.Response:
+        """Assemble parts into the final blob (reference completion_url)."""
+        blob_id = request.match_info["blob_id"]
+        n_parts = int(request.match_info["n_parts"])
+        final = self.state.blob_path(blob_id)
+        part_paths = [final + f".part{i}" for i in range(n_parts)]
+        missing = [p for p in part_paths if not os.path.exists(p)]
+        if missing:
+            return web.Response(status=400, text=f"{len(missing)} parts missing")
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as out:
+            for p in part_paths:
+                with open(p, "rb") as f:
+                    while chunk := f.read(4 * 1024 * 1024):
+                        out.write(chunk)
+        os.replace(tmp, final)
+        for p in part_paths:
+            os.unlink(p)
         return web.Response(status=200)
 
     async def _get(self, request: web.Request) -> web.StreamResponse:

@@ -1,0 +1,257 @@
+"""Input-plane server: region-local invocation data plane with JWT auth.
+
+The reference routes latency-sensitive invocations through a regional input
+plane speaking AttemptStart/AttemptAwait/AttemptRetry (single calls,
+/root/reference/py/modal/_functions.py:394) and MapStartOrContinue/MapAwait
+(maps, /root/reference/py/modal/parallel_map.py:620), authenticated with a
+refreshing JWT (auth_token_manager.py:28). This is the serving half: a lean
+gRPC service sharing the control plane's state (in production it would be a
+separate regional deployment fronting the same queues — the wire contract is
+what matters), enforcing the JWT on every RPC.
+
+Attempt tokens are server-minted ids mapping to (function_call_id, input_id);
+a retry re-queues the same input and mints a fresh token.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import grpc
+
+from ..config import logger
+from .._utils.jwt_utils import verify_jwt
+from ..proto import api_pb2
+from ..proto.rpc import build_generic_handler
+from .state import FunctionCallState, ServerState, make_id
+
+AUTH_METADATA_KEY = "x-modal-tpu-auth-token"
+
+
+class InputPlaneServicer:
+    """Serves ONLY the input-plane RPCs; everything else is UNIMPLEMENTED
+    (the generic handler skips methods the servicer doesn't define)."""
+
+    def __init__(self, state: ServerState, control_servicer):
+        self.s = state
+        self.control = control_servicer  # reuses _enqueue_input + conditions
+        self.auth_failures = 0  # observability for tests
+        self.rpc_counts: dict[str, int] = {}
+
+    def _count(self, name: str) -> None:
+        self.rpc_counts[name] = self.rpc_counts.get(name, 0) + 1
+
+    async def _require_auth(self, context) -> None:
+        md = dict(context.invocation_metadata() or ())
+        token = md.get(AUTH_METADATA_KEY, "")
+        if not token or verify_jwt(token, self.s.auth_secret) is None:
+            self.auth_failures += 1
+            await context.abort(grpc.StatusCode.UNAUTHENTICATED, "missing or invalid input-plane auth token")
+
+    def _mint_attempt(self, call_id: str, input_id: str, supersedes: str = "") -> str:
+        token = make_id("at")
+        self.s.attempts[token] = (call_id, input_id)
+        if supersedes:
+            # the replaced attempt's token must stop resolving
+            self.s.attempts.pop(supersedes, None)
+        if len(self.s.attempts) > 100_000:
+            # opportunistic GC: tokens whose call is gone can never resolve
+            live = {
+                t for t, (cid, _) in self.s.attempts.items() if cid in self.s.function_calls
+            }
+            self.s.attempts = {t: v for t, v in self.s.attempts.items() if t in live}
+        return token
+
+    def _start_call(self, function_id: str, call_type: int) -> FunctionCallState:
+        call = FunctionCallState(
+            function_call_id=make_id("fc"),
+            function_id=function_id,
+            call_type=call_type,
+        )
+        self.s.function_calls[call.function_call_id] = call
+        return call
+
+    async def _enqueue(self, fn, call, item: api_pb2.FunctionPutInputsItem) -> str:
+        inp = self.control._enqueue_input(fn, call, item)
+        return inp.input_id
+
+    async def _notify(self, fn) -> None:
+        async with fn.input_condition:
+            fn.input_condition.notify_all()
+        self.s.schedule_event.set()
+
+    # -- single-input attempts (ref _functions.py:394) ----------------------
+
+    async def AttemptStart(self, request: api_pb2.AttemptStartRequest, context) -> api_pb2.AttemptStartResponse:
+        await self._require_auth(context)
+        self._count("AttemptStart")
+        fn = self.s.functions.get(request.function_id)
+        if fn is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"function {request.function_id} not found")
+        call = self._start_call(request.function_id, api_pb2.FUNCTION_CALL_TYPE_UNARY)
+        input_id = await self._enqueue(fn, call, request.input)
+        await self._notify(fn)
+        resp = api_pb2.AttemptStartResponse(attempt_token=self._mint_attempt(call.function_call_id, input_id))
+        if fn.definition.HasField("retry_policy"):
+            resp.retry_policy.CopyFrom(fn.definition.retry_policy)
+        return resp
+
+    async def AttemptAwait(self, request: api_pb2.AttemptAwaitRequest, context) -> api_pb2.AttemptAwaitResponse:
+        await self._require_auth(context)
+        self._count("AttemptAwait")
+        entry = self.s.attempts.get(request.attempt_token)
+        if entry is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "unknown attempt token")
+        call_id, input_id = entry
+        call = self.s.function_calls.get(call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
+        while True:
+            for item in call.outputs:
+                if item.input_id == input_id:
+                    return api_pb2.AttemptAwaitResponse(output=item)
+            if time.monotonic() >= deadline:
+                return api_pb2.AttemptAwaitResponse()
+            async with call.output_condition:
+                try:
+                    await asyncio.wait_for(
+                        call.output_condition.wait(), timeout=max(0.05, deadline - time.monotonic())
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+    async def AttemptRetry(self, request: api_pb2.AttemptRetryRequest, context) -> api_pb2.AttemptRetryResponse:
+        await self._require_auth(context)
+        self._count("AttemptRetry")
+        entry = self.s.attempts.get(request.attempt_token)
+        if entry is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "unknown attempt token")
+        call_id, input_id = entry
+        call = self.s.function_calls.get(call_id)
+        inp = self.s.inputs.get(input_id)
+        if call is None or inp is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "attempt state lost")
+        fn = self.s.functions[call.function_id]
+        # drop the failed attempt's output so the new one is awaitable
+        call.outputs[:] = [o for o in call.outputs if o.input_id != input_id]
+        call.num_done = max(0, call.num_done - 1)
+        inp.status = "pending"
+        inp.retry_count += 1
+        if request.input.input.WhichOneof("args_oneof"):
+            inp.input.CopyFrom(request.input.input)
+        inp.delivered_to.clear()
+        inp.claimed_by = ""
+        inp.claimed_at = 0.0
+        if input_id not in fn.pending:
+            fn.pending.append(input_id)
+        await self._notify(fn)
+        return api_pb2.AttemptRetryResponse(
+            attempt_token=self._mint_attempt(call_id, input_id, supersedes=request.attempt_token)
+        )
+
+    # -- map attempts (ref parallel_map.py:620) -----------------------------
+
+    async def MapStartOrContinue(
+        self, request: api_pb2.MapStartOrContinueRequest, context
+    ) -> api_pb2.MapStartOrContinueResponse:
+        await self._require_auth(context)
+        self._count("MapStartOrContinue")
+        fn = self.s.functions.get(request.function_id)
+        if fn is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"function {request.function_id} not found")
+        if request.function_call_id:
+            call = self.s.function_calls.get(request.function_call_id)
+            if call is None:
+                await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        else:
+            call = self._start_call(request.function_id, api_pb2.FUNCTION_CALL_TYPE_MAP)
+        tokens = []
+        for item in request.items:
+            if item.attempt_token:
+                # re-submission of a failed attempt: reset the same input
+                entry = self.s.attempts.get(item.attempt_token)
+                if entry is not None and (inp := self.s.inputs.get(entry[1])) is not None:
+                    # the failed attempt's output already counted toward
+                    # num_done; the retry will count again (AttemptRetry
+                    # does the same) — keep num_unfinished_inputs truthful
+                    call.num_done = max(0, call.num_done - 1)
+                    inp.status = "pending"
+                    inp.retry_count += 1
+                    inp.delivered_to.clear()
+                    inp.claimed_by = ""
+                    inp.claimed_at = 0.0
+                    if inp.input_id not in fn.pending:
+                        fn.pending.append(inp.input_id)
+                    tokens.append(
+                        self._mint_attempt(
+                            call.function_call_id, inp.input_id, supersedes=item.attempt_token
+                        )
+                    )
+                    continue
+            input_id = await self._enqueue(fn, call, item.input)
+            tokens.append(self._mint_attempt(call.function_call_id, input_id))
+        await self._notify(fn)
+        return api_pb2.MapStartOrContinueResponse(
+            function_call_id=call.function_call_id, attempt_tokens=tokens
+        )
+
+    async def MapAwait(self, request: api_pb2.MapAwaitRequest, context) -> api_pb2.MapAwaitResponse:
+        await self._require_auth(context)
+        self._count("MapAwait")
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
+        deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
+        while True:
+            start = int(request.last_entry_id or 0)
+            available = call.outputs[start:]
+            if available:
+                return api_pb2.MapAwaitResponse(
+                    outputs=available,
+                    last_entry_id=str(start + len(available)),
+                    num_unfinished_inputs=call.num_inputs - call.num_done,
+                )
+            if time.monotonic() >= deadline:
+                return api_pb2.MapAwaitResponse(
+                    outputs=[],
+                    last_entry_id=str(start),
+                    num_unfinished_inputs=call.num_inputs - call.num_done,
+                )
+            async with call.output_condition:
+                try:
+                    await asyncio.wait_for(
+                        call.output_condition.wait(), timeout=max(0.05, deadline - time.monotonic())
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+
+class InputPlaneServer:
+    """Owns the gRPC server for the input-plane servicer (own port; in
+    production a separate regional deployment)."""
+
+    def __init__(self, state: ServerState, control_servicer, port: int = 0):
+        self.servicer = InputPlaneServicer(state, control_servicer)
+        self.state = state
+        self.port = port
+        self._server: Optional[grpc.aio.Server] = None
+
+    async def start(self) -> None:
+        self._server = grpc.aio.server(
+            options=[
+                ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+                ("grpc.max_send_message_length", 128 * 1024 * 1024),
+            ]
+        )
+        self._server.add_generic_rpc_handlers((build_generic_handler(self.servicer),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{self.port}")
+        self.state.input_plane_url = f"grpc://127.0.0.1:{self.port}"
+        await self._server.start()
+        logger.debug(f"input plane up at {self.state.input_plane_url}")
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.5)

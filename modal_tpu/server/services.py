@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import os
 import time
 from typing import Any, Optional
 
@@ -58,7 +59,21 @@ class ModalTPUServicer:
     # ------------------------------------------------------------------
 
     async def ClientHello(self, request: api_pb2.ClientHelloRequest, context) -> api_pb2.ClientHelloResponse:
-        return api_pb2.ClientHelloResponse(server_version="0.1.0", image_builder_version="2026.07")
+        return api_pb2.ClientHelloResponse(
+            server_version="0.1.0",
+            image_builder_version="2026.07",
+            input_plane_url=self.s.input_plane_url,
+        )
+
+    async def AuthTokenGet(self, request: api_pb2.AuthTokenGetRequest, context) -> api_pb2.AuthTokenGetResponse:
+        """Issue an input-plane JWT (reference: AuthTokenGet consumed by
+        _AuthTokenManager, auth_token_manager.py:28). TTL overridable for
+        expiry tests via MODAL_TPU_AUTH_TOKEN_TTL."""
+        from .._utils.jwt_utils import encode_jwt
+
+        ttl = float(os.environ.get("MODAL_TPU_AUTH_TOKEN_TTL", "1200"))
+        token = encode_jwt({"sub": "input-plane"}, self.s.auth_secret, ttl_s=ttl)
+        return api_pb2.AuthTokenGetResponse(token=token)
 
     async def EnvironmentList(self, request, context):
         names = set(self.s.environments) | {env for env, _ in self.s.deployed_apps.keys() if env}
@@ -321,6 +336,26 @@ class ModalTPUServicer:
         blob_id = "bl-" + hashlib.sha256(
             (request.content_sha256_base64 + str(time.time_ns())).encode()
         ).hexdigest()[:16]
+        # Multipart above the reference threshold (blob_utils.py:54: 1 GiB;
+        # env-overridable so tests exercise the path without GiB payloads).
+        # Part length balances part count (S3-style 10k cap) against memory.
+        from .._utils.blob_utils import MULTIPART_THRESHOLD
+
+        threshold = int(os.environ.get("MODAL_TPU_MULTIPART_THRESHOLD", str(MULTIPART_THRESHOLD)))
+        if request.content_length >= threshold:
+            part_length = int(
+                os.environ.get("MODAL_TPU_MULTIPART_PART_LEN", str(64 * 1024 * 1024))
+            )
+            part_length = max(part_length, (request.content_length + 9_999) // 10_000)
+            n_parts = (request.content_length + part_length - 1) // part_length
+            mp = api_pb2.MultiPartUpload(
+                part_length=part_length,
+                upload_urls=[
+                    f"{self.s.blob_url_base}/blob/{blob_id}/part/{i}" for i in range(n_parts)
+                ],
+                completion_url=f"{self.s.blob_url_base}/blob/{blob_id}/complete/{n_parts}",
+            )
+            return api_pb2.BlobCreateResponse(blob_id=blob_id, multipart=mp)
         return api_pb2.BlobCreateResponse(
             blob_id=blob_id, upload_url=f"{self.s.blob_url_base}/blob/{blob_id}"
         )

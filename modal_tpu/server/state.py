@@ -270,6 +270,13 @@ class ServerState:
         self.tokens: dict[str, str] = {}  # token_id -> token_secret
         self.pending_token_flows: dict[str, tuple[str, str]] = {}
         self.blob_url_base: str = ""  # set by supervisor once blob server is up
+        # input plane (region-local data plane): url advertised in
+        # ClientHello; HS256 secret shared between AuthTokenGet (control
+        # plane) and the input-plane servicer's verifier; attempt_token ->
+        # (function_call_id, input_id)
+        self.input_plane_url: str = ""
+        self.auth_secret: bytes = os.urandom(32)
+        self.attempts: dict[str, tuple[str, str]] = {}
 
         # scheduling wakeup
         self.schedule_event = asyncio.Event()

@@ -18,6 +18,7 @@ import grpc
 from ..config import config, logger
 from ..proto.rpc import build_generic_handler
 from .blob_server import BlobServer
+from .input_plane import InputPlaneServer
 from .scheduler import Scheduler
 from .services import ModalTPUServicer
 from .state import ServerState
@@ -44,6 +45,7 @@ class LocalSupervisor:
         self.scheduler = Scheduler(self.state, self.servicer)
         self.servicer.scheduler = self.scheduler
         self.blob_server = BlobServer(self.state)
+        self.input_plane = InputPlaneServer(self.state, self.servicer)
         self.workers: list[WorkerAgent] = []
         self._grpc_server: Optional[grpc.aio.Server] = None
 
@@ -63,6 +65,7 @@ class LocalSupervisor:
         self.port = self._grpc_server.add_insecure_port(f"127.0.0.1:{self.port}")
         await self._grpc_server.start()
         await self.blob_server.start()
+        await self.input_plane.start()
         self.scheduler.start()
         for i in range(self.num_workers):
             worker = WorkerAgent(
@@ -79,6 +82,7 @@ class LocalSupervisor:
         for worker in self.workers:
             await worker.stop()
         await self.scheduler.stop()
+        await self.input_plane.stop()
         await self.blob_server.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)

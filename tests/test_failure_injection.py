@@ -52,6 +52,27 @@ def test_map_survives_injected_put_inputs_faults(supervisor):
     with app.run():
         supervisor.servicer.fail_put_inputs = 2
         assert sorted(f.map([1, 2, 3])) == [1, 2, 3]
+        # the knob targets the control-plane pump: with the input plane
+        # routing maps elsewhere it must be pinned (and consumed) there
+        assert supervisor.servicer.fail_put_inputs == 2  # input plane active: untouched
+
+
+def test_map_survives_put_inputs_faults_control_plane(supervisor, monkeypatch):
+    """The control-plane pump retries injected UNAVAILABLE on PutInputs
+    (pinned via the input-plane opt-out so the knob actually fires)."""
+    import modal_tpu
+
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")
+    app = modal_tpu.App("fi-putin-cp")
+
+    def ident(x):
+        return x
+
+    f = app.function(serialized=True)(ident)
+    with app.run():
+        supervisor.servicer.fail_put_inputs = 2
+        assert sorted(f.map([1, 2, 3])) == [1, 2, 3]
+        assert supervisor.servicer.fail_put_inputs == 0  # faults were consumed
 
 
 def test_rate_limit_sleep_is_honored(supervisor):

@@ -1,0 +1,212 @@
+"""Input-plane invocation: AttemptStart/Await/Retry + MapStartOrContinue/
+MapAwait over a separate JWT-authenticated gRPC server.
+
+Reference: _InputPlaneInvocation (py/modal/_functions.py:394), map variant
+(py/modal/parallel_map.py:620), token refresh-ahead
+(py/modal/_utils/auth_token_manager.py:14).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import modal_tpu
+
+
+def _make_app():
+    app = modal_tpu.App("ip-test")
+
+    @app.function(serialized=True)
+    def double(x: int) -> int:
+        return x * 2
+
+    return app, double
+
+
+def test_remote_routes_through_input_plane(supervisor):
+    app, double = _make_app()
+    with app.run():
+        assert double.remote(21) == 42
+    counts = supervisor.input_plane.servicer.rpc_counts
+    assert counts.get("AttemptStart", 0) >= 1
+    assert counts.get("AttemptAwait", 0) >= 1
+
+
+def test_map_routes_through_input_plane(supervisor):
+    app, double = _make_app()
+    with app.run():
+        results = list(double.map(range(10)))
+    assert results == [x * 2 for x in range(10)]
+    counts = supervisor.input_plane.servicer.rpc_counts
+    assert counts.get("MapStartOrContinue", 0) >= 2  # create + >=1 batch
+    assert counts.get("MapAwait", 0) >= 1
+
+
+def test_input_plane_disable_env(supervisor, monkeypatch):
+    """Opt-out pins the control plane path (used by the fault-injection
+    tests that target control-plane RPCs)."""
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")
+    app, double = _make_app()
+    before = dict(supervisor.input_plane.servicer.rpc_counts)
+    with app.run():
+        assert double.remote(5) == 10
+    assert supervisor.input_plane.servicer.rpc_counts == before
+
+
+def test_input_plane_requires_auth(supervisor):
+    """Direct RPC without the JWT is UNAUTHENTICATED."""
+    import grpc
+
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.grpc_utils import create_channel
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.proto.rpc import ModalTPUStub
+
+    url = supervisor.state.input_plane_url
+
+    async def _call():
+        channel = create_channel(url)
+        stub = ModalTPUStub(channel)
+        try:
+            await stub.AttemptStart(api_pb2.AttemptStartRequest(function_id="fu-x"))
+        finally:
+            await channel.close()
+
+    with pytest.raises(grpc.aio.AioRpcError) as exc_info:
+        synchronizer.run(_call())
+    assert exc_info.value.code() == grpc.StatusCode.UNAUTHENTICATED
+    assert supervisor.input_plane.servicer.auth_failures >= 1
+
+    # and a garbage token is also rejected
+    async def _call_bad():
+        channel = create_channel(url)
+        stub = ModalTPUStub(channel)
+        try:
+            await stub.AttemptStart(
+                api_pb2.AttemptStartRequest(function_id="fu-x"),
+                metadata=[("x-modal-tpu-auth-token", "aaa.bbb.ccc")],
+            )
+        finally:
+            await channel.close()
+
+    with pytest.raises(grpc.aio.AioRpcError) as exc_info:
+        synchronizer.run(_call_bad())
+    assert exc_info.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+
+def test_attempt_retry_user_policy(supervisor, tmp_path):
+    """A function that fails until its third attempt succeeds through the
+    input plane's AttemptRetry path under the user retry policy."""
+    app = modal_tpu.App("ip-retry")
+    marker = str(tmp_path / "attempts.txt")
+
+    @app.function(serialized=True, retries=modal_tpu.Retries(max_retries=3, initial_delay=0.1))
+    def flaky(marker_path: str) -> int:
+        import os
+
+        n = 1
+        if os.path.exists(marker_path):
+            n = int(open(marker_path).read()) + 1
+        with open(marker_path, "w") as f:
+            f.write(str(n))
+        if n < 3:
+            raise RuntimeError(f"attempt {n} fails")
+        return n
+
+    with app.run():
+        assert flaky.remote(marker) == 3
+    counts = supervisor.input_plane.servicer.rpc_counts
+    assert counts.get("AttemptRetry", 0) >= 2
+
+
+def test_map_retry_through_input_plane(supervisor, tmp_path):
+    """Map attempts re-submitted with attempt tokens on user-code failure."""
+    app = modal_tpu.App("ip-map-retry")
+    marker_dir = str(tmp_path)
+
+    @app.function(serialized=True, retries=modal_tpu.Retries(max_retries=2, initial_delay=0.1))
+    def flaky_item(x: int, marker_dir: str) -> int:
+        import os
+
+        p = os.path.join(marker_dir, f"m{x}.txt")
+        n = int(open(p).read()) + 1 if os.path.exists(p) else 1
+        with open(p, "w") as f:
+            f.write(str(n))
+        if x == 2 and n < 2:
+            raise RuntimeError("first attempt of item 2 fails")
+        return x * 10
+
+    with app.run():
+        results = list(flaky_item.map(range(4), kwargs={"marker_dir": marker_dir}))
+    assert results == [0, 10, 20, 30]
+
+
+def test_map_retry_keeps_done_count_truthful(supervisor, tmp_path):
+    """A map re-submission must decrement num_done before the retry runs —
+    num_unfinished_inputs on the wire can never go negative."""
+    app = modal_tpu.App("ip-count")
+    marker = str(tmp_path / "m.txt")
+
+    @app.function(serialized=True, retries=modal_tpu.Retries(max_retries=2, initial_delay=0.1))
+    def once_flaky(x: int, marker_path: str) -> int:
+        import os
+
+        if x == 1 and not os.path.exists(marker_path):
+            with open(marker_path, "w") as f:
+                f.write("1")
+            raise RuntimeError("first attempt fails")
+        return x
+
+    with app.run():
+        assert sorted(once_flaky.map(range(3), kwargs={"marker_path": marker})) == [0, 1, 2]
+    for call in supervisor.state.function_calls.values():
+        assert call.num_done <= call.num_inputs, (call.function_call_id, call.num_done, call.num_inputs)
+
+
+def test_auth_token_manager_states():
+    """The three cached-token states (reference auth_token_manager.py:28):
+    valid (no fetch), expiring-soon (refresh-ahead), expired (block+fetch)."""
+    from modal_tpu._utils import auth_token_manager as atm
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.jwt_utils import encode_jwt
+    from modal_tpu.proto import api_pb2
+
+    calls = []
+
+    class FakeStub:
+        def __init__(self, ttl):
+            self.ttl = ttl
+
+        async def AuthTokenGet(self, request):
+            calls.append(time.time())
+            return api_pb2.AuthTokenGetResponse(token=encode_jwt({}, b"k", ttl_s=self.ttl))
+
+    async def scenario():
+        # long-lived token: second get is a cache hit
+        mgr = atm.AuthTokenManager(FakeStub(3600))
+        t1 = await mgr.get_token()
+        t2 = await mgr.get_token()
+        assert t1 == t2 and len(calls) == 1
+        # expired token: refetch
+        mgr2 = atm.AuthTokenManager(FakeStub(-10))
+        await mgr2.get_token()
+        await mgr2.get_token()
+        assert len(calls) == 3  # every call refetches (always expired)
+        # concurrent first fetch: only one RPC
+        calls.clear()
+        mgr3 = atm.AuthTokenManager(FakeStub(3600))
+        await asyncio.gather(*[mgr3.get_token() for _ in range(10)])
+        assert len(calls) == 1
+
+    synchronizer.run(scenario())
+
+
+def test_token_expiry_refresh_e2e(supervisor, monkeypatch):
+    """Short-TTL tokens (expired by the refresh window immediately) force a
+    refetch per call — calls still succeed."""
+    monkeypatch.setenv("MODAL_TPU_AUTH_TOKEN_TTL", "2")
+    app, double = _make_app()
+    with app.run():
+        assert double.remote(1) == 2
+        assert double.remote(2) == 4
