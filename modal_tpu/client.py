@@ -118,6 +118,65 @@ class _Client:
     async def __aexit__(self, *exc: Any) -> None:
         await self._close()
 
+    _local_supervisor: ClassVar[Optional[Any]] = None
+
+    @classmethod
+    async def _maybe_boot_local_server(cls, server_url: str) -> str:
+        """Zero-config local mode: when the configured server is the default
+        localhost URL and nothing is listening, boot an in-process
+        LocalSupervisor (control plane + worker + blob server) and use it.
+        The reference SDK always has a cloud to talk to; this is our
+        equivalent of that always-reachable default. Containers
+        (task_id set) never auto-boot — a refused connection there is real."""
+        if cls._local_supervisor is not None:
+            return cls._local_supervisor.server_url
+        if config.get("task_id") or not config.get("auto_local_server"):
+            return server_url
+        from .config import _SETTINGS
+
+        if server_url != _SETTINGS["server_url"].default:
+            # an explicitly configured URL means the user runs their own
+            # server — a refused connection there must surface, not be
+            # papered over by a fresh empty supervisor
+            return server_url
+        import socket
+
+        host, port_s = server_url.removeprefix("grpc://").rsplit(":", 1)
+        try:
+            probe = socket.create_connection((host, int(port_s)), timeout=0.25)
+            probe.close()
+            return server_url  # a real server is listening
+        except OSError:
+            pass
+        from .server.supervisor import LocalSupervisor
+
+        sup = LocalSupervisor(num_workers=1, port=int(port_s))
+        try:
+            await sup.start()
+        except Exception as exc:  # noqa: BLE001 — e.g. lost a port race
+            logger.debug(f"local supervisor auto-boot failed: {exc}")
+            try:
+                await sup.stop()  # release anything that did bind (port!)
+            except Exception:  # noqa: BLE001
+                pass
+            return server_url
+        cls._local_supervisor = sup
+        loop = asyncio.get_running_loop()
+
+        def _shutdown() -> None:
+            try:
+                if loop.is_closed():
+                    return
+                asyncio.run_coroutine_threadsafe(sup.stop(), loop).result(timeout=5.0)
+            except Exception:  # noqa: BLE001 — loop already gone at exit
+                pass
+
+        import atexit
+
+        atexit.register(_shutdown)
+        logger.info(f"auto-booted local supervisor at {sup.server_url}")
+        return sup.server_url
+
     @classmethod
     async def from_env(cls) -> "_Client":
         """Singleton client from config/env; re-created on fork (reference
@@ -129,7 +188,7 @@ class _Client:
             cls._client_from_env_lock = asyncio.Lock()
         async with cls._client_from_env_lock:
             if cls._client_from_env is None or cls._client_from_env._closed:
-                server_url = config["server_url"]
+                server_url = await cls._maybe_boot_local_server(config["server_url"])
                 token_id = config.get("token_id")
                 token_secret = config.get("token_secret")
                 credentials = (token_id, token_secret) if token_id else None

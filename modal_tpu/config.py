@@ -85,6 +85,9 @@ _SETTINGS: dict[str, _Setting] = {
     "loglevel": _Setting("WARNING", lambda s: s.upper()),
     "log_format": _Setting("STRING", lambda s: s.upper()),
     "server_url": _Setting("grpc://127.0.0.1:9900"),
+    # zero-config local mode: when the server_url is local and nothing is
+    # listening, Client.from_env boots an in-process LocalSupervisor
+    "auto_local_server": _Setting(True, _to_boolean),
     "input_plane_url": _Setting(""),
     "token_id": _Setting(),
     "token_secret": _Setting(),

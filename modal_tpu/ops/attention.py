@@ -52,20 +52,24 @@ def _flash_kernel(
     k_ref,  # [S, head_dim]
     v_ref,  # [S, head_dim]
     o_ref,  # [block_q, head_dim]
-    lse_ref,  # [block_q] — logsumexp per query row (backward needs it)
+    lse_ref,  # [block_q, 1] — logsumexp per query row (backward needs it)
     *,
     sm_scale: float,
     block_k: int,
     causal: bool,
     block_q: int,
 ):
+    # All row statistics are kept (block_q, 1)-shaped: Mosaic's block rule
+    # wants the last two dims of every ref (8, 128)-aligned or full, and the
+    # VPU handles 2D vectors natively; interpret mode accepts rank-1 but the
+    # real lowering does not.
     q_blk = pl.program_id(2)
     seq_len = k_ref.shape[0]
     q = q_ref[...].astype(jnp.float32) * sm_scale
     q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q_ref.shape[1]), jnp.float32)
 
     num_k_blocks = seq_len // block_k
@@ -78,12 +82,12 @@ def _flash_kernel(
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        m_blk = jnp.max(s, axis=-1)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1)
-        acc_new = acc * correction[:, None] + p @ v
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + p @ v
         return m_new, l_new, acc_new
 
     if causal:
@@ -95,7 +99,7 @@ def _flash_kernel(
         last_block = num_k_blocks
     m, l, acc = lax.fori_loop(0, last_block, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[...] = m + jnp.log(l_safe)
 
 
@@ -104,8 +108,8 @@ def _flash_dq_kernel(
     k_ref,  # [S, d]
     v_ref,  # [S, d]
     do_ref,  # [block_q, d]
-    lse_ref,  # [block_q]
-    delta_ref,  # [block_q] — rowsum(dO * O)
+    lse_ref,  # [block_q, 1]
+    delta_ref,  # [block_q, 1] — rowsum(dO * O)
     dq_ref,  # [block_q, d]
     *,
     sm_scale: float,
@@ -119,8 +123,8 @@ def _flash_dq_kernel(
     seq_len = k_ref.shape[0]
     q = q_ref[...].astype(jnp.float32)
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...]
-    delta = delta_ref[...]
+    lse = lse_ref[...]  # [block_q, 1]
+    delta = delta_ref[...]  # [block_q, 1]
     q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
     num_k_blocks = seq_len // block_k
 
@@ -131,9 +135,9 @@ def _flash_dq_kernel(
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # exact probs via saved lse
+        p = jnp.exp(s - lse)  # exact probs via saved lse
         dp = do @ v.T
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         return acc + ds @ k
 
     if causal:
@@ -150,8 +154,8 @@ def _flash_dkv_kernel(
     k_ref,  # [block_k, d]
     v_ref,  # [block_k, d]
     do_ref,  # [S, d]
-    lse_ref,  # [S]
-    delta_ref,  # [S]
+    lse_ref,  # [S, 1]
+    delta_ref,  # [S, 1]
     dk_ref,  # [block_k, d]
     dv_ref,  # [block_k, d]
     *,
@@ -173,16 +177,16 @@ def _flash_dkv_kernel(
         dk_acc, dv_acc = carry
         q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[pl.ds(qb * block_q, block_q)]
+        lse = lse_ref[pl.ds(qb * block_q, block_q), :]  # [block_q, 1]
+        delta = delta_ref[pl.ds(qb * block_q, block_q), :]
         s = (q @ k.T) * sm_scale  # [block_q, block_k]
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv_acc = dv_acc + p.T @ do
         dp = do @ v.T
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dk_acc = dk_acc + ds.T @ q
         return dk_acc, dv_acc
 
@@ -213,7 +217,7 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (out [B,S,H,D], lse [B,H,S])."""
+    """Returns (out [B,S,H,D], lse [B,H,S,1])."""
     b, s, h, d = q.shape
     skv = k.shape[1]
     if causal and s != skv:
@@ -242,11 +246,14 @@ def _flash_forward(
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            # lse rides a trailing unit dim: Mosaic requires the last two
+            # block dims be (8,128)-aligned or full, which a squeezed rank-1
+            # block can't satisfy
+            pl.BlockSpec((None, None, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt)
@@ -270,7 +277,7 @@ def _flash_backward(
     k: jax.Array,
     v: jax.Array,
     out: jax.Array,
-    lse: jax.Array,  # [B, H, S]
+    lse: jax.Array,  # [B, H, S, 1]
     do: jax.Array,  # [B, S, H, D]
     causal: bool,
     block_q: int,
@@ -288,7 +295,7 @@ def _flash_backward(
         do.astype(jnp.float32),
         out.astype(jnp.float32),
         preferred_element_type=jnp.float32,
-    )
+    )[..., None]  # [B, H, S, 1] to match the lse block layout
 
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -306,8 +313,8 @@ def _flash_backward(
             pl.BlockSpec((None, None, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((None, None, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
-            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((None, None, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
@@ -325,8 +332,8 @@ def _flash_backward(
             pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((None, None, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, s), lambda bi, hi, ki: (bi, hi, 0)),
-            pl.BlockSpec((None, None, s), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((None, None, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),

@@ -26,6 +26,8 @@ from __future__ import annotations
 import asyncio
 import time
 import typing
+
+import grpc
 from typing import Any, AsyncGenerator, AsyncIterable, Iterable, Optional, Union
 
 from ._utils.async_utils import TaskContext, aclosing, queue_batch_iterator, synchronizer, sync_or_async_iter
@@ -47,6 +49,10 @@ MAP_INPUT_BATCH_SIZE = 100
 MAX_INPUTS_OUTSTANDING = 1000
 LOST_INPUT_CHECK_PERIOD = 30.0  # reference MapCheckInputs cadence
 
+# server backpressure on input puts must back off, not kill the map — both
+# transports retry this status beyond the transient set
+_RESOURCE_EXHAUSTED = [grpc.StatusCode.RESOURCE_EXHAUSTED]
+
 
 class _ControlPlaneMapTransport:
     """Default map wire path: FunctionMap / FunctionPutInputs /
@@ -55,9 +61,6 @@ class _ControlPlaneMapTransport:
     def __init__(self, client, function_id: str):
         self.stub = client.stub
         self.function_id = function_id
-        import grpc as _grpc
-
-        self._resource_exhausted = [_grpc.StatusCode.RESOURCE_EXHAUSTED]
 
     async def create_call(self, return_exceptions: bool) -> str:
         resp = await retry_transient_errors(
@@ -79,7 +82,7 @@ class _ControlPlaneMapTransport:
             ),
             max_retries=8,
             max_delay=15.0,
-            additional_status_codes=self._resource_exhausted,
+            additional_status_codes=_RESOURCE_EXHAUSTED,
         )
 
     async def retry_input(
@@ -142,6 +145,7 @@ class _InputPlaneMapTransport:
             ),
             max_retries=8,
             max_delay=15.0,
+            additional_status_codes=_RESOURCE_EXHAUSTED,
             metadata=metadata,
         )
         for item, token in zip(items, resp.attempt_tokens):

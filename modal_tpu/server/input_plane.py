@@ -50,18 +50,26 @@ class InputPlaneServicer:
             self.auth_failures += 1
             await context.abort(grpc.StatusCode.UNAUTHENTICATED, "missing or invalid input-plane auth token")
 
+    # tokens older than this are assumed abandoned (no client awaits an
+    # attempt for an hour; function timeout ceiling is far below it)
+    ATTEMPT_TTL_S = 3600.0
+
     def _mint_attempt(self, call_id: str, input_id: str, supersedes: str = "") -> str:
         token = make_id("at")
-        self.s.attempts[token] = (call_id, input_id)
+        self.s.attempts[token] = (call_id, input_id, time.monotonic())
         if supersedes:
             # the replaced attempt's token must stop resolving
             self.s.attempts.pop(supersedes, None)
         if len(self.s.attempts) > 100_000:
-            # opportunistic GC: tokens whose call is gone can never resolve
-            live = {
-                t for t, (cid, _) in self.s.attempts.items() if cid in self.s.function_calls
+            # opportunistic GC. Client-originated calls are never removed from
+            # state.function_calls, so call-liveness alone frees nothing —
+            # age out stale tokens too so the scan actually shrinks the dict.
+            cutoff = time.monotonic() - self.ATTEMPT_TTL_S
+            self.s.attempts = {
+                t: (cid, iid, ts)
+                for t, (cid, iid, ts) in self.s.attempts.items()
+                if cid in self.s.function_calls and ts > cutoff
             }
-            self.s.attempts = {t: v for t, v in self.s.attempts.items() if t in live}
         return token
 
     def _start_call(self, function_id: str, call_type: int) -> FunctionCallState:
@@ -104,18 +112,22 @@ class InputPlaneServicer:
         entry = self.s.attempts.get(request.attempt_token)
         if entry is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "unknown attempt token")
-        call_id, input_id = entry
+        call_id, input_id = entry[0], entry[1]
         call = self.s.function_calls.get(call_id)
         if call is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
         deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
-        while True:
-            for item in call.outputs:
-                if item.input_id == input_id:
-                    return api_pb2.AttemptAwaitResponse(output=item)
-            if time.monotonic() >= deadline:
-                return api_pb2.AttemptAwaitResponse()
-            async with call.output_condition:
+        # predicate is checked while HOLDING the condition lock: producers
+        # notify under it (appends happen just before, outside the lock), so
+        # a notify can't slip between our scan and wait() — that race would
+        # stall the RPC a full poll window
+        async with call.output_condition:
+            while True:
+                for item in call.outputs:
+                    if item.input_id == input_id:
+                        return api_pb2.AttemptAwaitResponse(output=item)
+                if time.monotonic() >= deadline:
+                    return api_pb2.AttemptAwaitResponse()
                 try:
                     await asyncio.wait_for(
                         call.output_condition.wait(), timeout=max(0.05, deadline - time.monotonic())
@@ -123,34 +135,45 @@ class InputPlaneServicer:
                 except asyncio.TimeoutError:
                     pass
 
+    def _requeue_input(self, fn, call, inp, supersedes: str, *, prune_output: bool, new_input=None) -> str:
+        """Reset a failed attempt's input to pending and mint the superseding
+        token — the shared invariant block of AttemptRetry (which also prunes
+        the stale output so the new attempt is awaitable) and
+        MapStartOrContinue re-submission (which keeps outputs: the map cursor
+        already handed them out)."""
+        if prune_output:
+            call.outputs[:] = [o for o in call.outputs if o.input_id != inp.input_id]
+        # the failed attempt's output already counted toward num_done; the
+        # retry will count again — keep num_unfinished_inputs truthful
+        call.num_done = max(0, call.num_done - 1)
+        inp.status = "pending"
+        inp.retry_count += 1
+        if new_input is not None and new_input.WhichOneof("args_oneof"):
+            inp.input.CopyFrom(new_input)
+        inp.delivered_to.clear()
+        inp.claimed_by = ""
+        inp.claimed_at = 0.0
+        if inp.input_id not in fn.pending:
+            fn.pending.append(inp.input_id)
+        return self._mint_attempt(call.function_call_id, inp.input_id, supersedes=supersedes)
+
     async def AttemptRetry(self, request: api_pb2.AttemptRetryRequest, context) -> api_pb2.AttemptRetryResponse:
         await self._require_auth(context)
         self._count("AttemptRetry")
         entry = self.s.attempts.get(request.attempt_token)
         if entry is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "unknown attempt token")
-        call_id, input_id = entry
+        call_id, input_id = entry[0], entry[1]
         call = self.s.function_calls.get(call_id)
         inp = self.s.inputs.get(input_id)
         if call is None or inp is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "attempt state lost")
         fn = self.s.functions[call.function_id]
-        # drop the failed attempt's output so the new one is awaitable
-        call.outputs[:] = [o for o in call.outputs if o.input_id != input_id]
-        call.num_done = max(0, call.num_done - 1)
-        inp.status = "pending"
-        inp.retry_count += 1
-        if request.input.input.WhichOneof("args_oneof"):
-            inp.input.CopyFrom(request.input.input)
-        inp.delivered_to.clear()
-        inp.claimed_by = ""
-        inp.claimed_at = 0.0
-        if input_id not in fn.pending:
-            fn.pending.append(input_id)
-        await self._notify(fn)
-        return api_pb2.AttemptRetryResponse(
-            attempt_token=self._mint_attempt(call_id, input_id, supersedes=request.attempt_token)
+        token = self._requeue_input(
+            fn, call, inp, request.attempt_token, prune_output=True, new_input=request.input.input
         )
+        await self._notify(fn)
+        return api_pb2.AttemptRetryResponse(attempt_token=token)
 
     # -- map attempts (ref parallel_map.py:620) -----------------------------
 
@@ -174,21 +197,8 @@ class InputPlaneServicer:
                 # re-submission of a failed attempt: reset the same input
                 entry = self.s.attempts.get(item.attempt_token)
                 if entry is not None and (inp := self.s.inputs.get(entry[1])) is not None:
-                    # the failed attempt's output already counted toward
-                    # num_done; the retry will count again (AttemptRetry
-                    # does the same) — keep num_unfinished_inputs truthful
-                    call.num_done = max(0, call.num_done - 1)
-                    inp.status = "pending"
-                    inp.retry_count += 1
-                    inp.delivered_to.clear()
-                    inp.claimed_by = ""
-                    inp.claimed_at = 0.0
-                    if inp.input_id not in fn.pending:
-                        fn.pending.append(inp.input_id)
                     tokens.append(
-                        self._mint_attempt(
-                            call.function_call_id, inp.input_id, supersedes=item.attempt_token
-                        )
+                        self._requeue_input(fn, call, inp, item.attempt_token, prune_output=False)
                     )
                     continue
             input_id = await self._enqueue(fn, call, item.input)
@@ -205,22 +215,24 @@ class InputPlaneServicer:
         if call is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
         deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
-        while True:
-            start = int(request.last_entry_id or 0)
-            available = call.outputs[start:]
-            if available:
-                return api_pb2.MapAwaitResponse(
-                    outputs=available,
-                    last_entry_id=str(start + len(available)),
-                    num_unfinished_inputs=call.num_inputs - call.num_done,
-                )
-            if time.monotonic() >= deadline:
-                return api_pb2.MapAwaitResponse(
-                    outputs=[],
-                    last_entry_id=str(start),
-                    num_unfinished_inputs=call.num_inputs - call.num_done,
-                )
-            async with call.output_condition:
+        # same lock discipline as AttemptAwait: predicate under the condition
+        # lock so the producer's notify can't be lost between scan and wait
+        async with call.output_condition:
+            while True:
+                start = int(request.last_entry_id or 0)
+                available = call.outputs[start:]
+                if available:
+                    return api_pb2.MapAwaitResponse(
+                        outputs=available,
+                        last_entry_id=str(start + len(available)),
+                        num_unfinished_inputs=call.num_inputs - call.num_done,
+                    )
+                if time.monotonic() >= deadline:
+                    return api_pb2.MapAwaitResponse(
+                        outputs=[],
+                        last_entry_id=str(start),
+                        num_unfinished_inputs=call.num_inputs - call.num_done,
+                    )
                 try:
                     await asyncio.wait_for(
                         call.output_condition.wait(), timeout=max(0.05, deadline - time.monotonic())

@@ -276,7 +276,7 @@ class ServerState:
         # (function_call_id, input_id)
         self.input_plane_url: str = ""
         self.auth_secret: bytes = os.urandom(32)
-        self.attempts: dict[str, tuple[str, str]] = {}
+        self.attempts: dict[str, tuple[str, str, float]] = {}  # token -> (call_id, input_id, minted_at)
 
         # scheduling wakeup
         self.schedule_event = asyncio.Event()

@@ -26,6 +26,9 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["MODAL_TPU_JAX_PLATFORM"] = "cpu"
+# hermetic tests: never auto-boot a LocalSupervisor from Client.from_env —
+# every test that needs a server runs its own fixture supervisor
+os.environ["MODAL_TPU_AUTO_LOCAL_SERVER"] = "0"
 
 import jax  # noqa: E402
 
