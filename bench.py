@@ -143,10 +143,11 @@ def _make_app(tpu_type: str, timeout_s: int):
             # are created directly in int8 — a bf16-staged 8B tree could
             # never materialize on the chip.
             from modal_tpu.models.quant import init_params_quantized, quantized_bytes
+            from modal_tpu.models.sampling import host_sync
 
             t0 = _time.perf_counter()
             qparams = init_params_quantized(cfg, jax.random.PRNGKey(0))
-            jax.block_until_ready(qparams)
+            host_sync(qparams)
             init_s = _time.perf_counter() - t0
             timings = benchmark_decode(
                 qparams, cfg, batch=batch, prompt_len=prompt_len, gen_len=gen_len,
@@ -162,20 +163,22 @@ def _make_app(tpu_type: str, timeout_s: int):
             # (the SAME program the measure phase times, so cold numbers
             # describe the real decode path). The server's first_output_at
             # for this call IS cold-start-to-first-step.
+            from modal_tpu.models.sampling import host_sync
+
             t0 = _time.perf_counter()
             params = init_params(cfg, jax.random.PRNGKey(0))
-            jax.block_until_ready(params)
+            host_sync(params)
             init_s = _time.perf_counter() - t0
             prompt = jnp.ones((batch, prompt_len), jnp.int32)
             cache = KVCache.create(cfg, batch, cache_len)
             t0 = _time.perf_counter()
             logits, cache = prefill(params, cfg, prompt, cache)
-            logits.block_until_ready()
+            jax.device_get(logits[:, :8])
             prefill_s = _time.perf_counter() - t0
             next_tok = jnp.argmax(logits, axis=-1, keepdims=True).astype(jnp.int32)
             t0 = _time.perf_counter()
             toks, _, cache = decode_tokens(params, cfg, next_tok, cache, gen_len)
-            toks.block_until_ready()
+            jax.device_get(toks)
             first_sequence_s = _time.perf_counter() - t0
             _BENCH_STATE["params"] = params
             devices = jax.devices()
@@ -246,7 +249,9 @@ def _make_snap_app(tpu_type: str, timeout_s: int, model_name: str, use_volume_we
                 self.params = load_params((vol, "ckpt"), cfg)
             else:
                 self.params = init_params(cfg, jax.random.PRNGKey(0))
-            jax.block_until_ready(self.params)
+            from modal_tpu.models.sampling import host_sync
+
+            host_sync(self.params)
             self.load_stats = {
                 "weights_load_s": _time.perf_counter() - t0,
                 "peak_rss_gb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6,

@@ -75,6 +75,8 @@ __all__ = [
     "Queue",
     "Retries",
     "Sandbox",
+    "SandboxSnapshot",
+    "Tunnel",
     "ContainerProcess",
     "SandboxFS",
     "FileIO",
@@ -123,6 +125,14 @@ def __getattr__(name: str):
         from .container_process import ContainerProcess
 
         return ContainerProcess
+    if name == "SandboxSnapshot":
+        from .snapshot import SandboxSnapshot
+
+        return SandboxSnapshot
+    if name == "Tunnel":
+        from .sandbox import Tunnel
+
+        return Tunnel
     if name == "SandboxFS":
         from .sandbox_fs import SandboxFS
 

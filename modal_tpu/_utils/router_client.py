@@ -109,7 +109,14 @@ class TaskRouterClient:
     # -- exec plane ---------------------------------------------------------
 
     async def exec_start(
-        self, args: list[str], workdir: str = "", env: Optional[dict] = None, timeout_secs: int = 0
+        self,
+        args: list[str],
+        workdir: str = "",
+        env: Optional[dict] = None,
+        timeout_secs: int = 0,
+        pty: bool = False,
+        pty_rows: int = 0,
+        pty_cols: int = 0,
     ) -> str:
         import uuid
 
@@ -126,10 +133,21 @@ class TaskRouterClient:
                 env=env or {},
                 timeout_secs=timeout_secs,
                 exec_id=exec_id,
+                pty=pty,
+                pty_rows=pty_rows,
+                pty_cols=pty_cols,
             ),
             metadata=self._metadata,
         )
         return resp.exec_id
+
+    async def pty_resize(self, exec_id: str, rows: int, cols: int) -> None:
+        stub = await self.connect()
+        await retry_transient_errors(
+            stub.TaskExecPtyResize,
+            api_pb2.TaskExecPtyResizeRequest(exec_id=exec_id, rows=rows, cols=cols),
+            metadata=self._metadata,
+        )
 
     async def stdio_read(self, exec_id: str, fd: int) -> AsyncGenerator[bytes, None]:
         """Stream a fd to EOF, resuming from the last acked offset across

@@ -179,6 +179,7 @@ class _App:
         scaledown_window: int = 60,
         cloud: Optional[str] = None,
         region: Optional[Union[str, Sequence[str]]] = None,
+        scheduler_placement: Optional[SchedulerPlacement] = None,
         enable_memory_snapshot: bool = False,
         restrict_output: bool = False,
         is_generator: Optional[bool] = None,
@@ -208,7 +209,7 @@ class _App:
             check_valid_function(raw_f)
 
             info = FunctionInfo(raw_f, serialized=serialized, name_override=name)
-            placement = SchedulerPlacement(region=region) if region else None
+            placement = scheduler_placement or (SchedulerPlacement(region=region) if region else None)
             spec = _FunctionSpec(
                 image=image or self._image or _get_default_image(),
                 secrets=[*self._secrets, *secrets],

@@ -135,9 +135,12 @@ def deploy(ref: str, name: Optional[str], env: Optional[str], tag: str) -> None:
 @cli.command()
 @click.option("--cmd", "-c", "command", default=None, help="Run one command instead of an interactive shell.")
 @click.option("--tpu", default=None, help="TPU slice for the shell sandbox, e.g. v5e-1.")
-def shell(command: Optional[str], tpu: Optional[str]) -> None:
+@click.option("--no-pty", is_flag=True, help="Force the line-based fallback even on a tty.")
+def shell(command: Optional[str], tpu: Optional[str], no_pty: bool) -> None:
     """Open a shell (or run one command) in a fresh sandbox (reference
-    cli/shell.py — line-based here, no PTY)."""
+    cli/shell.py). On a real terminal this is a full PTY session (raw-mode
+    passthrough, window-size forwarding); piped stdin falls back to a
+    line-based loop."""
     from ..sandbox import Sandbox
 
     def run_and_echo(sb, line: str) -> int:
@@ -159,6 +162,11 @@ def shell(command: Optional[str], tpu: Optional[str]) -> None:
     try:
         if command:
             raise SystemExit(run_and_echo(sb, command))
+        if sys.stdin.isatty() and not no_pty:
+            from .._utils.pty_shell import run_pty_session
+
+            user_shell = os.environ.get("SHELL") or "/bin/bash"
+            raise SystemExit(run_pty_session(sb, [user_shell, "-i"]))
         click.echo("modal-tpu shell (line-based; 'exit' to quit)", err=True)
         while True:
             try:

@@ -114,6 +114,10 @@ _SETTINGS: dict[str, _Setting] = {
     # Directory for the local single-host backend's state (images, volumes,
     # blobs, compilation cache).
     "state_dir": _Setting(os.path.expanduser("~/.modal_tpu_state")),
+    # worker placement labels (matched against SchedulerPlacement)
+    "worker_region": _Setting(""),
+    "worker_zone": _Setting(""),
+    "worker_spot": _Setting(False, _to_boolean),
     # jax persistent compilation cache for cold-start elimination.
     "compilation_cache_dir": _Setting(os.path.expanduser("~/.modal_tpu_state/jit_cache")),
     # Default TPU runtime visible-device pinning behavior.

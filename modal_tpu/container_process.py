@@ -37,6 +37,11 @@ class _ExecStreamReader:
     def __aiter__(self):
         return self._aiter()
 
+    def __iter__(self):
+        # blocking surface: _aiter() resolves to a bridged sync generator
+        # when called off the synchronizer loop
+        return self._aiter()
+
 
 class _ExecStreamWriter:
     """Offset-tracked stdin writer: retried flushes can't duplicate bytes
@@ -112,6 +117,10 @@ class _ContainerProcess:
         if rc is not None:
             self._returncode = rc
         return rc
+
+    async def pty_resize(self, rows: int, cols: int) -> None:
+        """Propagate the client terminal's new window size (pty execs)."""
+        await self._router.pty_resize(self.exec_id, rows, cols)
 
 
 ContainerProcess = synchronize_api(_ContainerProcess)

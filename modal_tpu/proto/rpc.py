@@ -158,6 +158,12 @@ _RPC_NAMES = [
     "SandboxGetCommandRouterAccess",
     "SandboxGetLogs",
     "SandboxSnapshotFs",
+    "SandboxSnapshot",
+    "SandboxSnapshotGet",
+    "SandboxRestore",
+    "SandboxGetTunnels",
+    "TaskTunnelsUpdate",
+    "TaskReady",
     "ContainerExec",
     "ContainerExecGetOutput",
     "ContainerExecWait",
@@ -221,6 +227,7 @@ _ROUTER_RPC_NAMES = [
     "TaskExecStart",
     "TaskExecStdioRead",
     "TaskExecPutInput",
+    "TaskExecPtyResize",
     "TaskExecWait",
     "TaskFsOp",
 ]

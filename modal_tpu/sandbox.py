@@ -11,12 +11,19 @@ the SDK surface is the same).
 from __future__ import annotations
 
 import asyncio
+from dataclasses import dataclass
 from typing import Any, AsyncGenerator, Optional, Sequence
 
 from ._utils.async_utils import synchronize_api
 from ._utils.grpc_utils import retry_transient_errors
 from .client import _Client
-from .exception import InvalidError, NotFoundError, SandboxTerminatedError, SandboxTimeoutError
+from .exception import (
+    ExecutionError,
+    InvalidError,
+    NotFoundError,
+    SandboxTerminatedError,
+    SandboxTimeoutError,
+)
 from .image import _Image
 from .object import _Object
 from .proto import api_pb2
@@ -92,6 +99,25 @@ class _StreamWriter:
         )
 
 
+@dataclass(frozen=True)
+class Tunnel:
+    """A client-reachable forward of a sandbox port (reference _tunnel.py
+    Tunnel): connect to (host, port) to reach the sandbox's container_port."""
+
+    host: str
+    port: int
+    unencrypted: bool = False
+
+    @property
+    def url(self) -> str:
+        scheme = "http" if self.unencrypted else "https"
+        return f"{scheme}://{self.host}:{self.port}"
+
+    @property
+    def tcp_socket(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+
 class _Sandbox(_Object, type_prefix="sb"):
     _stdout: Optional[_StreamReader] = None
     _stderr: Optional[_StreamReader] = None
@@ -112,10 +138,16 @@ class _Sandbox(_Object, type_prefix="sb"):
         memory: Optional[int] = None,
         secrets: Sequence[Any] = (),
         name: Optional[str] = None,
+        encrypted_ports: Sequence[int] = (),
+        unencrypted_ports: Sequence[int] = (),
+        readiness_probe: Optional[Sequence[str]] = None,
         client: Optional[_Client] = None,
     ) -> "_Sandbox":
         """Launch a sandbox running `entrypoint_args` (reference
-        Sandbox.create, sandbox.py:518)."""
+        Sandbox.create, sandbox.py:518). Ports listed in encrypted_ports /
+        unencrypted_ports are forwarded — see `tunnels()`. A readiness_probe
+        argv is run inside the sandbox until it exits 0 (reference
+        sandbox.py:256 Probe); `wait_until_ready()` blocks on it."""
         if not entrypoint_args:
             raise InvalidError("sandbox needs a command, e.g. Sandbox.create('python', '-c', ...)")
         if client is None:
@@ -126,6 +158,19 @@ class _Sandbox(_Object, type_prefix="sb"):
             workdir=workdir or "",
             name=name or "",
         )
+        if image is not None:
+            from .object import LoadContext, Resolver
+
+            if not image.is_hydrated:
+                resolver = Resolver()
+                await resolver.load(image, LoadContext(client=client))
+            definition.image_id = image.object_id
+        for port in encrypted_ports:
+            definition.open_ports.append(api_pb2.PortSpec(port=port, unencrypted=False))
+        for port in unencrypted_ports:
+            definition.open_ports.append(api_pb2.PortSpec(port=port, unencrypted=True))
+        if readiness_probe:
+            definition.readiness_probe.exec_command.extend(readiness_probe)
         spec = parse_tpu_config(tpu)
         if spec is not None:
             definition.resources.tpu_config.CopyFrom(spec.to_proto())
@@ -227,16 +272,29 @@ class _Sandbox(_Object, type_prefix="sb"):
         env: Optional[dict] = None,
         timeout: int = 0,
         text: bool = True,
+        pty: bool = False,
+        pty_rows: int = 0,
+        pty_cols: int = 0,
     ):
         """Run a command inside the running sandbox, returning a
         ContainerProcess with streamed stdio (reference Sandbox.exec,
-        sandbox.py:1930 — V2 data plane via the worker's command router)."""
+        sandbox.py:1930 — V2 data plane via the worker's command router).
+        With pty=True the command runs under a real pseudo-terminal
+        (stdout+stderr merged on fd 1, as terminals do)."""
         if not args:
             raise InvalidError("exec needs a command")
         from .container_process import _ContainerProcess
 
         router = self._get_router()
-        exec_id = await router.exec_start(list(args), workdir=workdir or "", env=env, timeout_secs=timeout)
+        exec_id = await router.exec_start(
+            list(args),
+            workdir=workdir or "",
+            env=env,
+            timeout_secs=timeout,
+            pty=pty,
+            pty_rows=pty_rows,
+            pty_cols=pty_cols,
+        )
         return _ContainerProcess(router, exec_id, text=text)
 
     @property
@@ -251,6 +309,82 @@ class _Sandbox(_Object, type_prefix="sb"):
     async def open(self, path: str, mode: str = "r"):
         """Remote file handle (reference Sandbox.open / file_io.py)."""
         return await self.fs.open(path, mode)
+
+    async def tunnels(self, timeout: float = 50.0) -> dict[int, Tunnel]:
+        """Forwarded addresses for the sandbox's open ports, keyed by
+        container port (reference Sandbox.tunnels, sandbox.py:1930). Blocks
+        until the worker's tunnel listeners are up."""
+        resp = await retry_transient_errors(
+            self.client.stub.SandboxGetTunnels,
+            api_pb2.SandboxGetTunnelsRequest(sandbox_id=self.object_id, timeout=timeout),
+            attempt_timeout=timeout + 5.0,
+        )
+        if resp.result.status == api_pb2.GENERIC_STATUS_FAILURE:
+            raise InvalidError(resp.result.exception)
+        return {
+            t.container_port: Tunnel(host=t.host, port=t.port, unencrypted=t.unencrypted)
+            for t in resp.tunnels
+        }
+
+    async def wait_until_ready(self, timeout: float = 55.0) -> None:
+        """Block until the readiness probe passes. Raises
+        SandboxTerminatedError if the sandbox exits first, TimeoutError if
+        the probe still hasn't passed after `timeout` — a timeout must never
+        read as readiness."""
+        resp = await retry_transient_errors(
+            self.client.stub.SandboxGetTaskId,
+            api_pb2.SandboxGetTaskIdRequest(
+                sandbox_id=self.object_id, timeout=timeout, wait_until_ready=True
+            ),
+            attempt_timeout=timeout + 5.0,
+        )
+        if resp.task_result_json:
+            raise SandboxTerminatedError(
+                f"sandbox exited before becoming ready: {resp.task_result_json}"
+            )
+        if not resp.ready:
+            raise TimeoutError(f"sandbox not ready after {timeout}s (probe still failing)")
+
+    async def snapshot_filesystem(self, timeout: float = 55.0) -> _Image:
+        """Snapshot the sandbox's filesystem into an Image usable by new
+        sandboxes (reference sandbox.py:1480)."""
+        resp = await retry_transient_errors(
+            self.client.stub.SandboxSnapshotFs,
+            api_pb2.SandboxSnapshotFsRequest(sandbox_id=self.object_id, timeout=timeout),
+            attempt_timeout=timeout + 5.0,
+        )
+        if resp.result.status != api_pb2.GENERIC_STATUS_SUCCESS:
+            raise ExecutionError(f"filesystem snapshot failed: {resp.result.exception}")
+        return _Image._new_hydrated(resp.image_id, self.client, resp.image_metadata)
+
+    async def snapshot(self):
+        """Full sandbox snapshot (definition + filesystem) restorable with
+        `Sandbox.from_snapshot` (reference sandbox.py:2157
+        _experimental_snapshot; the local backend restores by re-running the
+        entrypoint over the snapshotted filesystem — no process checkpoint)."""
+        from .snapshot import _SandboxSnapshot
+
+        resp = await retry_transient_errors(
+            self.client.stub.SandboxSnapshot,
+            api_pb2.SandboxSnapshotRequest(sandbox_id=self.object_id),
+        )
+        return _SandboxSnapshot._new_hydrated(resp.snapshot_id, self.client, None)
+
+    # reference-parity alias (sandbox.py:2157)
+    _experimental_snapshot = snapshot
+
+    @staticmethod
+    async def from_snapshot(snapshot: Any, name: str = "", client: Optional[_Client] = None) -> "_Sandbox":
+        """Recreate a sandbox from a snapshot (reference
+        Sandbox._experimental_from_snapshot)."""
+        if client is None:
+            client = await _Client.from_env()
+        snapshot_id = snapshot if isinstance(snapshot, str) else snapshot.object_id
+        resp = await retry_transient_errors(
+            client.stub.SandboxRestore,
+            api_pb2.SandboxRestoreRequest(snapshot_id=snapshot_id, name=name),
+        )
+        return _Sandbox._new_hydrated(resp.sandbox_id, client, None)
 
     async def terminate(self) -> None:
         await retry_transient_errors(

@@ -72,6 +72,9 @@ class BuiltImage:
     entrypoint: list[str] = field(default_factory=list)
     cmd: list[str] = field(default_factory=list)
     rootfs: str = ""
+    # snapshot-image: content to seed a sandbox's workdir with (a dir holding
+    # the extracted fs snapshot; Sandbox.snapshot_filesystem round-trip)
+    fs_seed_dir: str = ""
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -158,6 +161,9 @@ class ImageBuilder:
         """Returns the built image, or None when the chain is trivial (host
         venv is the image). Raises ImageBuildError on any unhonorable layer."""
         chain = await self.fetch_chain(stub, image_id)
+        snapshot_blob_id = next((im.fs_snapshot_blob_id for im in chain if im.fs_snapshot_blob_id), "")
+        if snapshot_blob_id:
+            return await self._materialize_snapshot(stub, snapshot_blob_id)
         if _is_trivial(chain):
             return None
         key = chain_hash(chain)
@@ -195,6 +201,40 @@ class ImageBuilder:
             finally:
                 fcntl.flock(lock_file, fcntl.LOCK_UN)
                 lock_file.close()
+
+    async def _materialize_snapshot(self, stub, blob_id: str) -> BuiltImage:
+        """A snapshot-image is a filesystem tarball, not a layer build: fetch
+        the blob once (content-addressed by blob id) and extract it; sandboxes
+        using the image get a COPY of the extracted tree as their workdir."""
+        from .._utils.blob_utils import blob_download
+        from .fs_snapshot import untar_dir
+
+        seed_dir = os.path.join(self.images_dir, f"snapshot-{blob_id}")
+        marker = os.path.join(seed_dir, ".complete")
+        if not os.path.exists(marker):
+            lock = self._locks.setdefault(f"snapshot-{blob_id}", asyncio.Lock())
+            async with lock:
+                # cross-process (standalone worker agents sharing a state
+                # dir): same flock discipline as the layer-build path — two
+                # processes extracting into one tmp dir would corrupt the
+                # seed tree for every future restore
+                import fcntl
+
+                lock_file = open(seed_dir + ".lock", "w")
+                try:
+                    await asyncio.to_thread(fcntl.flock, lock_file, fcntl.LOCK_EX)
+                    if not os.path.exists(marker):
+                        data = await blob_download(blob_id, stub)
+                        tmp_dir = f"{seed_dir}.tmp{os.getpid()}"
+                        shutil.rmtree(tmp_dir, ignore_errors=True)
+                        await untar_dir(data, tmp_dir)
+                        open(os.path.join(tmp_dir, ".complete"), "w").close()
+                        shutil.rmtree(seed_dir, ignore_errors=True)
+                        os.replace(tmp_dir, seed_dir)
+                finally:
+                    fcntl.flock(lock_file, fcntl.LOCK_UN)
+                    lock_file.close()
+        return BuiltImage(python_bin=sys.executable, fs_seed_dir=seed_dir)
 
     async def _build(self, chain: list[api_pb2.Image], build_dir: str) -> BuiltImage:
         venv_dir = os.path.join(build_dir, "venv")

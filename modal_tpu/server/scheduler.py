@@ -27,6 +27,9 @@ from ..tpu_config import parse_tpu_config, slice_info_proto
 from .state import ClusterState, FunctionState, ServerState, TaskState_, WorkerState, make_id
 
 SCHEDULE_INTERVAL = 0.05
+# how long a placement may look unsatisfiable before its backlog is failed
+# (covers worker (re-)registration races at boot)
+PLACEMENT_UNSAT_GRACE_S = 5.0
 # Containers whose heartbeat is this stale are considered dead (reference
 # unhealthy threshold: 50 × heartbeat_interval, container_io_manager.py:605;
 # locally we use a much tighter bound).
@@ -81,6 +84,33 @@ class Scheduler:
                     logger.warning(f"disabling schedule for {fn.tag}: {exc}")
                     fn.next_fire_at = -1.0
             backlog = sum(1 for iid in fn.pending if self.s.inputs[iid].status == "pending")
+            placement = self._fn_placement(fn)
+            if backlog > 0 and placement is not None and not self._placement_satisfiable(placement):
+                # no registered worker could EVER match (wrong region/zone/
+                # spot labels): fail the backlog loudly instead of queueing
+                # forever — "all matching workers busy" is NOT this case.
+                # Grace window: a matching worker may simply not have
+                # (re-)registered yet (boot, restart-with-retries) — only
+                # fail after the condition persists.
+                now = time.time()
+                if fn.placement_unsat_since == 0.0:
+                    fn.placement_unsat_since = now
+                if now - fn.placement_unsat_since < PLACEMENT_UNSAT_GRACE_S:
+                    continue
+                result = api_pb2.GenericResult(
+                    status=api_pb2.GENERIC_STATUS_FAILURE,
+                    exception=(
+                        f"unsatisfiable placement for {fn.tag}: "
+                        f"regions={list(placement.regions)} zones={list(placement.zones)}"
+                        + (f" spot={placement.spot}" if placement.HasField("spot") else "")
+                        + " matches no registered worker"
+                    ),
+                )
+                logger.warning(result.exception)
+                if self.servicer is not None:
+                    await self.servicer._fail_pending_inputs(fn, result)
+                continue
+            fn.placement_unsat_since = 0.0  # satisfiable again
             settings = fn.autoscaler
             live = [
                 tid
@@ -160,15 +190,53 @@ class Scheduler:
         # single-task share: one host's worth of chips (gangs span hosts)
         return min(spec.chips, spec.chips_per_host) if spec else 0
 
+    @staticmethod
+    def _placement_ok(worker: WorkerState, placement) -> bool:
+        """Does this worker's labels satisfy the SchedulerPlacement?
+        Empty constraint lists match everything (reference
+        scheduler_placement.py:7 semantics)."""
+        if placement is None:
+            return True
+        if placement.regions and worker.region not in placement.regions:
+            return False
+        if placement.zones and worker.zone not in placement.zones:
+            return False
+        if placement.HasField("spot") and worker.spot != placement.spot:
+            return False
+        return True
+
+    def _placement_satisfiable(self, placement) -> bool:
+        """Could ANY registered worker (busy or not) ever match? Used to
+        reject impossible placements loudly instead of queueing forever."""
+        return any(self._placement_ok(w, placement) for w in self.s.workers.values())
+
+    @staticmethod
+    def _placement_or_none(p):
+        """None when the proto expresses no constraint at all (shared by the
+        function and sandbox paths so the two can't drift)."""
+        if not p.regions and not p.zones and not p.HasField("spot") and not p.instance_types:
+            return None
+        return p
+
+    @classmethod
+    def _fn_placement(cls, fn: FunctionState):
+        return cls._placement_or_none(fn.definition.scheduler_placement)
+
     def _pick_worker(
-        self, chips_needed: int, reserved: Optional[dict[str, int]] = None
+        self,
+        chips_needed: int,
+        reserved: Optional[dict[str, int]] = None,
+        placement=None,
     ) -> Optional[WorkerState]:
-        """Least-loaded worker with enough free chips. `reserved` counts chips
-        tentatively claimed by a gang being placed (so multi-rank placement on
-        one host can't double-book chips)."""
+        """Least-loaded worker with enough free chips that satisfies the
+        placement constraints. `reserved` counts chips tentatively claimed by
+        a gang being placed (so multi-rank placement on one host can't
+        double-book chips)."""
         best: Optional[WorkerState] = None
         for worker in self.s.workers.values():
             if time.time() - worker.last_heartbeat > 60.0:
+                continue
+            if not self._placement_ok(worker, placement):
                 continue
             free = len(worker.free_chips()) - (reserved or {}).get(worker.worker_id, 0)
             if chips_needed > 0 and free < chips_needed:
@@ -186,7 +254,7 @@ class Scheduler:
     ) -> Optional[TaskState_]:
         chips_needed = self._chips_needed(fn)
         if worker is None:
-            worker = self._pick_worker(chips_needed)
+            worker = self._pick_worker(chips_needed, placement=self._fn_placement(fn))
         if worker is None:
             return None
         task_id = make_id("ta")
@@ -235,7 +303,7 @@ class Scheduler:
         chosen: list[WorkerState] = []
         reserved: dict[str, int] = {}
         for r in range(group_size):
-            w = self._pick_worker(chips_needed, reserved=reserved)
+            w = self._pick_worker(chips_needed, reserved=reserved, placement=self._fn_placement(fn))
             if w is None:
                 return  # not enough capacity; retry next tick
             reserved[w.worker_id] = reserved.get(w.worker_id, 0) + chips_needed
@@ -317,7 +385,8 @@ class Scheduler:
         if tpu.tpu_type:
             spec = parse_tpu_config(tpu.tpu_type)
             chips_needed = min(spec.chips, spec.chips_per_host) if spec else 0
-        worker = self._pick_worker(chips_needed)
+        sb_placement = self._placement_or_none(sandbox.definition.scheduler_placement)
+        worker = self._pick_worker(chips_needed, placement=sb_placement)
         if worker is None:
             return None
         task_id = make_id("ta")

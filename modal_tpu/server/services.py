@@ -748,6 +748,13 @@ class ModalTPUServicer:
         fn = self.s.functions.get(task.function_id)
         if fn is not None:
             fn.init_failures = 0  # a container came up: init is healthy
+        if request.sandbox_workdir:
+            # the worker's ACTUAL choice of sandbox cwd (may come from the
+            # image's WORKDIR) — fs snapshots must tar this, not a guess
+            for sb in self.s.sandboxes.values():
+                if sb.task_id == request.task_id:
+                    sb.workdir = request.sandbox_workdir
+                    break
         return api_pb2.ContainerHelloResponse()
 
     async def ContainerHeartbeat(self, request, context) -> api_pb2.ContainerHeartbeatResponse:
@@ -1215,7 +1222,25 @@ class ModalTPUServicer:
         sb = self.s.sandboxes.get(request.sandbox_id)
         if sb is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
-        return api_pb2.SandboxGetTaskIdResponse(task_id=sb.task_id)
+        if request.wait_until_ready:
+            # block until the readiness probe passes (or the sandbox exits
+            # first — then surface its result so the client raises)
+            deadline = time.monotonic() + min(max(request.timeout, 0.0) or 55.0, 60.0)
+            while not sb.ready and sb.result is None and time.monotonic() < deadline:
+                task = self.s.tasks.get(sb.task_id)
+                if task is not None and task.result is not None:
+                    sb.result = task.result
+                    break
+                await asyncio.sleep(0.05)
+            if not sb.ready and sb.result is not None:
+                return api_pb2.SandboxGetTaskIdResponse(
+                    task_id=sb.task_id,
+                    task_result_json=json.dumps(
+                        {"status": int(sb.result.status), "exception": sb.result.exception}
+                    ),
+                )
+            return api_pb2.SandboxGetTaskIdResponse(task_id=sb.task_id, ready=sb.ready)
+        return api_pb2.SandboxGetTaskIdResponse(task_id=sb.task_id, ready=sb.ready)
 
     async def SandboxWait(self, request: api_pb2.SandboxWaitRequest, context) -> api_pb2.SandboxWaitResponse:
         sb = self.s.sandboxes.get(request.sandbox_id)
@@ -1360,6 +1385,9 @@ class ModalTPUServicer:
             container_address=request.container_address,
             slice_index=request.slice_index,
             router_address=request.router_address,
+            region=request.region,
+            zone=request.zone,
+            spot=request.spot,
         )
         self.s.schedule_event.set()
         return api_pb2.WorkerRegisterResponse(worker_id=worker_id)
@@ -1385,6 +1413,167 @@ class ModalTPUServicer:
             task_id=task.task_id,
             router_token=task.router_token,
         )
+
+    # -- sandbox snapshots + tunnels + readiness ----------------------------
+
+    def _sandbox_workdir(self, sb) -> str:
+        from .fs_snapshot import sandbox_workdir
+
+        # prefer the cwd the worker REPORTED at ContainerHello (it may come
+        # from the image's WORKDIR, which the control plane can't derive)
+        return sb.workdir or sandbox_workdir(self.s.state_dir, sb.task_id, sb.definition.workdir)
+
+    async def _snapshot_sandbox_fs(self, sb) -> str:
+        """Tar the sandbox's workdir into the blob store; returns blob_id."""
+        from .fs_snapshot import tar_dir
+
+        workdir = self._sandbox_workdir(sb)
+        if not os.path.isdir(workdir):
+            raise FileNotFoundError(f"sandbox workdir {workdir} not found on this host")
+        data = await tar_dir(workdir)
+        blob_id = make_id("bl")
+        path = self.s.blob_path(blob_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return blob_id
+
+    async def SandboxSnapshotFs(
+        self, request: api_pb2.SandboxSnapshotFsRequest, context
+    ) -> api_pb2.SandboxSnapshotFsRequestResponse:
+        """Filesystem snapshot → a snapshot-image usable by new sandboxes
+        (reference sandbox.py:1480 returns an Image the same way)."""
+        from .state import ImageState
+
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        try:
+            blob_id = await self._snapshot_sandbox_fs(sb)
+        except Exception as exc:  # noqa: BLE001 — surface as result, like ref
+            return api_pb2.SandboxSnapshotFsRequestResponse(
+                result=api_pb2.GenericResult(
+                    status=api_pb2.GENERIC_STATUS_FAILURE, exception=f"fs snapshot failed: {exc}"
+                )
+            )
+        image_id = make_id("im")
+        definition = api_pb2.Image(fs_snapshot_blob_id=blob_id)
+        self.s.images[image_id] = ImageState(image_id=image_id, definition=definition, built=True)
+        return api_pb2.SandboxSnapshotFsRequestResponse(
+            image_id=image_id,
+            result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+        )
+
+    async def SandboxSnapshot(
+        self, request: api_pb2.SandboxSnapshotRequest, context
+    ) -> api_pb2.SandboxSnapshotResponse:
+        from .state import SandboxSnapshotState
+
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        try:
+            blob_id = await self._snapshot_sandbox_fs(sb)
+        except (OSError, ValueError) as exc:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, f"snapshot failed: {exc}")
+        snapshot_id = make_id("sn")
+        definition = api_pb2.Sandbox()
+        definition.CopyFrom(sb.definition)
+        self.s.sandbox_snapshots[snapshot_id] = SandboxSnapshotState(
+            snapshot_id=snapshot_id, definition=definition, fs_blob_id=blob_id
+        )
+        return api_pb2.SandboxSnapshotResponse(snapshot_id=snapshot_id)
+
+    async def SandboxSnapshotGet(
+        self, request: api_pb2.SandboxSnapshotGetRequest, context
+    ) -> api_pb2.SandboxSnapshotGetResponse:
+        snap = self.s.sandbox_snapshots.get(request.snapshot_id)
+        if snap is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "snapshot not found")
+        return api_pb2.SandboxSnapshotGetResponse(
+            snapshot_id=snap.snapshot_id, created_at=snap.created_at
+        )
+
+    async def SandboxRestore(
+        self, request: api_pb2.SandboxRestoreRequest, context
+    ) -> api_pb2.SandboxRestoreResponse:
+        """Recreate a sandbox from a snapshot: same definition, workdir seeded
+        from the snapshot's filesystem tarball (via a snapshot-image)."""
+        from .state import ImageState
+
+        snap = self.s.sandbox_snapshots.get(request.snapshot_id)
+        if snap is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "snapshot not found")
+        definition = api_pb2.Sandbox()
+        definition.CopyFrom(snap.definition)
+        if snap.fs_blob_id:
+            image_id = make_id("im")
+            self.s.images[image_id] = ImageState(
+                image_id=image_id,
+                definition=api_pb2.Image(fs_snapshot_blob_id=snap.fs_blob_id),
+                built=True,
+            )
+            definition.image_id = image_id
+            definition.workdir = ""  # seeded copy, not the old sandbox's dir
+        if request.name:
+            definition.name = request.name
+        resp = await self.SandboxCreate(
+            api_pb2.SandboxCreateRequest(definition=definition), context
+        )
+        return api_pb2.SandboxRestoreResponse(sandbox_id=resp.sandbox_id)
+
+    async def SandboxGetTunnels(
+        self, request: api_pb2.SandboxGetTunnelsRequest, context
+    ) -> api_pb2.SandboxGetTunnelsResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        if not sb.definition.open_ports:
+            return api_pb2.SandboxGetTunnelsResponse(
+                result=api_pb2.GenericResult(
+                    status=api_pb2.GENERIC_STATUS_FAILURE,
+                    exception="sandbox has no open ports — pass unencrypted_ports/encrypted_ports to create()",
+                )
+            )
+        deadline = time.monotonic() + min(max(request.timeout, 0.0), 60.0)
+        while not sb.tunnels_reported and time.monotonic() < deadline:
+            if sb.result is not None:  # sandbox already exited
+                break
+            await asyncio.sleep(0.05)
+        if not sb.tunnels_reported:
+            # an empty list must NOT read as success — callers index by port
+            reason = (
+                f"sandbox exited before tunnels came up: {sb.result.exception or 'exit'}"
+                if sb.result is not None
+                else f"tunnels not reported within {request.timeout:.0f}s"
+            )
+            return api_pb2.SandboxGetTunnelsResponse(
+                result=api_pb2.GenericResult(
+                    status=api_pb2.GENERIC_STATUS_FAILURE, exception=reason
+                )
+            )
+        return api_pb2.SandboxGetTunnelsResponse(
+            tunnels=list(sb.tunnels),
+            result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+        )
+
+    async def TaskTunnelsUpdate(
+        self, request: api_pb2.TaskTunnelsUpdateRequest, context
+    ) -> api_pb2.TaskTunnelsUpdateResponse:
+        for sb in self.s.sandboxes.values():
+            if sb.task_id == request.task_id:
+                sb.tunnels = list(request.tunnels)
+                sb.tunnels_reported = True
+                break
+        return api_pb2.TaskTunnelsUpdateResponse()
+
+    async def TaskReady(self, request: api_pb2.TaskReadyRequest, context) -> api_pb2.TaskReadyResponse:
+        for sb in self.s.sandboxes.values():
+            if sb.task_id == request.task_id:
+                sb.ready = True
+                break
+        return api_pb2.TaskReadyResponse()
 
     async def WorkerPoll(self, request: api_pb2.WorkerPollRequest, context):
         worker = self.s.workers.get(request.worker_id)

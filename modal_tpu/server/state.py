@@ -96,6 +96,7 @@ class FunctionState:
     web_url: str = ""
     next_fire_at: float = 0.0  # schedule evaluation (server/cron.py)
     init_failures: int = 0  # consecutive container INIT_FAILUREs
+    placement_unsat_since: float = 0.0  # when placement first looked unsatisfiable
     bound_parent: Optional[str] = None  # parametrized variant parent id
     serialized_params: bytes = b""
     autoscaler_override: Optional[api_pb2.AutoscalerSettings] = None
@@ -156,6 +157,9 @@ class WorkerState:
     container_address: str = ""
     router_address: str = ""  # worker's TaskCommandRouter data plane
     slice_index: int = 0
+    region: str = ""  # placement labels (SchedulerPlacement matching)
+    zone: str = ""
+    spot: bool = False
     last_heartbeat: float = field(default_factory=time.time)
     # assignment channel consumed by the worker's WorkerPoll stream
     events: asyncio.Queue = field(default_factory=asyncio.Queue)
@@ -234,6 +238,21 @@ class SandboxState_:
     stdin_eof: bool = False
     stdin_last_index: int = 0  # dedups retried SandboxStdinWrite calls
     name: str = ""
+    tunnels: list = field(default_factory=list)  # TunnelData, worker-reported
+    tunnels_reported: bool = False
+    ready: bool = False  # readiness probe passed (or no probe configured)
+    workdir: str = ""  # worker-reported ACTUAL cwd (fs snapshots tar this)
+
+
+@dataclass
+class SandboxSnapshotState:
+    """A full sandbox snapshot: definition + filesystem tarball
+    (reference snapshot.py:17 _SandboxSnapshot)."""
+
+    snapshot_id: str
+    definition: api_pb2.Sandbox
+    fs_blob_id: str  # empty if the sandbox had no workdir content
+    created_at: float = field(default_factory=time.time)
 
 
 class ServerState:
@@ -266,6 +285,7 @@ class ServerState:
         self.images: dict[str, ImageState] = {}
         self.images_by_hash: dict[str, str] = {}
         self.sandboxes: dict[str, SandboxState_] = {}
+        self.sandbox_snapshots: dict[str, SandboxSnapshotState] = {}
         self.environments: dict[str, str] = {"main": ""}  # name -> web suffix
         self.tokens: dict[str, str] = {}  # token_id -> token_secret
         self.pending_token_flows: dict[str, tuple[str, str]] = {}

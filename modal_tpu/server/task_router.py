@@ -54,6 +54,11 @@ class ExecState:
     returncode: Optional[int] = None
     token: str = ""  # inherited from the task at start (task may unregister first)
     condition: asyncio.Condition = field(default_factory=asyncio.Condition)
+    pty_master: int = -1  # master fd when this exec runs under a PTY
+    # serializes PutInput bodies: a retried RPC racing a blocked pty write
+    # must re-check the acked offset AFTER the first write completes, or the
+    # dedupe-by-offset protocol breaks and bytes duplicate
+    stdin_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     def buf(self, fd: int) -> bytearray:
         return self.stdout if fd == 1 else self.stderr
@@ -138,20 +143,120 @@ class TaskRouterServicer:
             env = dict(task.env)
             env.update(dict(request.env))
             cwd = request.workdir or task.cwd
-            proc = await asyncio.create_subprocess_exec(
-                *request.args,
-                stdin=asyncio.subprocess.PIPE,
-                stdout=asyncio.subprocess.PIPE,
-                stderr=asyncio.subprocess.PIPE,
-                env=env,
-                cwd=cwd or None,
-            )
-            st = ExecState(exec_id=exec_id, task_id=request.task_id, proc=proc, token=task.token)
+            if request.pty:
+                st = await self._start_pty_exec(request, exec_id, env, cwd, task)
+            else:
+                proc = await asyncio.create_subprocess_exec(
+                    *request.args,
+                    stdin=asyncio.subprocess.PIPE,
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.PIPE,
+                    env=env,
+                    cwd=cwd or None,
+                )
+                st = ExecState(exec_id=exec_id, task_id=request.task_id, proc=proc, token=task.token)
             self._execs[exec_id] = st
-        asyncio.create_task(self._pump(st, proc.stdout, 1))
-        asyncio.create_task(self._pump(st, proc.stderr, 2))
+        if st.pty_master >= 0:
+            asyncio.create_task(self._pump_pty(st))
+        else:
+            asyncio.create_task(self._pump(st, st.proc.stdout, 1))
+            asyncio.create_task(self._pump(st, st.proc.stderr, 2))
         asyncio.create_task(self._reap(st, request.timeout_secs or 0))
         return api_pb2.TaskExecStartResponse(exec_id=exec_id)
+
+    async def _start_pty_exec(
+        self, request: api_pb2.TaskExecStartRequest, exec_id: str, env: dict, cwd: str, task: TaskContext
+    ) -> ExecState:
+        """Run the command under a real pseudo-terminal: the child gets the
+        PTY slave as its controlling tty on all three fds; stdout/stderr are
+        merged onto fd 1 as terminals do (reference _output/pty.py +
+        ContainerExec pty=true)."""
+        import fcntl
+        import pty as _pty
+        import struct
+        import termios
+
+        master, slave = _pty.openpty()
+        rows = request.pty_rows or 24
+        cols = request.pty_cols or 80
+        fcntl.ioctl(slave, termios.TIOCSWINSZ, struct.pack("HHHH", rows, cols, 0, 0))
+        env = dict(env)
+        env.setdefault("TERM", "xterm-256color")
+
+        def _become_session_leader() -> None:
+            # runs in the child after fd redirection: new session + claim
+            # the slave (now fd 0) as controlling tty, so job control works
+            os.setsid()
+            fcntl.ioctl(0, termios.TIOCSCTTY, 0)
+
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                *request.args,
+                stdin=slave,
+                stdout=slave,
+                stderr=slave,
+                env=env,
+                cwd=cwd or None,
+                preexec_fn=_become_session_leader,
+            )
+        finally:
+            os.close(slave)  # child holds its own copy
+        return ExecState(
+            exec_id=exec_id,
+            task_id=request.task_id,
+            proc=proc,
+            token=task.token,
+            pty_master=master,
+        )
+
+    async def _pump_pty(self, st: ExecState) -> None:
+        """Read the PTY master into the stdout buffer. EIO on a closed slave
+        is the PTY's EOF."""
+        loop = asyncio.get_running_loop()
+
+        def _read() -> bytes:
+            try:
+                return os.read(st.pty_master, 65536)
+            except OSError:
+                return b""
+
+        while True:
+            chunk = await loop.run_in_executor(None, _read)
+            async with st.condition:
+                if not chunk:
+                    st.stdout_eof = True
+                    st.stderr_eof = True
+                    st.condition.notify_all()
+                    try:
+                        os.close(st.pty_master)
+                    except OSError:
+                        pass
+                    st.pty_master = -1
+                    return
+                st.stdout.extend(chunk)
+                st.condition.notify_all()
+
+    async def TaskExecPtyResize(
+        self, request: api_pb2.TaskExecPtyResizeRequest, context
+    ) -> api_pb2.TaskExecPtyResizeResponse:
+        st = self._get_exec(request.exec_id)
+        if st is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
+        await self._authorize(context, st.token)
+        if st.pty_master >= 0 and request.rows and request.cols:
+            import fcntl
+            import struct
+            import termios
+
+            try:
+                fcntl.ioctl(
+                    st.pty_master,
+                    termios.TIOCSWINSZ,
+                    struct.pack("HHHH", request.rows, request.cols, 0, 0),
+                )
+            except OSError:
+                pass
+        return api_pb2.TaskExecPtyResizeResponse()
 
     async def _pump(self, st: ExecState, stream, fd: int) -> None:
         while True:
@@ -237,30 +342,51 @@ class TaskRouterServicer:
                 yield api_pb2.TaskExecStdioChunk(offset=offset, eof=True)
                 return
 
+    @staticmethod
+    def _write_all_fd(fd: int, data: bytes) -> None:
+        """Loop os.write to completion: partial writes (pty buffer full,
+        EINTR) must not drop bytes that the offset protocol will ack."""
+        view = memoryview(data)
+        while view:
+            n = os.write(fd, view)
+            view = view[n:]
+
     async def TaskExecPutInput(self, request: api_pb2.TaskExecPutInputRequest, context) -> api_pb2.TaskExecPutInputResponse:
         st = self._get_exec(request.exec_id)
         if st is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
         await self._authorize(context, st.token)
-        data = request.data
-        # offset-dedupe: drop the prefix we've already accepted
-        if request.offset < st.stdin_acked:
-            overlap = st.stdin_acked - request.offset
-            data = data[overlap:] if overlap < len(data) else b""
-        elif request.offset > st.stdin_acked:
-            await context.abort(
-                grpc.StatusCode.FAILED_PRECONDITION,
-                f"stdin gap: acked {st.stdin_acked}, got offset {request.offset}",
-            )
-        if data and st.proc.stdin is not None and not st.stdin_eof:
-            st.proc.stdin.write(data)
-            await st.proc.stdin.drain()
-            st.stdin_acked += len(data)
-        if request.eof and not st.stdin_eof:
-            st.stdin_eof = True
-            if st.proc.stdin is not None:
-                st.proc.stdin.close()
-        return api_pb2.TaskExecPutInputResponse(acked_offset=st.stdin_acked)
+        async with st.stdin_lock:  # serialize with any still-blocked write
+            data = request.data
+            # offset-dedupe: drop the prefix we've already accepted
+            if request.offset < st.stdin_acked:
+                overlap = st.stdin_acked - request.offset
+                data = data[overlap:] if overlap < len(data) else b""
+            elif request.offset > st.stdin_acked:
+                await context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"stdin gap: acked {st.stdin_acked}, got offset {request.offset}",
+                )
+            if data and not st.stdin_eof:
+                if st.pty_master >= 0:
+                    await asyncio.to_thread(self._write_all_fd, st.pty_master, bytes(data))
+                    st.stdin_acked += len(data)
+                elif st.proc.stdin is not None:
+                    st.proc.stdin.write(data)
+                    await st.proc.stdin.drain()
+                    st.stdin_acked += len(data)
+            if request.eof and not st.stdin_eof:
+                st.stdin_eof = True
+                if st.pty_master >= 0:
+                    # a terminal has no half-close; send EOT so
+                    # line-disciplined readers see end-of-input
+                    try:
+                        await asyncio.to_thread(os.write, st.pty_master, b"\x04")
+                    except OSError:
+                        pass
+                elif st.proc.stdin is not None:
+                    st.proc.stdin.close()
+            return api_pb2.TaskExecPutInputResponse(acked_offset=st.stdin_acked)
 
     async def TaskExecWait(self, request: api_pb2.TaskExecWaitRequest, context) -> api_pb2.TaskExecWaitResponse:
         st = self._get_exec(request.exec_id)

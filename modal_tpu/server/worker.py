@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import shutil
 import signal
 import sys
 import tempfile
@@ -65,11 +66,19 @@ class WorkerAgent:
         num_chips: Optional[int] = None,
         tpu_type: Optional[str] = None,
         state_dir: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        spot: Optional[bool] = None,
     ):
         self.server_url = server_url
         self.worker_id = worker_id or ""
         self._override_chips = num_chips
         self._override_type = tpu_type
+        # placement labels: explicit args, else env (MODAL_TPU_WORKER_REGION
+        # / _ZONE / _SPOT — how a fleet operator tags hosts)
+        self.region = region if region is not None else config.get("worker_region")
+        self.zone = zone if zone is not None else config.get("worker_zone")
+        self.spot = spot if spot is not None else bool(config.get("worker_spot"))
         self.state_dir = state_dir or config["state_dir"]
         self._procs: dict[str, asyncio.subprocess.Process] = {}
         self._image_builder = None  # lazy ImageBuilder (created on first use)
@@ -119,6 +128,9 @@ class WorkerAgent:
                 memory_mb=16384,
                 container_address="127.0.0.1",
                 router_address=self.router_address,
+                region=self.region or "",
+                zone=self.zone or "",
+                spot=self.spot,
             ),
             max_retries=10,
             max_delay=2.0,
@@ -305,14 +317,48 @@ class WorkerAgent:
         ok, built_image = await self._prepare_image(task_id, d.image_id, env)
         if not ok:
             return
-        sandbox_cwd = d.workdir or (built_image.workdir if built_image else None) or None
+        # Dedicated per-task workdir (unless explicit): makes fs snapshots
+        # capture exactly this sandbox's files, and gives snapshot-images a
+        # place to seed their content into
+        from .fs_snapshot import sandbox_workdir
+
+        sandbox_cwd = d.workdir or (built_image.workdir if built_image else "") or ""
+        if not sandbox_cwd:
+            sandbox_cwd = sandbox_workdir(self.state_dir, task_id, "")
+            os.makedirs(sandbox_cwd, exist_ok=True)
+        if built_image is not None and built_image.fs_seed_dir:
+            # snapshot-image: the sandbox starts on a COPY of the snapshot
+            # content (each restored sandbox gets its own mutable tree)
+            try:
+                await asyncio.to_thread(
+                    shutil.copytree,
+                    built_image.fs_seed_dir,
+                    sandbox_cwd,
+                    dirs_exist_ok=True,
+                    ignore=shutil.ignore_patterns(".complete"),
+                )
+            except Exception as exc:
+                await retry_transient_errors(
+                    self._stub.TaskResult,
+                    api_pb2.TaskResultRequest(
+                        task_id=task_id,
+                        result=api_pb2.GenericResult(
+                            status=api_pb2.GENERIC_STATUS_INIT_FAILURE,
+                            exception=f"snapshot restore failed: {exc}",
+                        ),
+                    ),
+                    max_retries=2,
+                )
+                return
         # secrets are resolved control-plane-side into the assignment env
         env.update(dict(assignment.container_arguments.env))
         if assignment.tpu_chip_ids:
             env["TPU_VISIBLE_DEVICES"] = ",".join(str(c) for c in assignment.tpu_chip_ids)
         try:
             await retry_transient_errors(
-                self._stub.ContainerHello, api_pb2.ContainerHelloRequest(task_id=task_id), max_retries=3
+                self._stub.ContainerHello,
+                api_pb2.ContainerHelloRequest(task_id=task_id, sandbox_workdir=sandbox_cwd),
+                max_retries=3,
             )
             proc = await asyncio.create_subprocess_exec(
                 *d.entrypoint_args,
@@ -415,10 +461,105 @@ class WorkerAgent:
                 if not data:
                     return
 
+        tunnel_servers: list[asyncio.AbstractServer] = []
+
+        async def _open_tunnels() -> None:
+            """One TCP proxy listener per open port: client connects to the
+            tunnel port, bytes are piped to the sandbox's own port. This IS
+            the data plane (not a stub) — production would front the same
+            proxy with TLS (reference _tunnel.py / sandbox.py:1930)."""
+            tunnels = []
+            for spec in d.open_ports:
+                target_port = spec.port
+
+                def make_handler(tp):
+                    async def handle(reader, writer):
+                        try:
+                            up_r, up_w = await asyncio.open_connection("127.0.0.1", tp)
+                        except OSError:
+                            writer.close()
+                            return
+
+                        async def pipe(src, dst):
+                            try:
+                                while True:
+                                    data = await src.read(64 * 1024)
+                                    if not data:
+                                        break
+                                    dst.write(data)
+                                    await dst.drain()
+                            except Exception:  # noqa: BLE001 — peer reset
+                                pass
+                            finally:
+                                try:
+                                    dst.close()
+                                except Exception:  # noqa: BLE001
+                                    pass
+
+                        await asyncio.gather(pipe(reader, up_w), pipe(up_r, writer))
+
+                    return handle
+
+                server = await asyncio.start_server(make_handler(target_port), "127.0.0.1", 0)
+                tunnel_servers.append(server)
+                port = server.sockets[0].getsockname()[1]
+                tunnels.append(
+                    api_pb2.TunnelData(
+                        container_port=target_port,
+                        host="127.0.0.1",
+                        port=port,
+                        unencrypted=spec.unencrypted,
+                    )
+                )
+            await retry_transient_errors(
+                self._stub.TaskTunnelsUpdate,
+                api_pb2.TaskTunnelsUpdateRequest(task_id=task_id, tunnels=tunnels),
+                max_retries=3,
+            )
+
+        async def _readiness_probe() -> None:
+            probe = d.readiness_probe
+            if not probe.exec_command:
+                return
+            period = probe.period_secs or 1.0
+            deadline = time.monotonic() + (probe.timeout_secs or d.timeout_secs or 600)
+            while proc.returncode is None and time.monotonic() < deadline:
+                try:
+                    p = await asyncio.create_subprocess_exec(
+                        *probe.exec_command,
+                        cwd=sandbox_cwd,
+                        env=env,
+                        stdout=asyncio.subprocess.DEVNULL,
+                        stderr=asyncio.subprocess.DEVNULL,
+                    )
+                    rc = await asyncio.wait_for(p.wait(), timeout=max(period * 5, 10.0))
+                except (asyncio.TimeoutError, OSError):
+                    rc = -1
+                if rc == 0:
+                    await retry_transient_errors(
+                        self._stub.TaskReady, api_pb2.TaskReadyRequest(task_id=task_id), max_retries=3
+                    )
+                    return
+                await asyncio.sleep(period)
+
         stdin_task = asyncio.create_task(_pump_stdin())
         hb_task = asyncio.create_task(_heartbeat())
         out_task = asyncio.create_task(_pump_out(proc.stdout, 1))
         err_task = asyncio.create_task(_pump_out(proc.stderr, 2))
+        aux_tasks = []
+        if d.open_ports:
+            aux_tasks.append(asyncio.create_task(_open_tunnels()))
+        if d.readiness_probe.exec_command:
+            aux_tasks.append(asyncio.create_task(_readiness_probe()))
+        else:
+            # no probe configured: the sandbox is "ready" once running
+            aux_tasks.append(
+                asyncio.create_task(
+                    retry_transient_errors(
+                        self._stub.TaskReady, api_pb2.TaskReadyRequest(task_id=task_id), max_retries=3
+                    )
+                )
+            )
         timeout_s = d.timeout_secs or 600
         try:
             returncode = await asyncio.wait_for(proc.wait(), timeout=timeout_s)
@@ -444,7 +585,11 @@ class WorkerAgent:
             self.router.unregister_task(task_id)
             stdin_task.cancel()
             hb_task.cancel()
-            await asyncio.gather(stdin_task, hb_task, return_exceptions=True)
+            for t in aux_tasks:
+                t.cancel()
+            for server in tunnel_servers:
+                server.close()
+            await asyncio.gather(stdin_task, hb_task, *aux_tasks, return_exceptions=True)
             await asyncio.gather(out_task, err_task, return_exceptions=True)
         result = api_pb2.GenericResult(status=status, exception=exception)
         result.data = str(returncode).encode()

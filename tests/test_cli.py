@@ -125,3 +125,68 @@ def test_cli_app_imports(cli_runner, app_script, supervisor, monkeypatch):
     monkeypatch.setenv("MODAL_TPU_STATE_DIR", supervisor.state_dir)
     out = cli_runner("app", "imports", task_id)
     assert "ms" in out and "modal_tpu" in out
+
+
+def test_cli_shell_interactive_pty(supervisor):
+    """Full interactive `modal-tpu shell` driven through a REAL local
+    pseudo-terminal: raw-mode passthrough, shell prompt, command round-trip,
+    clean exit (the reference's cli/shell.py + _output/pty.py path)."""
+    import errno
+    import os
+    import pty
+    import select
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ)
+    env["MODAL_TPU_SERVER_URL"] = f"grpc://127.0.0.1:{supervisor.port}"
+    env["SHELL"] = "/bin/sh"  # predictable prompt-less shell
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    master, slave = pty.openpty()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "modal_tpu.cli", "shell"],
+        stdin=slave,
+        stdout=slave,
+        stderr=slave,
+        env=env,
+        close_fds=True,
+    )
+    os.close(slave)
+
+    buf = b""
+
+    def read_until(needle: bytes, timeout: float) -> bytes:
+        nonlocal buf
+        deadline = time.monotonic() + timeout
+        while needle not in buf and time.monotonic() < deadline:
+            r, _, _ = select.select([master], [], [], 0.5)
+            if master in r:
+                try:
+                    data = os.read(master, 4096)
+                except OSError as exc:
+                    if exc.errno == errno.EIO:  # pty closed = EOF
+                        break
+                    raise
+                if not data:
+                    break
+                buf += data
+        return buf
+
+    try:
+        # wait for the remote shell's prompt BEFORE typing: interactive
+        # shells flush queued tty input while initializing the terminal
+        prompt = b"# " if os.geteuid() == 0 else b"$ "
+        read_until(prompt, 60.0)
+        os.write(master, b"echo interactive-$((6*7))\n")
+        out = read_until(b"interactive-42", 30.0)
+        assert b"interactive-42" in out, out[-500:]
+        os.write(master, b"exit\n")
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        os.close(master)

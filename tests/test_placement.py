@@ -1,0 +1,112 @@
+"""Scheduler placement: region/zone labels are honored, impossible
+placements fail loudly (reference scheduler_placement.py:7; the matching
+itself is our scheduler's, the reference's is closed)."""
+
+import pytest
+
+from tests.conftest import _make_fault_injecting_servicer
+
+
+@pytest.fixture
+def labeled_supervisor(tmp_path, monkeypatch):
+    """Control plane with TWO labeled workers: us-east1 (on-demand) and
+    eu-west4 (spot)."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.server.supervisor import LocalSupervisor
+    from modal_tpu.server.worker import WorkerAgent
+
+    monkeypatch.setenv("MODAL_TPU_STATE_DIR", str(tmp_path / "state"))
+    sup = LocalSupervisor(
+        num_workers=0,
+        state_dir=str(tmp_path / "state"),
+        servicer_cls=_make_fault_injecting_servicer(),
+    )
+    synchronizer.run(sup.start())
+    workers = []
+    for region, zone, spot in [("us-east1", "us-east1-b", False), ("eu-west4", "eu-west4-a", True)]:
+        w = WorkerAgent(
+            sup.server_url,
+            num_chips=8,
+            tpu_type="local-sim",
+            state_dir=str(tmp_path / "state"),
+            region=region,
+            zone=zone,
+            spot=spot,
+        )
+        synchronizer.run(w.start())
+        workers.append(w)
+    monkeypatch.setenv("MODAL_TPU_SERVER_URL", sup.server_url)
+    _Client.set_env_client(None)
+    try:
+        yield sup, workers
+    finally:
+        env_client = _Client._client_from_env
+        if env_client is not None and not env_client._closed:
+            env_client._close()
+        _Client.set_env_client(None)
+        for w in workers:
+            synchronizer.run(w.stop())
+        synchronizer.run(sup.stop())
+
+
+def _worker_id_by_region(sup, region):
+    for w in sup.state.workers.values():
+        if w.region == region:
+            return w.worker_id
+    raise AssertionError(f"no worker in {region}")
+
+
+def test_placement_region_honored(labeled_supervisor):
+    sup, _ = labeled_supervisor
+    import modal_tpu
+
+    app = modal_tpu.App("placement")
+
+    @app.function(region="eu-west4", serialized=True)
+    def where(x):
+        return x + 1
+
+    with app.run():
+        assert where.remote(1) == 2
+    eu = _worker_id_by_region(sup, "eu-west4")
+    ran_on = {t.worker_id for t in sup.state.tasks.values() if t.worker_id}
+    assert ran_on == {eu}
+
+
+def test_placement_zone_honored(labeled_supervisor):
+    sup, _ = labeled_supervisor
+    import modal_tpu
+
+    app = modal_tpu.App("placement-zone")
+
+    @app.function(
+        scheduler_placement=modal_tpu.SchedulerPlacement(zone="us-east1-b"), serialized=True
+    )
+    def where(x):
+        return x * 10
+
+    with app.run():
+        assert where.remote(4) == 40
+    east = _worker_id_by_region(sup, "us-east1")
+    ran_on = {t.worker_id for t in sup.state.tasks.values() if t.worker_id}
+    assert ran_on == {east}
+
+
+def test_placement_unsatisfiable_fails_loudly(labeled_supervisor):
+    """A region no worker carries must error the call promptly, not hang."""
+    import time
+
+    import modal_tpu
+
+    app = modal_tpu.App("placement-bad")
+
+    @app.function(region="mars-north1", serialized=True, timeout=30)
+    def unreachable(x):
+        return x
+
+    t0 = time.monotonic()
+    with app.run():
+        with pytest.raises(Exception, match="unsatisfiable placement"):
+            unreachable.remote(1)
+    assert time.monotonic() - t0 < 20  # failed fast, didn't ride the timeout

@@ -208,3 +208,60 @@ def test_router_rejects_missing_or_bad_token(supervisor):
         assert code == grpc.StatusCode.PERMISSION_DENIED
     finally:
         sb.terminate()
+
+
+def test_exec_pty_isatty(supervisor):
+    """pty=True gives the exec'd process a real controlling terminal on all
+    three fds (reference ContainerExec pty)."""
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create("sleep", "30")
+    p = sb.exec(
+        "python", "-c",
+        "import sys, os; print(sys.stdin.isatty(), sys.stdout.isatty(), sys.stderr.isatty())",
+        pty=True,
+    )
+    assert p.wait() == 0
+    assert "True True True" in p.stdout.read()
+    sb.terminate()
+
+
+def test_exec_pty_window_size_and_resize(supervisor):
+    """The requested window size is visible to the child; pty_resize updates
+    it live (SIGWINCH forwarding path)."""
+    import time as _time
+
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create("sleep", "30")
+    code = (
+        "import os, sys, time\n"
+        "print(os.get_terminal_size().lines, os.get_terminal_size().columns, flush=True)\n"
+        "time.sleep(1.2)\n"
+        "print(os.get_terminal_size().lines, os.get_terminal_size().columns, flush=True)\n"
+    )
+    p = sb.exec("python", "-u", "-c", code, pty=True, pty_rows=37, pty_cols=111)
+    _time.sleep(0.6)
+    p.pty_resize(50, 140)
+    assert p.wait() == 0
+    out = p.stdout.read()
+    assert "37 111" in out
+    assert "50 140" in out
+    sb.terminate()
+
+
+def test_exec_pty_interactive_stdin(supervisor):
+    """An interactive REPL-style session: write through the PTY, see echoed
+    output (terminals echo input), drive a command to completion."""
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create("sleep", "30")
+    p = sb.exec("sh", "-i", pty=True, text=False)
+    p.stdin.write(b"echo marker-$((40+2))\n")
+    p.stdin.drain()
+    p.stdin.write(b"exit\n")
+    p.stdin.drain()
+    p.wait()
+    out = p.stdout.read().decode(errors="replace")
+    assert "marker-42" in out
+    sb.terminate()
