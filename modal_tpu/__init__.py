@@ -93,6 +93,7 @@ __all__ = [
     "enable_output",
     "enter",
     "exit",
+    "forward",
     "get_cluster_info",
     "get_fabric_peers",
     "is_local",
@@ -133,6 +134,10 @@ def __getattr__(name: str):
         from .sandbox import Tunnel
 
         return Tunnel
+    if name == "forward":
+        from .tunnel import forward
+
+        return forward
     if name == "SandboxFS":
         from .sandbox_fs import SandboxFS
 

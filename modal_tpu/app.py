@@ -185,6 +185,7 @@ class _App:
         is_generator: Optional[bool] = None,
         name: Optional[str] = None,
         i6pn: bool = False,
+        runtime_debug: bool = False,
         experimental_options: Optional[dict[str, str]] = None,
     ) -> Callable[[Union[Callable, _PartialFunction]], _Function]:
         """Register a function with this app (reference app.py:778).
@@ -239,7 +240,14 @@ class _App:
                 cloud=cloud,
                 enable_memory_snapshot=enable_memory_snapshot,
                 restrict_output=restrict_output,
-                experimental_options=dict(experimental_options or {}),
+                experimental_options={
+                    # runtime_debug rides experimental_options like the
+                    # reference's perf knobs (api.proto:1863,1944): each
+                    # input is wrapped in jax.profiler.trace and the xplane
+                    # lands in the task's state dir (`app profile` CLI)
+                    **({"runtime_debug": "1"} if runtime_debug else {}),
+                    **dict(experimental_options or {}),
+                },
             )
             if is_generator is None:
                 is_generator = params.is_generator

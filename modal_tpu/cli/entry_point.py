@@ -255,6 +255,29 @@ def app_list(env: str) -> None:
         )
 
 
+@app_group.command("profile")
+@click.argument("app_id")
+def app_profile(app_id: str) -> None:
+    """List jax profiler traces recorded by runtime_debug functions of an
+    app (xplane dumps, viewable with tensorboard/xprof)."""
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(
+            c.stub.AppListProfiles, api_pb2.AppListProfilesRequest(app_id=app_id)
+        )
+
+    resp = synchronizer.run(go(client))
+    if not resp.profiles:
+        click.echo("no profiles recorded (run the function with runtime_debug=True)")
+        return
+    for p in resp.profiles:
+        click.echo(f"{p.task_id}  {p.num_traces:3d} traces  {p.size_bytes / 1e6:8.2f} MB  {p.path}")
+
+
 @app_group.command("stop")
 @click.argument("app_id")
 def app_stop(app_id: str) -> None:
@@ -276,8 +299,11 @@ def app_stop(app_id: str) -> None:
 @click.argument("app_id")
 @click.option("--follow", "-f", is_flag=True, help="Keep following after the backfill.")
 @click.option("--task", "task_id", default="", help="Filter to one container.")
-def app_logs(app_id: str, follow: bool, task_id: str) -> None:
-    """Print an app's FULL log history (backfill), optionally following."""
+@click.option("--since", type=float, default=0.0, help="Unix timestamp: only entries at/after this.")
+@click.option("--until", type=float, default=0.0, help="Unix timestamp: only entries before this.")
+def app_logs(app_id: str, follow: bool, task_id: str, since: float, until: float) -> None:
+    """Print an app's log history (backfill), optionally following. With a
+    --since/--until window the bucketed fetch pages only dense ranges."""
     from .._logs import print_app_logs
 
     client = _client()
@@ -288,6 +314,8 @@ def app_logs(app_id: str, follow: bool, task_id: str) -> None:
                 app_id,
                 follow=follow,
                 task_id=task_id,
+                min_timestamp=since,
+                max_timestamp=until,
             )
         )
     except KeyboardInterrupt:

@@ -1,0 +1,3 @@
+"""Experimental surfaces (reference py/modal/experimental/)."""
+
+from .flash import flash_forward, flash_get_pool, FlashAutoscaler  # noqa: F401

@@ -1,0 +1,182 @@
+"""Flash: self-registering service pool + metrics-driven autoscaler.
+
+Reference: py/modal/experimental/flash.py — `_FlashManager` (flash.py:31)
+tunnels the container's port, registers it in a shared pool, heartbeats, and
+drains on exit; `_FlashPrometheusAutoscaler` (flash.py:280) scrapes each
+member's metrics endpoint and drives the function's target container count.
+
+The TPU build keeps the same contract on its own primitives: the pool is a
+named Dict (member key -> {host, port, ts}), the tunnel is the control
+plane's TCP proxy (tunnel.py), and scaling writes AutoscalerSettings through
+FunctionUpdateSchedulingParams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional
+
+from .._utils.async_utils import TaskContext, synchronize_api
+from .._utils.grpc_utils import retry_transient_errors
+from ..client import _Client
+from ..config import config, logger
+from ..dict import _Dict
+from ..exception import InvalidError
+from ..proto import api_pb2
+from ..tunnel import _forward
+
+HEARTBEAT_S = 5.0
+STALE_S = 30.0  # members older than this are dead (crashed before drain)
+
+
+def _pool_name(function_name: str) -> str:
+    return f"flash-pool-{function_name}"
+
+
+class _FlashManager:
+    """In-container: expose `port` through a tunnel and keep this container
+    registered in the pool until drained (reference flash.py:31)."""
+
+    def __init__(self, function_name: str, port: int):
+        self.function_name = function_name
+        self.port = port
+        self.task_id = config.get("task_id")
+        if not self.task_id:
+            raise InvalidError("flash_forward only works inside a running container")
+        self._fwd = _forward(port, unencrypted=True)
+        self._pool: Optional[_Dict] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self.tunnel = None
+
+    async def start(self):
+        self.tunnel = await self._fwd.__aenter__()
+        self._pool = await _Dict.lookup(_pool_name(self.function_name), create_if_missing=True)
+        await self._register()
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        return self
+
+    async def _register(self) -> None:
+        await self._pool.put(
+            self.task_id,
+            {"host": self.tunnel.host, "port": self.tunnel.port, "ts": time.time()},
+        )
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(HEARTBEAT_S)
+            try:
+                await self._register()
+            except Exception as exc:  # noqa: BLE001 — keep heartbeating
+                logger.debug(f"flash heartbeat failed: {exc}")
+
+    async def drain(self) -> None:
+        """Deregister BEFORE shutdown so no new requests route here
+        (reference flash.py stop/drain ordering)."""
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        try:
+            await self._pool.pop(self.task_id)
+        except Exception:  # noqa: BLE001
+            pass
+        await self._fwd.__aexit__(None, None, None)
+
+
+class _flash_forward:
+    """`async with flash_forward(name, port) as mgr:` — mgr.tunnel has the
+    public address; the pool lists every live member."""
+
+    def __init__(self, function_name: str, port: int):
+        self._mgr = _FlashManager(function_name, port)
+
+    async def __aenter__(self) -> _FlashManager:
+        return await self._mgr.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self._mgr.drain()
+
+
+async def _flash_get_pool(function_name: str, client: Optional[_Client] = None) -> dict:
+    """Live pool members: task_id -> {host, port}. Stale entries (crashed
+    containers that never drained) are filtered out."""
+    pool = await _Dict.lookup(_pool_name(function_name), create_if_missing=True, client=client)
+    now = time.time()
+    members = {}
+    async for key, value in pool.items():
+        if now - value.get("ts", 0) <= STALE_S:
+            members[key] = {"host": value["host"], "port": value["port"]}
+    return members
+
+
+class _FlashAutoscaler:
+    """Metrics-driven autoscaler (reference _FlashPrometheusAutoscaler,
+    flash.py:280): poll a per-member metric, average it, steer the
+    function's container count toward `target_value` per member."""
+
+    def __init__(
+        self,
+        function,  # hydrated Function handle
+        function_name: str,
+        get_metric: Callable,  # (host, port) -> float (e.g. scrape inflight)
+        target_value: float,
+        min_containers: int = 1,
+        max_containers: int = 8,
+        interval_s: float = 5.0,
+    ):
+        self.function = function
+        self.function_name = function_name
+        self.get_metric = get_metric
+        self.target_value = target_value
+        self.min_containers = min_containers
+        self.max_containers = max_containers
+        self.interval_s = interval_s
+        self.last_decision: Optional[int] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def step(self) -> int:
+        """One scrape → scale decision → FunctionUpdateSchedulingParams."""
+        members = await _flash_get_pool(self.function_name)
+        total = 0.0
+        for member in members.values():
+            try:
+                value = self.get_metric(member["host"], member["port"])
+                if asyncio.iscoroutine(value):
+                    value = await value
+                total += float(value)
+            except Exception as exc:  # noqa: BLE001 — skip a dead member
+                logger.debug(f"flash metric scrape failed: {exc}")
+        # containers needed so each member carries ~target_value of load
+        desired = max(1, round(total / max(self.target_value, 1e-9))) if total > 0 else 0
+        desired = min(max(desired, self.min_containers), self.max_containers)
+        client = await _Client.from_env()
+        await retry_transient_errors(
+            client.stub.FunctionUpdateSchedulingParams,
+            api_pb2.FunctionUpdateSchedulingParamsRequest(
+                function_id=self.function.object_id,
+                settings=api_pb2.AutoscalerSettings(
+                    min_containers=desired, max_containers=self.max_containers
+                ),
+            ),
+        )
+        self.last_decision = desired
+        return desired
+
+    async def start(self) -> None:
+        async def loop():
+            while True:
+                try:
+                    await self.step()
+                except Exception as exc:  # noqa: BLE001
+                    logger.debug(f"flash autoscaler step failed: {exc}")
+                await asyncio.sleep(self.interval_s)
+
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+
+flash_forward = synchronize_api(_flash_forward)
+flash_get_pool = synchronize_api(_flash_get_pool)
+FlashAutoscaler = synchronize_api(_FlashAutoscaler)

@@ -38,6 +38,7 @@ def pipeline_loss(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = "pipe",
+    attn_impl=None,  # e.g. ring attention over a seq axis (nested shard_map)
 ) -> jax.Array:
     """Next-token loss with the layer stack pipelined over `axis_name`.
 
@@ -75,7 +76,9 @@ def pipeline_loss(
 
     def stage_block(layers_local, act):
         def body(x_carry, layer):
-            out, _, _aux = _layer_forward(cfg, x_carry, layer, positions, None, inv_freq, None, None, None)
+            out, _, _aux = _layer_forward(
+                cfg, x_carry, layer, positions, None, inv_freq, None, None, attn_impl
+            )
             return out, None
 
         act, _ = lax.scan(body, act, layers_local)

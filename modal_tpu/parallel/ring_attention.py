@@ -108,9 +108,15 @@ def ring_attention(
     attention is embarrassingly parallel over heads, so no cross-head
     collectives are needed."""
     spec = P(batch_axes, axis_name, head_axis, None)
+    # nested-shard_map support: when tracing INSIDE another shard_map (e.g.
+    # ring attention per pipeline stage), the inner shard_map must be built
+    # against the context's abstract mesh (some axes already Manual), not
+    # the concrete mesh it was created with
+    ctx_mesh = jax.sharding.get_abstract_mesh()
+    use_mesh = ctx_mesh if ctx_mesh is not None and ctx_mesh.shape else mesh
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name),
-        mesh=mesh,
+        mesh=use_mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,

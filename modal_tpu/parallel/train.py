@@ -93,7 +93,9 @@ def make_train_step(
         num_micro = tc.resolve_num_microbatches(n_stages)
 
         def compute_loss(params, tokens):
-            return pipeline_loss(params, cfg, tokens, pipeline_mesh, num_micro)
+            return pipeline_loss(
+                params, cfg, tokens, pipeline_mesh, num_micro, attn_impl=attn_impl
+            )
     else:
         def compute_loss(params, tokens):
             return loss_fn(params, cfg, tokens, tc.remat, attn_impl)
@@ -125,8 +127,9 @@ def create_sharded_state(
     p_shardings = param_shardings(mesh, cfg, pipe=pipe)
     attn_impl = None
     if mesh.shape.get("seq", 1) > 1:
-        if pipe:
-            raise NotImplementedError("pipe + seq (ring attention inside pipeline) not supported yet")
+        # ring attention (context parallelism) — composes with the pipeline:
+        # the pipe shard_map manualizes only its own axis, so the nested ring
+        # shard_map over seq stays legal inside each stage
         from .ring_attention import make_ring_attention_impl
 
         attn_impl = make_ring_attention_impl(mesh, "seq", batch_axes=("data", "fsdp"))
@@ -165,15 +168,14 @@ def train_demo(
         state, step_fn, token_sharding = create_sharded_state(mesh, cfg, tc)
         n_batch = mesh.shape["data"] * mesh.shape["fsdp"] * per_device_batch
         if mesh.shape.get("pipe", 1) > 1:
-            # round UP to a batch divisible by both the microbatch count and
-            # the (data, fsdp) token sharding — never silently shrink the
-            # requested batch
-            import math
-
+            # round UP so each MICROBATCH still divides the (data, fsdp)
+            # token sharding — ring attention inside a stage shards the
+            # microbatch's batch dim over those axes — and never silently
+            # shrink the requested batch
             num_micro = tc.resolve_num_microbatches(mesh.shape["pipe"])
             group = mesh.shape["data"] * mesh.shape["fsdp"]
-            lcm = group * num_micro // math.gcd(group, num_micro)
-            n_batch = (n_batch + lcm - 1) // lcm * lcm
+            unit = group * num_micro
+            n_batch = (n_batch + unit - 1) // unit * unit
         tokens = jax.device_put(
             jax.random.randint(jax.random.PRNGKey(1), (n_batch, seq_len), 0, cfg.vocab_size, jnp.int32),
             token_sharding,

@@ -69,6 +69,8 @@ _RPC_NAMES = [
     "AppDeploymentHistory",
     "AppGetLogs",
     "AppFetchLogs",
+    "AppCountLogs",
+    "AppListProfiles",
     # Blob store
     "BlobCreate",
     "BlobGet",
@@ -164,6 +166,8 @@ _RPC_NAMES = [
     "SandboxGetTunnels",
     "TaskTunnelsUpdate",
     "TaskReady",
+    "TunnelStart",
+    "TunnelStop",
     "ContainerExec",
     "ContainerExecGetOutput",
     "ContainerExecWait",

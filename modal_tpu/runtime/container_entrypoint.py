@@ -81,6 +81,41 @@ async def run_lifecycle_hooks(hooks: list, name: str) -> None:
             await res
 
 
+# set by main_async when the function carries runtime_debug: every input is
+# wrapped in jax.profiler.trace, xplane dumps land here (SURVEY §5 tracing;
+# reference api.proto:1863 runtime_perf_record)
+PROFILE_DIR: Optional[str] = None
+
+
+_profile_active = False  # jax.profiler.trace is not reentrant
+
+
+def _maybe_profile():
+    import contextlib
+
+    if PROFILE_DIR is None:
+        return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def _guarded():
+        # concurrent inputs: only one trace at a time; the rest run
+        # unprofiled instead of crashing on the profiler's reentrancy check
+        global _profile_active
+        if _profile_active:
+            yield
+            return
+        import jax
+
+        _profile_active = True
+        try:
+            with jax.profiler.trace(PROFILE_DIR):
+                yield
+        finally:
+            _profile_active = False
+
+    return _guarded()
+
+
 async def call_user_code(service: Service, ctx: IOContext, io: ContainerIOManager) -> list[api_pb2.GenericResult]:
     """Run one IOContext (single input or batch) to results (reference
     call_function, _container_entrypoint.py:114)."""
@@ -111,10 +146,11 @@ async def call_user_code(service: Service, ctx: IOContext, io: ContainerIOManage
             )
             return [result]
         else:
-            if inspect.iscoroutinefunction(callable_):
-                value = await callable_(*args, **kwargs)
-            else:
-                value = await asyncio.to_thread(callable_, *args, **kwargs)
+            with _maybe_profile():
+                if inspect.iscoroutinefunction(callable_):
+                    value = await callable_(*args, **kwargs)
+                else:
+                    value = await asyncio.to_thread(callable_, *args, **kwargs)
             io.note_call_time(time.monotonic() - t0)
             if ctx.is_batch:
                 if not isinstance(value, (list, tuple)) or len(value) != len(ctx.input_ids):
@@ -229,6 +265,12 @@ async def main_async() -> int:
         max_retries=5,
     )
 
+    if function_def.experimental_options.get("runtime_debug"):
+        global PROFILE_DIR
+        task_dir = os.environ.get("MODAL_TPU_TASK_DIR", "")
+        PROFILE_DIR = os.path.join(task_dir or ".", "profile")
+        os.makedirs(PROFILE_DIR, exist_ok=True)
+
     io = ContainerIOManager(client, task_id, function_def)
     io._function_id = container_args.function_id
     heartbeat_task = asyncio.create_task(io.heartbeat_loop(), name="heartbeat")
@@ -236,11 +278,19 @@ async def main_async() -> int:
     exit_status = api_pb2.GENERIC_STATUS_SUCCESS
     exit_exception = ""
     service: Optional[Service] = None
+    bucket_states: list = []
     try:
         # Gang functions: rendezvous + jax.distributed BEFORE user imports
         # (reference hook point: _container_entrypoint.py:451-457).
         if function_def.group_size > 1 or container_args.world_size > 1:
             await initialize_clustered(container_args, client)
+
+        # cloud bucket mounts: sync bucket prefixes into their mount paths
+        # BEFORE user code (weights may load from them); written back on exit
+        if function_def.cloud_bucket_mounts:
+            from .bucket_mounts import sync_bucket_mounts
+
+            bucket_states = await sync_bucket_mounts(dict(function_def.cloud_bucket_mounts))
 
         # import user code + instantiate service
         bound_params = None
@@ -300,6 +350,16 @@ async def main_async() -> int:
         if service is not None:
             try:
                 await run_lifecycle_hooks(service.exit_hooks, "exit")
+            except Exception:
+                traceback.print_exc()
+        # bucket mounts: upload new/changed files (the "commit" half of the
+        # sync-down/write-back mount emulation). Synchronous: awaits in a
+        # cancelled task's finally were observed hanging to SIGKILL.
+        if bucket_states:
+            from .bucket_mounts import writeback_bucket_mounts_sync
+
+            try:
+                writeback_bucket_mounts_sync(bucket_states)
             except Exception:
                 traceback.print_exc()
         # volume auto-commit on shutdown (reference

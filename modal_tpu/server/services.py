@@ -738,6 +738,35 @@ class ModalTPUServicer:
     # Container data plane
     # ------------------------------------------------------------------
 
+    async def AppListProfiles(
+        self, request: api_pb2.AppListProfilesRequest, context
+    ) -> api_pb2.AppListProfilesResponse:
+        """Enumerate jax profiler dumps recorded by runtime_debug tasks of
+        this app (the dirs the container entrypoint's _maybe_profile wrote)."""
+        out = []
+        for task in self.s.tasks.values():
+            if request.app_id and task.app_id != request.app_id:
+                continue
+            profile_dir = os.path.join(self.s.state_dir, "tasks", task.task_id, "profile")
+            if not os.path.isdir(profile_dir):
+                continue
+            size = 0
+            traces = 0
+            for root, _dirs, files in os.walk(profile_dir):
+                for f in files:
+                    try:
+                        size += os.path.getsize(os.path.join(root, f))
+                    except OSError:
+                        pass
+                    if f.endswith(".xplane.pb"):
+                        traces += 1
+            out.append(
+                api_pb2.ProfileEntry(
+                    task_id=task.task_id, path=profile_dir, size_bytes=size, num_traces=traces
+                )
+            )
+        return api_pb2.AppListProfilesResponse(profiles=out)
+
     async def ContainerHello(self, request, context) -> api_pb2.ContainerHelloResponse:
         task = self.s.tasks.get(request.task_id)
         if task is None:
@@ -949,6 +978,39 @@ class ModalTPUServicer:
                     app.log_condition.notify_all()
         return api_pb2.ContainerLogResponse()
 
+    async def AppCountLogs(self, request: api_pb2.AppCountLogsRequest, context) -> api_pb2.AppCountLogsResponse:
+        """Histogram of stored log entries over [min_timestamp, max_timestamp)
+        (reference _logs.py:114-310: the client refines dense buckets into
+        fetch intervals instead of paging the whole history)."""
+        app = self.s.apps.get(request.app_id)
+        if app is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
+        lo = request.min_timestamp or (app.log_entries[0].timestamp if app.log_entries else time.time())
+        hi = request.max_timestamp or time.time()
+        n = min(max(request.n_buckets or 16, 1), 256)
+        if hi <= lo:
+            hi = lo + 1e-6
+        width = (hi - lo) / n
+        counts = [0] * n
+        first_index = [0] * n  # offset of each bucket's first entry
+        for i, entry in enumerate(app.log_entries):
+            if entry.timestamp < lo or entry.timestamp >= hi:
+                continue
+            if request.task_id and entry.task_id != request.task_id:
+                continue
+            b = min(int((entry.timestamp - lo) / width), n - 1)
+            if counts[b] == 0:
+                first_index[b] = i
+            counts[b] += 1
+        return api_pb2.AppCountLogsResponse(
+            buckets=[
+                api_pb2.LogBucket(
+                    start=lo + i * width, end=lo + (i + 1) * width, count=c, start_index=first_index[i]
+                )
+                for i, c in enumerate(counts)
+            ]
+        )
+
     async def AppFetchLogs(self, request: api_pb2.AppFetchLogsRequest, context) -> api_pb2.AppFetchLogsResponse:
         """Historical log backfill: offset-paged over the app's stored
         entries with time/task filters (reference _logs.py:114-310)."""
@@ -964,7 +1026,10 @@ class ModalTPUServicer:
             if request.min_timestamp and entry.timestamp < request.min_timestamp:
                 continue
             if request.max_timestamp and entry.timestamp >= request.max_timestamp:
-                continue
+                # entries are appended in time order: nothing later can be
+                # in the window — stop instead of scanning to the end
+                i = len(app.log_entries)
+                break
             if request.task_id and entry.task_id != request.task_id:
                 continue
             resp.entries.append(entry)
@@ -1100,6 +1165,13 @@ class ModalTPUServicer:
         fn = self.s.functions.get(task.function_id)
         if fn is not None:
             fn.task_ids.discard(task.task_id)
+        # close any forward() tunnels the container left open (crash, or a
+        # swallowed TunnelStop) — otherwise the proxy listener leaks for the
+        # control plane's lifetime
+        for key in [k for k in self.s.tunnels if k[0] == task.task_id]:
+            entry = self.s.tunnels.pop(key)
+            if entry[0] is not None:
+                entry[0].close()
         self.s.schedule_event.set()
 
     async def TaskGetTimeline(self, request: api_pb2.TaskGetTimelineRequest, context) -> api_pb2.TaskGetTimelineResponse:
@@ -1557,6 +1629,76 @@ class ModalTPUServicer:
             tunnels=list(sb.tunnels),
             result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
         )
+
+    async def TunnelStart(self, request: api_pb2.TunnelStartRequest, context) -> api_pb2.TunnelStartResponse:
+        """In-container `modal_tpu.forward(port)` (reference _tunnel.py): the
+        control plane serves a TCP proxy to the container's port (same host
+        in the local backend; production would front this with TLS + a
+        public hostname)."""
+        task = self.s.tasks.get(request.task_id)
+        if task is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "task not found")
+        key = (request.task_id, request.port)
+        existing = self.s.tunnels.get(key)
+        if existing is not None:
+            if existing[0] is None:
+                # another TunnelStart for this key is mid-flight: wait for it
+                # (reserving the key before the awaited start_server is what
+                # prevents two listeners leaking for one key)
+                for _ in range(100):
+                    await asyncio.sleep(0.05)
+                    existing = self.s.tunnels.get(key)
+                    if existing is None or existing[0] is not None:
+                        break
+            if existing is not None and existing[0] is not None:
+                return api_pb2.TunnelStartResponse(
+                    host="127.0.0.1", port=existing[1], url=f"tcp://127.0.0.1:{existing[1]}"
+                )
+        self.s.tunnels[key] = (None, 0)  # reservation
+        target_port = request.port
+
+        async def handle(reader, writer):
+            try:
+                up_r, up_w = await asyncio.open_connection("127.0.0.1", target_port)
+            except OSError:
+                writer.close()
+                return
+
+            async def pipe(src, dst):
+                try:
+                    while True:
+                        data = await src.read(64 * 1024)
+                        if not data:
+                            break
+                        dst.write(data)
+                        await dst.drain()
+                except Exception:  # noqa: BLE001 — peer reset
+                    pass
+                finally:
+                    try:
+                        dst.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            await asyncio.gather(pipe(reader, up_w), pipe(up_r, writer))
+
+        try:
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        except OSError:
+            self.s.tunnels.pop(key, None)  # release the reservation
+            raise
+        port = server.sockets[0].getsockname()[1]
+        self.s.tunnels[key] = (server, port)
+        scheme = "tcp" if request.unencrypted else "tls"
+        return api_pb2.TunnelStartResponse(host="127.0.0.1", port=port, url=f"{scheme}://127.0.0.1:{port}")
+
+    async def TunnelStop(self, request: api_pb2.TunnelStopRequest, context) -> api_pb2.TunnelStopResponse:
+        entry = self.s.tunnels.pop((request.task_id, request.port), None)
+        if entry is None:
+            return api_pb2.TunnelStopResponse(exists=False)
+        if entry[0] is not None:
+            entry[0].close()
+        return api_pb2.TunnelStopResponse(exists=True)
 
     async def TaskTunnelsUpdate(
         self, request: api_pb2.TaskTunnelsUpdateRequest, context

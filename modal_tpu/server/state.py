@@ -286,6 +286,7 @@ class ServerState:
         self.images_by_hash: dict[str, str] = {}
         self.sandboxes: dict[str, SandboxState_] = {}
         self.sandbox_snapshots: dict[str, SandboxSnapshotState] = {}
+        self.tunnels: dict[tuple[str, int], tuple] = {}  # (task_id, port) -> (server, proxy_port)
         self.environments: dict[str, str] = {"main": ""}  # name -> web suffix
         self.tokens: dict[str, str] = {}  # token_id -> token_secret
         self.pending_token_flows: dict[str, tuple[str, str]] = {}

@@ -1,0 +1,75 @@
+"""In-container port forwarding: `with modal_tpu.forward(port) as tunnel:`.
+
+Reference: py/modal/_tunnel.py (206 LoC) — a running container exposes one
+of its ports at a public address. The local backend's control plane serves
+the forward as a TCP proxy on the same host (TunnelStart/TunnelStop); in
+production the same contract would be fronted by a TLS terminator with a
+public hostname.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ._utils.async_utils import synchronize_api
+from ._utils.grpc_utils import retry_transient_errors
+from .client import _Client
+from .config import config
+from .exception import InvalidError
+from .proto import api_pb2
+
+
+@dataclass(frozen=True)
+class Tunnel:
+    """A live forward of a container port (reference _tunnel.py Tunnel)."""
+
+    host: str
+    port: int
+    unencrypted: bool = False
+
+    @property
+    def url(self) -> str:
+        scheme = "http" if self.unencrypted else "https"
+        return f"{scheme}://{self.host}:{self.port}"
+
+    @property
+    def tcp_socket(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+
+class _forward:
+    """Async context manager forwarding `port` of THIS container."""
+
+    def __init__(self, port: int, unencrypted: bool = False):
+        if not (0 < port < 65536):
+            raise InvalidError(f"invalid port {port}")
+        self.port = port
+        self.unencrypted = unencrypted
+        self._task_id = config.get("task_id")
+        self._client: _Client | None = None
+
+    async def __aenter__(self) -> Tunnel:
+        if not self._task_id:
+            raise InvalidError("modal_tpu.forward() only works inside a running container")
+        self._client = await _Client.from_env()
+        resp = await retry_transient_errors(
+            self._client.stub.TunnelStart,
+            api_pb2.TunnelStartRequest(
+                task_id=self._task_id, port=self.port, unencrypted=self.unencrypted
+            ),
+        )
+        return Tunnel(host=resp.host, port=resp.port, unencrypted=self.unencrypted)
+
+    async def __aexit__(self, *exc) -> None:
+        if self._client is not None:
+            try:
+                await retry_transient_errors(
+                    self._client.stub.TunnelStop,
+                    api_pb2.TunnelStopRequest(task_id=self._task_id, port=self.port),
+                    max_retries=1,
+                )
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+
+forward = synchronize_api(_forward)

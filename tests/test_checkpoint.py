@@ -152,3 +152,73 @@ def test_checkpoint_cross_mesh_regrid(supervisor):
     la, _ = forward(params, cfg, tokens)
     lb, _ = forward(restored, cfg, tokens)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-2, atol=1e-2)
+
+
+def test_checkpoint_regrid_to_more_devices(supervisor, tmp_path):
+    """Save on THIS process's 8-device mesh, restore in a SUBPROCESS with 16
+    virtual devices on a 16-way mesh (BASELINE config 5: resume after slice
+    rescale — the restore path regrids saved shards onto more devices than
+    the checkpoint ever saw)."""
+    import os
+    import subprocess
+    import sys
+
+    import modal_tpu
+    from modal_tpu.checkpoint import VolumeCheckpointer
+    from modal_tpu.models.llama import get_config, init_params
+    from modal_tpu.parallel.mesh import build_mesh
+    from modal_tpu.parallel.sharding import param_shardings
+
+    vol = modal_tpu.Volume.from_name("ckpt-regrid-16", create_if_missing=True)
+    vol.hydrate()
+    ckpt = VolumeCheckpointer(vol)
+
+    cfg = get_config("tiny")
+    mesh_a = build_mesh({"fsdp": 8})
+    sh_a = param_shardings(mesh_a, cfg)
+    params = jax.jit(lambda k: init_params(cfg, k), out_shardings=sh_a)(jax.random.PRNGKey(0))
+    ckpt.save("regrid16/step1", params, shard_leaves_over=0)
+    tokens = jnp.ones((2, 8), jnp.int32)
+    from modal_tpu.models.llama import forward
+
+    ref_logits = np.asarray(forward(params, cfg, tokens)[0])
+    ref_path = str(tmp_path / "ref_logits.npy")
+    np.save(ref_path, ref_logits)
+
+    child_code = f"""
+import os
+import numpy as np
+import jax, jax.numpy as jnp
+import modal_tpu
+from modal_tpu.checkpoint import VolumeCheckpointer
+from modal_tpu.models.llama import forward, get_config
+from modal_tpu.parallel.mesh import build_mesh
+from modal_tpu.parallel.sharding import param_shardings
+
+assert len(jax.devices()) == 16, jax.devices()
+cfg = get_config("tiny")
+vol = modal_tpu.Volume.from_name("ckpt-regrid-16")
+vol.hydrate()
+ckpt = VolumeCheckpointer(vol)
+mesh = build_mesh({{"data": 2, "fsdp": 4, "model": 2}})
+sh = param_shardings(mesh, cfg)
+restored = ckpt.restore("regrid16/step1", shardings=sh)
+assert restored["layers"]["wq"].sharding == sh["layers"]["wq"]
+tokens = jnp.ones((2, 8), jnp.int32)
+logits = np.asarray(forward(restored, cfg, tokens)[0])
+ref = np.load({ref_path!r})
+np.testing.assert_allclose(logits, ref, rtol=1e-2, atol=1e-2)
+print("REGRID-16-OK")
+"""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env["MODAL_TPU_SERVER_URL"] = f"grpc://127.0.0.1:{supervisor.port}"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c", child_code], env=env, capture_output=True, text=True, timeout=300
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "REGRID-16-OK" in r.stdout

@@ -1,0 +1,110 @@
+"""Flash (experimental): self-registering pool + metrics autoscaler
+(reference experimental/flash.py:31,280)."""
+
+import time
+
+import pytest
+
+
+def test_flash_pool_register_and_drain(supervisor):
+    """A container registers its tunneled port in the pool; after drain the
+    pool no longer lists it."""
+    import modal_tpu
+    from modal_tpu.experimental import flash_forward, flash_get_pool
+
+    app = modal_tpu.App("flash-e2e")
+
+    @app.function(serialized=True, timeout=60)
+    def member():
+        import socket
+
+        from modal_tpu.experimental import flash_forward, flash_get_pool
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        with flash_forward("flash-svc", port) as mgr:
+            pool = flash_get_pool("flash-svc")
+            in_pool = any(
+                m["host"] == mgr.tunnel.host and m["port"] == mgr.tunnel.port
+                for m in pool.values()
+            )
+            # reach the member THROUGH its tunnel while registered
+            import threading
+
+            def accept():
+                c, _ = srv.accept()
+                c.sendall(b"flash-ok")
+                c.close()
+
+            t = threading.Thread(target=accept, daemon=True)
+            t.start()
+            with socket.create_connection((mgr.tunnel.host, mgr.tunnel.port), timeout=10) as c:
+                data = c.recv(64)
+            t.join(timeout=5)
+        after = flash_get_pool("flash-svc")
+        srv.close()
+        return {"in_pool": in_pool, "data": data.decode(), "after_n": len(after)}
+
+    with app.run():
+        out = member.remote()
+    assert out["in_pool"] is True
+    assert out["data"] == "flash-ok"
+    assert out["after_n"] == 0  # drained on exit
+
+
+def test_flash_autoscaler_steers_container_count(supervisor):
+    """The autoscaler scrapes per-member load and writes the function's
+    AutoscalerSettings (reference _FlashPrometheusAutoscaler flash.py:280)."""
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.experimental.flash import _FlashAutoscaler, _pool_name
+    from modal_tpu.dict import _Dict
+
+    app = modal_tpu.App("flash-scale")
+
+    @app.function(serialized=True)
+    def svc(x):
+        return x
+
+    with app.run():
+        # seed the pool with two synthetic members carrying load 6.0 each;
+        # target 4.0 per member -> desired = round(12/4) = 3 containers
+        async def seed_and_step():
+            pool = await _Dict.lookup(_pool_name("scaled-svc"), create_if_missing=True)
+            now = time.time()
+            await pool.put("ta-a", {"host": "127.0.0.1", "port": 1111, "ts": now})
+            await pool.put("ta-b", {"host": "127.0.0.1", "port": 2222, "ts": now})
+            scaler = _FlashAutoscaler(
+                function=svc,
+                function_name="scaled-svc",
+                get_metric=lambda host, port: 6.0,
+                target_value=4.0,
+                min_containers=1,
+                max_containers=5,
+            )
+            return await scaler.step()
+
+        desired = synchronizer.run(seed_and_step())
+        assert desired == 3
+        fn_state = supervisor.state.functions[svc.object_id]
+        assert fn_state.autoscaler_override is not None
+        assert fn_state.autoscaler_override.min_containers == 3
+
+        # stale members (crashed without drain) are ignored
+        async def stale_step():
+            pool = await _Dict.lookup(_pool_name("scaled-svc"))
+            await pool.put("ta-a", {"host": "127.0.0.1", "port": 1111, "ts": time.time() - 120})
+            await pool.put("ta-b", {"host": "127.0.0.1", "port": 2222, "ts": time.time() - 120})
+            scaler = _FlashAutoscaler(
+                function=svc,
+                function_name="scaled-svc",
+                get_metric=lambda host, port: 6.0,
+                target_value=4.0,
+                min_containers=1,
+                max_containers=5,
+            )
+            return await scaler.step()
+
+        assert synchronizer.run(stale_step()) == 1  # no live members -> floor

@@ -176,3 +176,100 @@ def test_import_telemetry_traces_container_imports(supervisor, monkeypatch):
     modules = {e["module"] for e in roots}
     assert any(m.startswith("xml") for m in modules), sorted(modules)[:20]
     assert all(e["duration_s"] >= 0 for e in roots)
+
+
+def test_runtime_debug_profile_recorded(supervisor):
+    """runtime_debug=True wraps calls in jax.profiler.trace: an xplane dump
+    lands in the task state dir and `app profile` lists it (SURVEY §5
+    tracing; reference runtime_perf_record api.proto:1863)."""
+    import modal_tpu
+
+    app = modal_tpu.App("profiled")
+
+    @app.function(runtime_debug=True, serialized=True)
+    def traced(x):
+        import jax.numpy as jnp
+
+        return float(jnp.sum(jnp.arange(x)))
+
+    with app.run():
+        assert traced.remote(10) == 45.0
+        app_id = app.app_id
+
+    profile_dirs = []
+    for task in supervisor.state.tasks.values():
+        import os
+
+        d = os.path.join(supervisor.state.state_dir, "tasks", task.task_id, "profile")
+        if os.path.isdir(d):
+            profile_dirs.append(d)
+    assert profile_dirs, "no profile dir written"
+    found_xplane = any(
+        f.endswith(".xplane.pb")
+        for d in profile_dirs
+        for _root, _dirs, files in __import__("os").walk(d)
+        for f in files
+    )
+    assert found_xplane, "no xplane dump recorded"
+
+    from click.testing import CliRunner
+
+    from modal_tpu.cli.entry_point import cli
+
+    result = CliRunner().invoke(cli, ["app", "profile", app_id], catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    assert "traces" in result.output
+
+
+def test_bucketed_log_fetch(supervisor):
+    """AppCountLogs histogram -> dense-range refinement -> windowed fetch
+    yields exactly the in-window entries (reference _logs.py:114-310)."""
+    import time as _time
+
+    from modal_tpu._logs import build_fetch_intervals, fetch_app_logs_bucketed
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+
+    # seed the server's log store directly: two dense clusters separated by
+    # a long quiet gap, so refinement must skip the gap
+    state = supervisor.state
+
+    async def seed():
+        from modal_tpu.proto import api_pb2
+        from modal_tpu.server.state import AppState
+
+        app = AppState(app_id="ap-logs", description="t")
+        state.apps["ap-logs"] = app
+        base = _time.time() - 10_000
+        for i in range(800):  # dense cluster A (refined: >500 in one bucket)
+            app.log_entries.append(
+                api_pb2.TaskLogs(data=f"A{i}\n", task_id="ta-1", timestamp=base + i * 0.01)
+            )
+        for i in range(50):  # sparse cluster B, 9000s later
+            app.log_entries.append(
+                api_pb2.TaskLogs(data=f"B{i}\n", task_id="ta-1", timestamp=base + 9000 + i)
+            )
+        return base
+
+    base = synchronizer.run(seed())
+
+    async def go():
+        client = await _Client.from_env()
+        intervals = await build_fetch_intervals(
+            client, "ap-logs", base - 1, base + 9100
+        )
+        entries = []
+        async for e in fetch_app_logs_bucketed(
+            client, "ap-logs", min_timestamp=base + 8999, max_timestamp=base + 9100
+        ):
+            entries.append(e)
+        return intervals, entries
+
+    intervals, entries = synchronizer.run(go())
+    # the quiet 9000s gap must NOT be covered by any interval
+    assert all(
+        not (start < base + 4000 and end > base + 5000) for start, end, _idx in intervals
+    ), intervals
+    # the windowed fetch returns exactly cluster B
+    assert len(entries) == 50
+    assert all(e.data.startswith("B") for e in entries)

@@ -65,3 +65,120 @@ def test_get_blocks_sha256_flag(monkeypatch):
     base = get_blocks_sha256(data, 32768)
     monkeypatch.setenv("MODAL_TPU_NATIVE_HASH", "1")
     assert get_blocks_sha256(data, 32768) == base
+
+
+# ---------------------------------------------------------------------------
+# CloudBucketMount real IO (S3-compatible endpoint; local emulator fixture)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def s3_emulator(tmp_path):
+    """Minimal S3-compatible server: ListObjectsV2 + GET/PUT object. Runs on
+    the synchronizer loop like the supervisor fixtures do."""
+    from aiohttp import web
+
+    from modal_tpu._utils.async_utils import synchronizer
+
+    store: dict[str, dict[str, bytes]] = {}  # bucket -> key -> data
+
+    async def start():
+        async def handle_bucket(request):
+            bucket = request.match_info["bucket"]
+            prefix = request.query.get("prefix", "")
+            keys = sorted(k for k in store.get(bucket, {}) if k.startswith(prefix))
+            contents = "".join(f"<Contents><Key>{k}</Key></Contents>" for k in keys)
+            xml = (
+                '<?xml version="1.0"?><ListBucketResult>'
+                f"<IsTruncated>false</IsTruncated>{contents}</ListBucketResult>"
+            )
+            return web.Response(text=xml, content_type="application/xml")
+
+        async def handle_get(request):
+            bucket, key = request.match_info["bucket"], request.match_info["key"]
+            data = store.get(bucket, {}).get(key)
+            if data is None:
+                return web.Response(status=404)
+            return web.Response(body=data)
+
+        async def handle_put(request):
+            bucket, key = request.match_info["bucket"], request.match_info["key"]
+            store.setdefault(bucket, {})[key] = await request.read()
+            return web.Response()
+
+        app = web.Application()
+        app.router.add_get("/{bucket}", handle_bucket)
+        app.router.add_get("/{bucket}/{key:.+}", handle_get)
+        app.router.add_put("/{bucket}/{key:.+}", handle_put)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return runner, f"http://127.0.0.1:{port}"
+
+    runner, url = synchronizer.run(start())
+    try:
+        yield store, url
+    finally:
+        synchronizer.run(runner.cleanup())
+
+
+def test_cloud_bucket_mount_sync_and_writeback(supervisor, s3_emulator, tmp_path):
+    """e2e: container sees seeded bucket objects at the mount path; files it
+    writes there land back in the bucket on exit (the local realization of
+    reference cloud_bucket_mount.py)."""
+    import time
+
+    import modal_tpu
+    from modal_tpu.cloud_bucket_mount import CloudBucketMount
+
+    store, url = s3_emulator
+    store["weights"] = {"ckpt/model.bin": b"fake-weights-bytes", "ckpt/config.json": b"{}"}
+
+    app = modal_tpu.App("bucket-e2e")
+    mount = CloudBucketMount("weights", bucket_endpoint_url=url, key_prefix="ckpt/")
+    mnt = str(tmp_path / "bucket-mnt")  # per-test dir: no cross-run leftovers
+
+    @app.function(volumes={mnt: mount}, serialized=True)
+    def use_bucket():
+        with open(f"{mnt}/model.bin", "rb") as f:
+            data = f.read()
+        with open(f"{mnt}/output.txt", "w") as f:
+            f.write("produced-by-container")
+        return len(data)
+
+    with app.run():
+        assert use_bucket.remote() == len(b"fake-weights-bytes")
+
+    # write-back happens at container exit (scaledown); poll for it
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if "ckpt/output.txt" in store.get("weights", {}):
+            break
+        time.sleep(0.5)
+    assert store["weights"].get("ckpt/output.txt") == b"produced-by-container"
+
+
+def test_cloud_bucket_mount_read_only_no_writeback(supervisor, s3_emulator, tmp_path):
+    import time
+
+    import modal_tpu
+    from modal_tpu.cloud_bucket_mount import CloudBucketMount
+
+    store, url = s3_emulator
+    store["ro-bucket"] = {"data.txt": b"readable"}
+
+    app = modal_tpu.App("bucket-ro")
+    mount = CloudBucketMount("ro-bucket", bucket_endpoint_url=url, read_only=True)
+    mnt = str(tmp_path / "ro-mnt")
+
+    @app.function(volumes={mnt: mount}, serialized=True)
+    def peek():
+        open(f"{mnt}/extra.txt", "w").write("should not upload")
+        return open(f"{mnt}/data.txt").read()
+
+    with app.run():
+        assert peek.remote() == "readable"
+    time.sleep(2.0)
+    assert "extra.txt" not in store["ro-bucket"]

@@ -110,3 +110,57 @@ def test_wsgi_app_endpoint(supervisor):
         status, body = _get(url + "/w?a=1")
         assert status == 200
         assert body == {"path": "/w", "q": "a=1"}
+
+
+def test_forward_tunnel_from_container(supervisor):
+    """A function exposes a TCP server via modal_tpu.forward(port); the
+    client reaches it through the proxy (reference _tunnel.py)."""
+    import socket
+    import time
+
+    import modal_tpu
+
+    app = modal_tpu.App("tunnel-e2e")
+
+    @app.function(serialized=True, timeout=60)
+    def serve_once():
+        import socket as sk
+
+        import modal_tpu as mt
+
+        srv = sk.socket()
+        srv.setsockopt(sk.SOL_SOCKET, sk.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        with mt.forward(port, unencrypted=True) as tunnel:
+            # hand the proxy address back; then serve one echo connection
+            import json
+
+            srv.settimeout(30)
+            addr = {"host": tunnel.host, "port": tunnel.port, "url": tunnel.url}
+            import threading
+
+            result = {}
+
+            def accept():
+                conn, _ = srv.accept()
+                data = conn.recv(1024)
+                conn.sendall(b"tunneled:" + data)
+                conn.close()
+                result["ok"] = True
+
+            t = threading.Thread(target=accept, daemon=True)
+            t.start()
+            # the client can't coordinate mid-call; do the round trip HERE
+            # through the proxy address (it traverses the real proxy path)
+            with sk.create_connection((tunnel.host, tunnel.port), timeout=10) as c:
+                c.sendall(b"ping")
+                reply = c.recv(1024)
+            t.join(timeout=10)
+            srv.close()
+            assert tunnel.url.startswith("http://")
+            return reply.decode()
+
+    with app.run():
+        assert serve_once.remote() == "tunneled:ping"
