@@ -71,8 +71,13 @@ def run_pty_session(sandbox, argv: list[str]) -> int:
                 break
             if not data:
                 break
-            proc.stdin.write(data)
-            proc.stdin.drain()
+            try:
+                proc.stdin.write(data)
+                proc.stdin.drain()
+            except Exception:  # noqa: BLE001 — remote process exited while we
+                # were writing: fall through to proc.wait() for the real exit
+                # code instead of blowing a traceback out of the shell
+                break
     finally:
         termios.tcsetattr(stdin_fd, termios.TCSADRAIN, old_attrs)
         signal.signal(signal.SIGWINCH, old_winch)

@@ -118,6 +118,7 @@ _SETTINGS: dict[str, _Setting] = {
     "worker_region": _Setting(""),
     "worker_zone": _Setting(""),
     "worker_spot": _Setting(False, _to_boolean),
+    "worker_instance_type": _Setting(""),
     # jax persistent compilation cache for cold-start elimination.
     "compilation_cache_dir": _Setting(os.path.expanduser("~/.modal_tpu_state/jit_cache")),
     # Default TPU runtime visible-device pinning behavior.

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Regenerate the checked-in protobuf bindings from api.proto.
+#
+# This is the codegen pipeline for the wire contract: api_pb2.py is generated
+# code and MUST NOT be edited by hand (proto drift was one mistake away when
+# regeneration was an undocumented manual step). The gRPC method registry
+# (rpc.py) is declarative and hand-maintained on purpose — adding an RPC means
+# adding it to the service definition there, where the router/auth metadata
+# lives next to the method name.
+#
+# Usage: ./regen.sh   (from this directory)
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=. api.proto
+python - <<'EOF'
+import sys, os
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__ if '__file__' in dir() else '.'), '..', '..')))
+from modal_tpu.proto import api_pb2  # noqa: F401 — import-checks the output
+print("api_pb2.py regenerated and import-checked")
+EOF

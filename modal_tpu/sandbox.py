@@ -141,6 +141,8 @@ class _Sandbox(_Object, type_prefix="sb"):
         encrypted_ports: Sequence[int] = (),
         unencrypted_ports: Sequence[int] = (),
         readiness_probe: Optional[Sequence[str]] = None,
+        region: "str | Sequence[str] | None" = None,
+        scheduler_placement: Optional[Any] = None,
         client: Optional[_Client] = None,
     ) -> "_Sandbox":
         """Launch a sandbox running `entrypoint_args` (reference
@@ -171,6 +173,13 @@ class _Sandbox(_Object, type_prefix="sb"):
             definition.open_ports.append(api_pb2.PortSpec(port=port, unencrypted=True))
         if readiness_probe:
             definition.readiness_probe.exec_command.extend(readiness_probe)
+        if region is not None or scheduler_placement is not None:
+            from .schedule import SchedulerPlacement
+
+            placement = scheduler_placement or SchedulerPlacement(region=region)
+            if region is not None and scheduler_placement is not None:
+                raise InvalidError("pass either region or scheduler_placement, not both")
+            definition.scheduler_placement.CopyFrom(placement.to_proto())
         spec = parse_tpu_config(tpu)
         if spec is not None:
             definition.resources.tpu_config.CopyFrom(spec.to_proto())

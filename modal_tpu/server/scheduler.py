@@ -84,8 +84,10 @@ class Scheduler:
                     logger.warning(f"disabling schedule for {fn.tag}: {exc}")
                     fn.next_fire_at = -1.0
             backlog = sum(1 for iid in fn.pending if self.s.inputs[iid].status == "pending")
-            placement = self._fn_placement(fn)
-            if backlog > 0 and placement is not None and not self._placement_satisfiable(placement):
+            unsat_reason = self.placement_unsatisfiable_reason(
+                fn.definition.scheduler_placement, subject=fn.tag
+            )
+            if backlog > 0 and unsat_reason is not None:
                 # no registered worker could EVER match (wrong region/zone/
                 # spot labels): fail the backlog loudly instead of queueing
                 # forever — "all matching workers busy" is NOT this case.
@@ -99,12 +101,7 @@ class Scheduler:
                     continue
                 result = api_pb2.GenericResult(
                     status=api_pb2.GENERIC_STATUS_FAILURE,
-                    exception=(
-                        f"unsatisfiable placement for {fn.tag}: "
-                        f"regions={list(placement.regions)} zones={list(placement.zones)}"
-                        + (f" spot={placement.spot}" if placement.HasField("spot") else "")
-                        + " matches no registered worker"
-                    ),
+                    exception=unsat_reason,
                 )
                 logger.warning(result.exception)
                 if self.servicer is not None:
@@ -203,12 +200,39 @@ class Scheduler:
             return False
         if placement.HasField("spot") and worker.spot != placement.spot:
             return False
+        if placement.instance_types and worker.instance_type not in placement.instance_types:
+            # workers that don't report an instance type never match an
+            # instance_types constraint — the unsatisfiable-placement path
+            # then fails the request loudly instead of ignoring the filter
+            return False
         return True
 
     def _placement_satisfiable(self, placement) -> bool:
         """Could ANY registered worker (busy or not) ever match? Used to
         reject impossible placements loudly instead of queueing forever."""
         return any(self._placement_ok(w, placement) for w in self.s.workers.values())
+
+    def placement_unsatisfiable_reason(self, placement_proto, subject: str = "") -> Optional[str]:
+        """Loud-failure check shared by the function-backlog and sandbox
+        paths (one formatter, so the two can't drift): a non-None string
+        means no registered worker could EVER match. Callers own the grace
+        window (workers may simply not have registered yet) — the function
+        path via fn.placement_unsat_since, SandboxCreate via a bounded wait."""
+        placement = self._placement_or_none(placement_proto)
+        if placement is None or self._placement_satisfiable(placement):
+            return None
+        return (
+            "unsatisfiable placement"
+            + (f" for {subject}" if subject else "")
+            + f": regions={list(placement.regions)} zones={list(placement.zones)}"
+            + (f" spot={placement.spot}" if placement.HasField("spot") else "")
+            + (
+                f" instance_types={list(placement.instance_types)}"
+                if placement.instance_types
+                else ""
+            )
+            + " matches no registered worker"
+        )
 
     @staticmethod
     def _placement_or_none(p):

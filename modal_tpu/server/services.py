@@ -21,6 +21,7 @@ import grpc
 
 from ..config import logger
 from ..proto import api_pb2
+from .scheduler import PLACEMENT_UNSAT_GRACE_S
 from .state import (
     AppState,
     ClusterState,
@@ -1026,10 +1027,15 @@ class ModalTPUServicer:
             if request.min_timestamp and entry.timestamp < request.min_timestamp:
                 continue
             if request.max_timestamp and entry.timestamp >= request.max_timestamp:
-                # entries are appended in time order: nothing later can be
-                # in the window — stop instead of scanning to the end
-                i = len(app.log_entries)
-                break
+                # Entries are stamped worker-side and appended at RPC arrival,
+                # so the store is only approximately time-ordered: a windowed
+                # fetch may still find in-window entries after this one. Keep
+                # scanning until entries are past the window by more than any
+                # plausible worker->server delivery skew.
+                if entry.timestamp >= request.max_timestamp + 30.0:
+                    i = len(app.log_entries)
+                    break
+                continue
             if request.task_id and entry.task_id != request.task_id:
                 continue
             resp.entries.append(entry)
@@ -1170,7 +1176,10 @@ class ModalTPUServicer:
         # control plane's lifetime
         for key in [k for k in self.s.tunnels if k[0] == task.task_id]:
             entry = self.s.tunnels.pop(key)
-            if entry[0] is not None:
+            if isinstance(entry, asyncio.Future):
+                if not entry.done():
+                    entry.set_result(None)  # wake waiters now, not at their 15s timeout
+            elif entry[0] is not None:
                 entry[0].close()
         self.s.schedule_event.set()
 
@@ -1277,6 +1286,25 @@ class ModalTPUServicer:
             name=request.definition.name,
         )
         task = await self.scheduler.launch_sandbox(sb)
+        unsat = None
+        if task is None:
+            # A placement no worker could EVER match must fail loudly (same
+            # rule as the function-backlog path) — but only after a bounded
+            # grace wait: a matching worker may simply not have (re-)registered
+            # yet (boot, restart-with-retries).
+            unsat = self.scheduler.placement_unsatisfiable_reason(
+                request.definition.scheduler_placement
+            )
+            if unsat is not None:
+                deadline = time.time() + PLACEMENT_UNSAT_GRACE_S
+                while time.time() < deadline:
+                    await asyncio.sleep(0.25)
+                    unsat = self.scheduler.placement_unsatisfiable_reason(
+                        request.definition.scheduler_placement
+                    )
+                    if unsat is None:
+                        task = await self.scheduler.launch_sandbox(sb)
+                        break
         if task is None:
             # don't leave ghost state behind: neither the sandbox nor an
             # implicitly created ephemeral app
@@ -1285,6 +1313,8 @@ class ModalTPUServicer:
                 if implicit_app is not None:
                     await self._stop_app(implicit_app)
                     del self.s.apps[app_id]
+            if unsat is not None:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"sandbox {unsat}")
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no worker capacity for sandbox")
         self.s.sandboxes[sandbox_id] = sb
         sb.state = api_pb2.SANDBOX_STATE_RUNNING
@@ -1460,6 +1490,7 @@ class ModalTPUServicer:
             region=request.region,
             zone=request.zone,
             spot=request.spot,
+            instance_type=request.instance_type,
         )
         self.s.schedule_event.set()
         return api_pb2.WorkerRegisterResponse(worker_id=worker_id)
@@ -1639,22 +1670,35 @@ class ModalTPUServicer:
         if task is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "task not found")
         key = (request.task_id, request.port)
-        existing = self.s.tunnels.get(key)
-        if existing is not None:
-            if existing[0] is None:
-                # another TunnelStart for this key is mid-flight: wait for it
-                # (reserving the key before the awaited start_server is what
-                # prevents two listeners leaking for one key)
-                for _ in range(100):
-                    await asyncio.sleep(0.05)
-                    existing = self.s.tunnels.get(key)
-                    if existing is None or existing[0] is not None:
-                        break
-            if existing is not None and existing[0] is not None:
-                return api_pb2.TunnelStartResponse(
-                    host="127.0.0.1", port=existing[1], url=f"tcp://127.0.0.1:{existing[1]}"
-                )
-        self.s.tunnels[key] = (None, 0)  # reservation
+        # Reservation protocol: a mid-flight start stores a Future under the
+        # key; late arrivals await THAT future instead of creating a second
+        # listener (two listeners for one key meant one asyncio server leaked
+        # for the control plane's lifetime).
+        for _ in range(3):
+            existing = self.s.tunnels.get(key)
+            if existing is None:
+                break
+            if isinstance(existing, asyncio.Future):
+                try:
+                    await asyncio.wait_for(asyncio.shield(existing), timeout=15.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue  # re-read: resolved to (server, port) or was stopped
+            scheme = "tcp" if request.unencrypted else "tls"
+            return api_pb2.TunnelStartResponse(
+                host="127.0.0.1", port=existing[1], url=f"{scheme}://127.0.0.1:{existing[1]}"
+            )
+        else:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, "tunnel start contended; retry")
+        # Re-validate task liveness AFTER the wait: the task may have finished
+        # while we awaited, and _release_task (which closes this task's
+        # tunnels) has already run — a listener installed now would leak for
+        # the control plane's lifetime.
+        task = self.s.tasks.get(request.task_id)
+        if task is None or task.finished_at:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, "task finished")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.s.tunnels[key] = fut  # reservation
         target_port = request.port
 
         async def handle(reader, writer):
@@ -1682,13 +1726,25 @@ class ModalTPUServicer:
 
             await asyncio.gather(pipe(reader, up_w), pipe(up_r, writer))
 
+        server = None
         try:
             server = await asyncio.start_server(handle, "127.0.0.1", 0)
-        except OSError:
-            self.s.tunnels.pop(key, None)  # release the reservation
-            raise
-        port = server.sockets[0].getsockname()[1]
-        self.s.tunnels[key] = (server, port)
+            port = server.sockets[0].getsockname()[1]
+            if self.s.tunnels.get(key) is fut:
+                self.s.tunnels[key] = (server, port)
+            else:
+                # TunnelStop raced the start: don't leak the listener, and
+                # don't hand the client a port whose listener is closed
+                server.close()
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "tunnel stopped during start")
+        finally:
+            # ANY exit (OSError, RPC cancellation, abort) must release a
+            # still-held reservation and wake waiters, or the key is bricked
+            # for the control plane's lifetime
+            if self.s.tunnels.get(key) is fut:
+                del self.s.tunnels[key]
+            if not fut.done():
+                fut.set_result(None)  # waiters re-read the key and retry
         scheme = "tcp" if request.unencrypted else "tls"
         return api_pb2.TunnelStartResponse(host="127.0.0.1", port=port, url=f"{scheme}://127.0.0.1:{port}")
 
@@ -1696,7 +1752,13 @@ class ModalTPUServicer:
         entry = self.s.tunnels.pop((request.task_id, request.port), None)
         if entry is None:
             return api_pb2.TunnelStopResponse(exists=False)
-        if entry[0] is not None:
+        # a Future entry is a mid-flight start: the starter sees its
+        # reservation is gone and closes the listener itself; resolve it so
+        # waiters wake immediately instead of riding their 15s timeout
+        if isinstance(entry, asyncio.Future):
+            if not entry.done():
+                entry.set_result(None)
+        elif entry[0] is not None:
             entry[0].close()
         return api_pb2.TunnelStopResponse(exists=True)
 

@@ -160,6 +160,7 @@ class WorkerState:
     region: str = ""  # placement labels (SchedulerPlacement matching)
     zone: str = ""
     spot: bool = False
+    instance_type: str = ""
     last_heartbeat: float = field(default_factory=time.time)
     # assignment channel consumed by the worker's WorkerPoll stream
     events: asyncio.Queue = field(default_factory=asyncio.Queue)
@@ -286,7 +287,9 @@ class ServerState:
         self.images_by_hash: dict[str, str] = {}
         self.sandboxes: dict[str, SandboxState_] = {}
         self.sandbox_snapshots: dict[str, SandboxSnapshotState] = {}
-        self.tunnels: dict[tuple[str, int], tuple] = {}  # (task_id, port) -> (server, proxy_port)
+        # (task_id, port) -> (server, proxy_port), or an asyncio.Future while
+        # a TunnelStart is mid-flight (the reservation protocol in TunnelStart)
+        self.tunnels: dict[tuple[str, int], object] = {}
         self.environments: dict[str, str] = {"main": ""}  # name -> web suffix
         self.tokens: dict[str, str] = {}  # token_id -> token_secret
         self.pending_token_flows: dict[str, tuple[str, str]] = {}

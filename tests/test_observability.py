@@ -273,3 +273,48 @@ def test_bucketed_log_fetch(supervisor):
     # the windowed fetch returns exactly cluster B
     assert len(entries) == 50
     assert all(e.data.startswith("B") for e in entries)
+
+
+def test_windowed_log_fetch_tolerates_out_of_order_entries(supervisor):
+    """Log entries are stamped worker-side and appended at RPC arrival, so the
+    store is only approximately time-ordered. A windowed fetch must not drop
+    in-window entries that appear after a just-past-window one (ADVICE r3:
+    the early break silently disagreed with AppCountLogs counts)."""
+    import time as _time
+
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.state import AppState
+
+    state = supervisor.state
+    base = _time.time() - 1_000
+
+    async def seed():
+        app = AppState(app_id="ap-ooo", description="t")
+        state.apps["ap-ooo"] = app
+        # worker A's entry arrives late: timestamp just past the window END
+        # lands in the store BEFORE worker B's in-window entries (delivery
+        # skew of a few seconds — within the fetch's 30s scan margin)
+        app.log_entries.append(
+            api_pb2.TaskLogs(data="past-window\n", task_id="ta-A", timestamp=base + 65)
+        )
+        for i in range(5):
+            app.log_entries.append(
+                api_pb2.TaskLogs(data=f"in-window-{i}\n", task_id="ta-B", timestamp=base + 50 + i)
+            )
+
+    synchronizer.run(seed())
+
+    async def fetch():
+        client = await _Client.from_env()
+        resp = await client.stub.AppFetchLogs(
+            api_pb2.AppFetchLogsRequest(
+                app_id="ap-ooo", min_timestamp=base + 40, max_timestamp=base + 60
+            )
+        )
+        return resp
+
+    resp = synchronizer.run(fetch())
+    got = [e.data for e in resp.entries]
+    assert got == [f"in-window-{i}\n" for i in range(5)], got

@@ -24,7 +24,10 @@ def labeled_supervisor(tmp_path, monkeypatch):
     )
     synchronizer.run(sup.start())
     workers = []
-    for region, zone, spot in [("us-east1", "us-east1-b", False), ("eu-west4", "eu-west4-a", True)]:
+    for region, zone, spot, itype in [
+        ("us-east1", "us-east1-b", False, "ct5lp-hightpu-4t"),
+        ("eu-west4", "eu-west4-a", True, "ct5p-hightpu-8t"),
+    ]:
         w = WorkerAgent(
             sup.server_url,
             num_chips=8,
@@ -33,6 +36,7 @@ def labeled_supervisor(tmp_path, monkeypatch):
             region=region,
             zone=zone,
             spot=spot,
+            instance_type=itype,
         )
         synchronizer.run(w.start())
         workers.append(w)
@@ -110,3 +114,75 @@ def test_placement_unsatisfiable_fails_loudly(labeled_supervisor):
         with pytest.raises(Exception, match="unsatisfiable placement"):
             unreachable.remote(1)
     assert time.monotonic() - t0 < 20  # failed fast, didn't ride the timeout
+
+
+def test_placement_instance_type_honored(labeled_supervisor):
+    """instance_types constraints match the worker's registered label
+    (was silently ignored: counted as a constraint but never matched)."""
+    import modal_tpu
+
+    sup, _ = labeled_supervisor
+    app = modal_tpu.App("placement-itype")
+
+    @app.function(
+        scheduler_placement=modal_tpu.SchedulerPlacement(instance_type="ct5p-hightpu-8t"),
+        serialized=True,
+    )
+    def where(x):
+        return x - 1
+
+    with app.run():
+        assert where.remote(5) == 4
+    eu = _worker_id_by_region(sup, "eu-west4")
+    ran_on = {t.worker_id for t in sup.state.tasks.values() if t.worker_id}
+    assert ran_on == {eu}
+
+
+def test_placement_instance_type_unsatisfiable_fails_loudly(labeled_supervisor):
+    """An instance type no worker carries fails the call, not ignores it."""
+    import time
+
+    import modal_tpu
+
+    app = modal_tpu.App("placement-itype-bad")
+
+    @app.function(
+        scheduler_placement=modal_tpu.SchedulerPlacement(instance_type="a3-megagpu-8g"),
+        serialized=True,
+        timeout=30,
+    )
+    def unreachable(x):
+        return x
+
+    t0 = time.monotonic()
+    with app.run():
+        with pytest.raises(Exception, match="unsatisfiable placement"):
+            unreachable.remote(1)
+    assert time.monotonic() - t0 < 20
+
+
+def test_sandbox_unsatisfiable_placement_fails_loudly(labeled_supervisor):
+    """Sandbox.create with an impossible placement errors immediately with an
+    explanation instead of retrying until the sandbox timeout (ADVICE r3)."""
+    import time
+
+    import modal_tpu
+
+    t0 = time.monotonic()
+    with pytest.raises(Exception, match="unsatisfiable placement"):
+        modal_tpu.Sandbox.create("true", region="mars-north1", timeout=60)
+    # pays the bounded registration-grace wait (~5s), then fails — never
+    # retries until the 60s sandbox timeout
+    assert time.monotonic() - t0 < 20
+
+
+def test_sandbox_placement_honored(labeled_supervisor):
+    """A satisfiable sandbox placement lands on the matching worker."""
+    import modal_tpu
+
+    sup, _ = labeled_supervisor
+    sb = modal_tpu.Sandbox.create("sh", "-c", "echo hi", region="eu-west4", timeout=60)
+    sb.wait()
+    eu = _worker_id_by_region(sup, "eu-west4")
+    task = sup.state.tasks[sup.state.sandboxes[sb.object_id].task_id]
+    assert task.worker_id == eu
