@@ -1,0 +1,102 @@
+"""Image-builder version epochs: pinned base-dependency sets per builder
+version (reference: py/modal/builder/ — `2025.06.txt` requirement sets +
+`base-images.json`, consumed by the remote builder; README.md describes the
+epoch discipline).
+
+TPU-first interpretation: an epoch pins the **jax stack** a container built
+at that version is guaranteed to see (jax/flax/optax/orbax/numpy/...), plus
+per-epoch base-image defaults (supported python minors, default TPU env).
+The epoch participates in the image content hash — bumping a pin inside an
+epoch file, or moving to a new epoch, rebuilds every image — and `RUN pip
+install <bare-name>` lines are constrained to the epoch's pin so builds are
+reproducible across hosts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+
+_BUILDER_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class UnknownBuilderVersion(Exception):
+    def __init__(self, version: str):
+        super().__init__(
+            f"unknown image builder version {version!r}; known: {', '.join(known_versions())}"
+        )
+
+
+def known_versions() -> tuple[str, ...]:
+    versions = []
+    for name in sorted(os.listdir(_BUILDER_DIR)):
+        if name.endswith(".txt"):
+            versions.append(name[:-4])
+    return tuple(versions)
+
+
+def _epoch_path(version: str) -> str:
+    if version not in known_versions():
+        raise UnknownBuilderVersion(version)
+    return os.path.join(_BUILDER_DIR, f"{version}.txt")
+
+
+
+def load_requirements(version: str) -> dict[str, str]:
+    """{package_name: full requirement line} for the epoch's pinned set."""
+    pins: dict[str, str] = {}
+    with open(_epoch_path(version)) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^([A-Za-z0-9_.-]+)", line)
+            if m:
+                pins[m.group(1).lower().replace("_", "-")] = line
+    return pins
+
+
+
+def base_image_config(version: str) -> dict:
+    """Per-epoch base-image settings (python minors, default TPU env)."""
+    if version not in known_versions():
+        raise UnknownBuilderVersion(version)
+    with open(os.path.join(_BUILDER_DIR, "base_images.json")) as f:
+        table = json.load(f)
+    return {
+        "python": table["python"].get(version, []),
+        "tpu_env": table["tpu_env"].get(version, {}),
+    }
+
+
+
+def epoch_content_hash(version: str) -> str:
+    """Hash of everything the epoch pins — part of the image content hash,
+    so editing an epoch file invalidates images built under it."""
+    h = hashlib.sha256()
+    with open(_epoch_path(version), "rb") as f:
+        h.update(f.read())
+    h.update(json.dumps(base_image_config(version), sort_keys=True).encode())
+    return h.hexdigest()[:16]
+
+
+def constrain_pip_install(cmd: str, version: str) -> str:
+    """Rewrite `pip install name [name2...]` so bare names carry the epoch's
+    pin. Names the epoch doesn't pin, and specs with explicit constraints or
+    flags, pass through untouched."""
+    pins = load_requirements(version)
+    m = re.match(r"^(.*?-m pip install\s+)(.*)$", cmd)
+    if m is None:
+        return cmd
+    head, rest = m.groups()
+    out = []
+    for token in rest.split():
+        if re.fullmatch(r"[A-Za-z0-9_.-]+", token):
+            pin = pins.get(token.lower().replace("_", "-"))
+            if pin is not None and " " not in pin:
+                out.append(pin)
+                continue
+        out.append(token)
+    return head + " ".join(out)

@@ -630,6 +630,276 @@ def profile_activate(name: str) -> None:
     click.echo(f"activated profile {name}")
 
 
+# ---------------------------------------------------------------------------
+# container / cluster / environment / image / nfs
+# (reference cli/entry_point.py:101-134 — the management command groups)
+# ---------------------------------------------------------------------------
+
+
+def _task_state_name(state: int) -> str:
+    from ..proto import api_pb2
+
+    return api_pb2.TaskState.Name(state).removeprefix("TASK_STATE_").lower()
+
+
+@cli.group("container")
+def container_group() -> None:
+    """Manage running containers (reference cli/container.py)."""
+
+
+@container_group.command("list")
+@click.option("--env", default="", help="Filter to one environment.")
+@click.option("--all", "include_finished", is_flag=True, help="Include finished containers.")
+def container_list(env: str, include_finished: bool) -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(
+            c.stub.TaskList,
+            api_pb2.TaskListRequest(environment_name=env, include_finished=include_finished),
+        )
+
+    resp = synchronizer.run(go(client))
+    for t in resp.tasks:
+        chips = f" chips={list(t.tpu_chip_ids)}" if t.tpu_chip_ids else ""
+        gang = f" gang={t.cluster_id}#{t.rank}" if t.cluster_id else ""
+        click.echo(
+            f"{t.task_id}  {_task_state_name(t.state):10s} {_fmt_ts(t.created_at)}  "
+            f"{t.app_description or t.app_id}::{t.function_tag}{chips}{gang}"
+        )
+
+
+@container_group.command("stop")
+@click.argument("task_id")
+def container_stop(task_id: str) -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        await retry_transient_errors(
+            c.stub.ContainerStop, api_pb2.ContainerStopRequest(task_id=task_id)
+        )
+
+    synchronizer.run(go(client))
+    click.echo(f"stopping {task_id}")
+
+
+@container_group.command("logs")
+@click.argument("task_id")
+def container_logs(task_id: str) -> None:
+    """Backfill one container's logs (windowed fetch filtered by task)."""
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        tasks = await retry_transient_errors(
+            c.stub.TaskList, api_pb2.TaskListRequest(include_finished=True)
+        )
+        app_id = next((t.app_id for t in tasks.tasks if t.task_id == task_id), None)
+        if app_id is None:
+            raise Error(f"container {task_id} not found")
+        entries = []
+        start = 0
+        while True:
+            resp = await retry_transient_errors(
+                c.stub.AppFetchLogs,
+                api_pb2.AppFetchLogsRequest(app_id=app_id, task_id=task_id, start_index=start),
+            )
+            entries.extend(resp.entries)
+            # an empty PAGE is normal (500 consecutive entries from other
+            # tasks); only stop when the cursor reaches the end or stalls
+            if resp.next_index >= resp.total or resp.next_index <= start:
+                break
+            start = resp.next_index
+        return entries
+
+    for entry in synchronizer.run(go(client)):
+        click.echo(entry.data, nl=False)
+
+
+@cli.group("cluster")
+def cluster_group() -> None:
+    """Inspect gangs of co-scheduled containers (reference cli/cluster.py)."""
+
+
+@cluster_group.command("list")
+def cluster_list() -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.ClusterList, api_pb2.ClusterListRequest())
+
+    resp = synchronizer.run(go(client))
+    for cl in resp.clusters:
+        topo = f" topology={cl.topology}" if cl.topology else ""
+        click.echo(
+            f"{cl.cluster_id}  {cl.function_tag}  size={cl.size} "
+            f"ranks_reported={cl.ranks_reported}{topo}"
+        )
+
+
+@cli.group("environment")
+def environment_group() -> None:
+    """Manage environments (reference cli/environment.py)."""
+
+
+@environment_group.command("list")
+def environment_list() -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.EnvironmentList, api_pb2.EnvironmentListRequest())
+
+    resp = synchronizer.run(go(client))
+    for e in resp.items:
+        suffix = f"  {e.webhook_suffix}" if e.webhook_suffix else ""
+        click.echo(f"{e.name}{suffix}")
+
+
+@environment_group.command("create")
+@click.argument("name")
+def environment_create(name: str) -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        await retry_transient_errors(
+            c.stub.EnvironmentCreate, api_pb2.EnvironmentCreateRequest(name=name)
+        )
+
+    synchronizer.run(go(client))
+    click.echo(f"created environment {name}")
+
+
+@environment_group.command("rename")
+@click.argument("name")
+@click.argument("new_name")
+def environment_rename(name: str, new_name: str) -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        await retry_transient_errors(
+            c.stub.EnvironmentUpdate,
+            api_pb2.EnvironmentUpdateRequest(current_name=name, name=new_name),
+        )
+
+    synchronizer.run(go(client))
+    click.echo(f"renamed environment {name} -> {new_name}")
+
+
+@environment_group.command("delete")
+@click.argument("name")
+@click.confirmation_option(prompt="Delete this environment?")
+def environment_delete(name: str) -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        await retry_transient_errors(
+            c.stub.EnvironmentDelete, api_pb2.EnvironmentDeleteRequest(name=name)
+        )
+
+    synchronizer.run(go(client))
+    click.echo(f"deleted environment {name}")
+
+
+@cli.group("image")
+def image_group() -> None:
+    """Manage built images (reference cli/image.py)."""
+
+
+@image_group.command("list")
+def image_list() -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.ImageList, api_pb2.ImageListRequest())
+
+    resp = synchronizer.run(go(client))
+    for img in resp.images:
+        status = "built" if img.built else "pending"
+        click.echo(
+            f"{img.image_id}  {status:8s} {_fmt_ts(img.created_at)}  "
+            f"builder={img.builder_version or '-'} refs={img.ref_count}"
+        )
+
+
+@image_group.command("prune")
+@click.option("--yes", is_flag=True, help="Skip the confirmation prompt.")
+def image_prune(yes: bool) -> None:
+    """Delete image records not referenced by any live container."""
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        resp = await retry_transient_errors(c.stub.ImageList, api_pb2.ImageListRequest())
+        victims = []
+        for img in resp.images:
+            if img.ref_count:
+                continue
+            try:
+                await retry_transient_errors(
+                    c.stub.ImageDelete, api_pb2.ImageDeleteRequest(image_id=img.image_id)
+                )
+                victims.append(img.image_id)
+            except Exception:  # noqa: BLE001 — pinned between list and delete
+                pass
+        return victims
+
+    if not yes:
+        click.confirm("Delete all unreferenced images?", abort=True)
+    victims = synchronizer.run(go(client))
+    click.echo(f"pruned {len(victims)} image(s)")
+
+
+@cli.group("nfs")
+def nfs_group() -> None:
+    """Manage network file systems (alias of volumes — reference marks NFS
+    legacy; ours is a declared thin alias, network_file_system.py)."""
+
+
+def _alias_volume_command(name: str) -> None:
+    src = volume_group.commands[name]
+    nfs_group.add_command(
+        click.Command(
+            name,
+            params=src.params,
+            callback=src.callback,
+            help=src.help,
+            short_help=src.short_help,
+        )
+    )
+
+
+for _cmd in ("list", "create", "delete", "ls", "put", "get", "rm"):
+    _alias_volume_command(_cmd)
+
+
 @cli.group("token")
 def token_group() -> None:
     """Manage credentials."""

@@ -582,7 +582,9 @@ async def _process_result(result: api_pb2.GenericResult, data_format: int, stub,
         raise ExecutionError(result.exception)
     elif result.status != api_pb2.GENERIC_STATUS_SUCCESS:
         if data:
-            exc = deserialize_exception(data, result.exception, result.traceback, client)
+            exc = deserialize_exception(
+                data, result.exception, result.traceback, client, result.serialized_tb
+            )
             raise exc
         raise RemoteError(result.exception or "remote function failed")
 

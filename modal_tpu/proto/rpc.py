@@ -193,6 +193,11 @@ _RPC_NAMES = [
     "EnvironmentCreate",
     "EnvironmentDelete",
     "EnvironmentUpdate",
+    # CLI management surface (ref cli/container.py, cli/cluster.py, cli/image.py)
+    "TaskList",
+    "ClusterList",
+    "ImageList",
+    "ImageDelete",
 ]
 
 

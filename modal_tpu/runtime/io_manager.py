@@ -249,11 +249,12 @@ class ContainerIOManager:
             return api_pb2.GenericResult(
                 status=api_pb2.GENERIC_STATUS_TERMINATED, exception="input cancelled"
             )
-        data, exc_repr, tb_str = serialize_exception(exc)
+        data, exc_repr, tb_str, serialized_tb = serialize_exception(exc)
         return api_pb2.GenericResult(
             status=api_pb2.GENERIC_STATUS_FAILURE,
             exception=exc_repr,
             traceback=tb_str,
+            serialized_tb=serialized_tb,
             data=data,
             data_format=api_pb2.DATA_FORMAT_PICKLE,
         )

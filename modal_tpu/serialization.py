@@ -124,10 +124,16 @@ def deserialize_data_format(s: bytes, data_format: int, client: Any = None) -> A
 # ---------------------------------------------------------------------------
 
 
-def serialize_exception(exc: BaseException) -> tuple[bytes, str, str]:
-    """Returns (pickled_exception, repr, traceback_string). Falls back to a
-    generic ExecutionError when the exception itself doesn't pickle."""
+def serialize_exception(exc: BaseException) -> tuple[bytes, str, str, bytes]:
+    """Returns (pickled_exception, repr, traceback_string, serialized_tb).
+    Falls back to a generic ExecutionError when the exception itself doesn't
+    pickle; serialized_tb (frame summaries for client-side rehydration,
+    reference _traceback.py/tblib) is captured independently so a
+    non-picklable exception still ships its full remote stack."""
+    from ._utils.traceback_utils import serialize_traceback
+
     tb_str = "".join(tb_module.format_exception(type(exc), exc, exc.__traceback__))
+    serialized_tb = serialize_traceback(exc.__traceback__)
     try:
         # Strip traceback/frames (often unpicklable) but keep the exception.
         # Strip on a shallow copy: with_traceback mutates in place and the
@@ -142,16 +148,26 @@ def serialize_exception(exc: BaseException) -> tuple[bytes, str, str]:
     except Exception as ser_exc:
         logger.debug(f"exception {exc!r} failed to serialize: {ser_exc}")
         data = serialize(ExecutionError(repr(exc)))
-    return data, repr(exc), tb_str
+    return data, repr(exc), tb_str, serialized_tb
 
 
-def deserialize_exception(data: bytes, exc_repr: str, tb_str: str, client: Any = None) -> BaseException:
+def deserialize_exception(
+    data: bytes, exc_repr: str, tb_str: str, client: Any = None, serialized_tb: bytes = b""
+) -> BaseException:
+    from ._utils.traceback_utils import deserialize_traceback
+
     try:
         exc = deserialize(data, client)
         if not isinstance(exc, BaseException):
             exc = ExecutionError(exc_repr)
     except Exception:
         exc = ExecutionError(f"{exc_repr} (original exception could not be deserialized)")
+    # Rehydrate the remote stack onto the exception so `raise` shows the user
+    # function's frames (file/line/function, with source when shared), not
+    # just our invocation machinery's.
+    remote_tb = deserialize_traceback(serialized_tb)
+    if remote_tb is not None:
+        exc = exc.with_traceback(remote_tb)
     if tb_str:
         exc.__cause__ = RemoteTraceback(tb_str)
     return exc

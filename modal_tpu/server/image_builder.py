@@ -107,11 +107,30 @@ def _is_trivial(chain: list[api_pb2.Image]) -> bool:
     return True
 
 
+def chain_version(chain: list[api_pb2.Image]) -> str:
+    """The builder epoch a chain is built under: the newest layer's version
+    wins (layers inherit the epoch of the app that created them)."""
+    from ..config import config
+
+    for image in reversed(chain):
+        if image.version:
+            return image.version
+    return config["image_builder_version"]
+
+
 def chain_hash(chain: list[api_pb2.Image]) -> str:
+    from .. import builder as builder_epochs
+
     h = hashlib.sha256()
     for image in chain:
         h.update(image.SerializeToString(deterministic=True))
         h.update(b"\x00")
+    # the epoch's pinned-dep content participates in the key: editing an
+    # epoch file (or switching epochs) rebuilds every image under it
+    try:
+        h.update(builder_epochs.epoch_content_hash(chain_version(chain)).encode())
+    except builder_epochs.UnknownBuilderVersion:
+        pass  # validated loudly at build time; keep hashing total
     return h.hexdigest()[:24]
 
 
@@ -269,7 +288,21 @@ class ImageBuilder:
 
         host = f"{sys.version_info.major}.{sys.version_info.minor}"
         built = BuiltImage(python_bin="", rootfs=rootfs)
+        from .. import builder as builder_epochs
+
         try:
+            # Resolve the builder epoch (reference builder/ versioned
+            # requirement sets): unknown epochs fail the build loudly; the
+            # epoch's base-image config seeds the env and bounds pythons.
+            epoch = chain_version(chain)
+            epoch_cfg = builder_epochs.base_image_config(epoch)  # raises UnknownBuilderVersion
+            log(f"builder epoch {epoch} (content {builder_epochs.epoch_content_hash(epoch)})")
+            if epoch_cfg["python"] and host not in epoch_cfg["python"]:
+                raise ImageBuildError(
+                    f"builder epoch {epoch} supports python {epoch_cfg['python']}, host is {host}",
+                    tail(),
+                )
+            built.env.update(epoch_cfg["tpu_env"])
             # base venv (system-site-packages: host jax/numpy stack available,
             # pip layers shadow/extend it — the local-backend "debian slim")
             log(f"creating venv (python {host}, system-site-packages)")
@@ -366,6 +399,8 @@ class ImageBuilder:
                         continue
                     if cmd.startswith("RUN "):
                         shell_cmd = _rewrite_run(cmd[4:].strip(), built.python_bin)
+                        # bare package names in pip installs get the epoch pin
+                        shell_cmd = builder_epochs.constrain_pip_install(shell_cmd, epoch)
                         await run_shell(shell_cmd, shell_env(), built.workdir)
                         continue
                     raise ImageBuildError(f"unsupported image directive: {cmd}", tail())

@@ -963,7 +963,141 @@ class ModalTPUServicer:
         task = self.s.tasks.get(request.task_id)
         if task is not None:
             task.terminate = True
+            # push the stop to the worker immediately (same channel as
+            # _stop_app) — the terminate flag alone only takes effect at the
+            # container's next poll
+            worker = self.s.workers.get(task.worker_id)
+            if worker is not None:
+                await worker.events.put(
+                    api_pb2.WorkerPollResponse(stop=api_pb2.TaskStopEvent(task_id=task.task_id))
+                )
         return api_pb2.ContainerStopResponse()
+
+    async def TaskList(self, request: api_pb2.TaskListRequest, context) -> api_pb2.TaskListResponse:
+        """Running (and optionally finished) containers across apps
+        (reference `modal container list`, cli/container.py)."""
+        out = []
+        finished_states = (
+            api_pb2.TASK_STATE_COMPLETED,
+            api_pb2.TASK_STATE_FAILED,
+            api_pb2.TASK_STATE_TERMINATED,
+            api_pb2.TASK_STATE_PREEMPTED,
+        )
+        for task in self.s.tasks.values():
+            if not request.include_finished and task.state in finished_states:
+                continue
+            app = self.s.apps.get(task.app_id)
+            if request.environment_name and (
+                app is None or app.environment_name != request.environment_name
+            ):
+                continue
+            fn = self.s.functions.get(task.function_id)
+            out.append(
+                api_pb2.TaskInfo(
+                    task_id=task.task_id,
+                    app_id=task.app_id,
+                    app_description=app.description if app else "",
+                    function_tag=fn.tag if fn else "",
+                    state=task.state,
+                    worker_id=task.worker_id,
+                    created_at=task.created_at,
+                    started_at=task.started_at,
+                    finished_at=task.finished_at,
+                    cluster_id=task.cluster_id,
+                    rank=task.rank,
+                    tpu_chip_ids=list(task.tpu_chip_ids),
+                )
+            )
+        return api_pb2.TaskListResponse(tasks=out)
+
+    async def ClusterList(self, request, context) -> api_pb2.ClusterListResponse:
+        """Live gangs (reference `modal cluster list`, cli/cluster.py)."""
+        out = []
+        for cluster in self.s.clusters.values():
+            fn = self.s.functions.get(cluster.function_id)
+            out.append(
+                api_pb2.ClusterInfo(
+                    cluster_id=cluster.cluster_id,
+                    function_tag=fn.tag if fn else "",
+                    size=cluster.size,
+                    task_ids=list(cluster.task_ids),
+                    topology=(
+                        cluster.slice_info.topology if cluster.slice_info is not None else ""
+                    ),
+                    ranks_reported=len(cluster.reported),
+                )
+            )
+        return api_pb2.ClusterListResponse(clusters=out)
+
+    def _image_refs(self) -> dict[str, int]:
+        """Pin counts for `image prune`: an image is pinned while ANY
+        function or sandbox of a non-stopped app references it (scale-to-zero
+        deployments included — their autoscaler can start a task later), and
+        FROM-chain base images are pinned by their pinned children."""
+        refs: dict[str, int] = {}
+
+        def add_with_parents(image_id: str) -> None:
+            for _ in range(32):  # FROM chains are short; bound anyway
+                if not image_id:
+                    return
+                refs[image_id] = refs.get(image_id, 0) + 1
+                img = self.s.images.get(image_id)
+                if img is None:
+                    return
+                image_id = next(
+                    (
+                        c.strip()[5:].strip()
+                        for c in img.definition.dockerfile_commands
+                        if c.strip().startswith("FROM im-")
+                    ),
+                    "",
+                )
+
+        def app_alive(app_id: str) -> bool:
+            app = self.s.apps.get(app_id)
+            return app is not None and not app.done
+
+        for fn in self.s.functions.values():
+            if fn.definition.image_id and app_alive(fn.app_id):
+                add_with_parents(fn.definition.image_id)
+        for sb in self.s.sandboxes.values():
+            if sb.definition.image_id and sb.state != api_pb2.SANDBOX_STATE_TERMINATED:
+                add_with_parents(sb.definition.image_id)
+        return refs
+
+    async def ImageList(self, request, context) -> api_pb2.ImageListResponse:
+        refs = self._image_refs()
+        out = []
+        for image in self.s.images.values():
+            out.append(
+                api_pb2.ImageInfo(
+                    image_id=image.image_id,
+                    built=image.built,
+                    builder_version=image.metadata.image_builder_version,
+                    python_version=image.metadata.python_version,
+                    created_at=image.created_at,
+                    ref_count=refs.get(image.image_id, 0),
+                )
+            )
+        return api_pb2.ImageListResponse(images=out)
+
+    async def ImageDelete(self, request: api_pb2.ImageDeleteRequest, context) -> api_pb2.ImageDeleteResponse:
+        """`image prune` building block: delete an image RECORD. Refuses
+        pinned images — a record has no rebuild path from its id, so deleting
+        a referenced one would NOT_FOUND every later cold start. The
+        content-addressed venv on disk is shared and untouched."""
+        if request.image_id in self._image_refs():
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"image {request.image_id} is referenced by a live app/sandbox",
+            )
+        self.s.images.pop(request.image_id, None)
+        # keep the content-hash index consistent: a later ImageGetOrCreate of
+        # the same definition must mint a fresh record, not a dangling id
+        for key, image_id in list(self.s.images_by_hash.items()):
+            if image_id == request.image_id:
+                del self.s.images_by_hash[key]
+        return api_pb2.ImageDeleteResponse()
 
     async def ContainerLog(self, request: api_pb2.ContainerLogRequest, context):
         task = self.s.tasks.get(request.task_id)

@@ -223,6 +223,7 @@ class ImageState:
     metadata: api_pb2.ImageMetadata = field(default_factory=api_pb2.ImageMetadata)
     built: bool = False
     build_logs: list[api_pb2.TaskLogs] = field(default_factory=list)
+    created_at: float = field(default_factory=time.time)
 
 
 @dataclass

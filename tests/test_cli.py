@@ -190,3 +190,128 @@ def test_cli_shell_interactive_pty(supervisor):
             proc.kill()
             proc.wait()
         os.close(master)
+
+
+def test_cli_container_list_stop_logs(cli_runner, supervisor):
+    """container list shows a live container; stop kills it; logs backfill."""
+    import time
+
+    import modal_tpu
+
+    app = modal_tpu.App("cli-containers")
+
+    @app.function(serialized=True)
+    def chatty(x):
+        print(f"chatty says {x}")
+        return x
+
+    with app.run():
+        assert chatty.remote(9) == 9
+        out = cli_runner("container", "list")
+        assert "chatty" in out
+        task_id = next(line.split()[0] for line in out.splitlines() if "chatty" in line)
+        # stdout is shipped worker->server asynchronously: poll the backfill
+        logs = ""
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            logs = cli_runner("container", "logs", task_id)
+            if "chatty says 9" in logs:
+                break
+            time.sleep(0.25)
+        assert "chatty says 9" in logs
+        cli_runner("container", "stop", task_id)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            task = supervisor.state.tasks[task_id]
+            if task.finished_at:
+                break
+            time.sleep(0.25)
+        assert supervisor.state.tasks[task_id].finished_at, "stop did not land"
+    # finished containers only show with --all
+    out = cli_runner("container", "list")
+    assert task_id not in out
+    out = cli_runner("container", "list", "--all")
+    assert task_id in out
+
+
+def test_cli_cluster_list(cli_runner, supervisor):
+    """cluster list surfaces a live gang with rendezvous progress."""
+    import modal_tpu
+
+    app = modal_tpu.App("cli-cluster")
+
+    @app.function(serialized=True)
+    @modal_tpu.clustered(size=2)
+    def gang(x):
+        from modal_tpu import get_cluster_info
+
+        return get_cluster_info().rank
+
+    import os
+
+    os.environ["MODAL_TPU_SKIP_JAX_DISTRIBUTED"] = "1"
+    try:
+        with app.run():
+            assert gang.remote(1) in (0, 1)
+            out = cli_runner("cluster", "list")
+            assert "gang" in out
+            assert "size=2" in out
+            assert "ranks_reported=2" in out
+    finally:
+        os.environ.pop("MODAL_TPU_SKIP_JAX_DISTRIBUTED", None)
+
+
+def test_cli_environment_lifecycle(cli_runner):
+    out = cli_runner("environment", "create", "staging")
+    assert "created" in out
+    assert "staging" in cli_runner("environment", "list")
+    out = cli_runner("environment", "rename", "staging", "prod2")
+    assert "renamed" in out
+    listing = cli_runner("environment", "list")
+    assert "prod2" in listing and "staging" not in listing
+    cli_runner("environment", "delete", "prod2", "--yes")
+    assert "prod2" not in cli_runner("environment", "list")
+
+
+def test_cli_image_list_and_prune(cli_runner, supervisor):
+    """Images show up in image list; prune removes only unreferenced ones."""
+    import modal_tpu
+
+    app = modal_tpu.App("cli-image")
+
+    @app.function(serialized=True)
+    def noop(x):
+        return x
+
+    with app.run():
+        assert noop.remote(1) == 1
+        listing = cli_runner("image", "list")
+        assert "im-" in listing
+        # the running container pins its image: prune must not remove it
+        pruned = cli_runner("image", "prune", "--yes")
+        listing_after = cli_runner("image", "list")
+        assert "im-" in listing_after, (pruned, listing_after)
+    # app stopped: wait for the task to actually finish (teardown is async),
+    # then the image is unreferenced and prune removes it
+    import time
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        cli_runner("image", "prune", "--yes")
+        if "im-" not in cli_runner("image", "list"):
+            break
+        time.sleep(0.25)
+    assert "im-" not in cli_runner("image", "list")
+
+
+def test_cli_nfs_alias_matches_volume(cli_runner, tmp_path):
+    """The nfs group is a declared alias of volume commands."""
+    src = tmp_path / "hello.txt"
+    src.write_text("nfs-alias")
+    cli_runner("nfs", "create", "shared-fs")
+    cli_runner("nfs", "put", "shared-fs", str(src), "/hello.txt")
+    out = cli_runner("nfs", "ls", "shared-fs", "/")
+    assert "hello.txt" in out
+    # same store as the volume group
+    out = cli_runner("volume", "ls", "shared-fs", "/")
+    assert "hello.txt" in out

@@ -132,3 +132,82 @@ def test_run_function_build_step(supervisor, tmp_path):
     f = app.function(image=image, serialized=True)(probe)
     with app.run():
         assert f.remote() == "baked"
+
+
+# ---------------------------------------------------------------------------
+# Builder version epochs (reference py/modal/builder/: versioned requirement
+# sets + base-images.json; ours is modal_tpu/builder/)
+# ---------------------------------------------------------------------------
+
+
+def test_builder_epochs_known_and_pinned():
+    from modal_tpu import builder as epochs
+
+    versions = epochs.known_versions()
+    assert "2026.04" in versions and "2026.07" in versions
+    pins = epochs.load_requirements("2026.07")
+    assert pins["jax"].startswith("jax==")
+    assert pins["orbax-checkpoint"].startswith("orbax-checkpoint==")
+    with pytest.raises(epochs.UnknownBuilderVersion):
+        epochs.load_requirements("1999.01")
+
+
+def test_epoch_changes_image_chain_hash():
+    """Same image definition under two epochs hashes differently — the pin
+    set participates in the content address, so epoch bumps rebuild."""
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.image_builder import chain_hash
+
+    def chain(version):
+        return [api_pb2.Image(dockerfile_commands=["FROM python:3.12"], version=version)]
+
+    h_old, h_new = chain_hash(chain("2026.04")), chain_hash(chain("2026.07"))
+    assert h_old != h_new
+
+
+def test_pip_install_gets_epoch_pin():
+    from modal_tpu.builder import constrain_pip_install
+
+    out = constrain_pip_install("/v/bin/python -m pip install einops requests", "2026.07")
+    assert "einops==0.8.2" in out
+    assert "requests" in out and "requests==" not in out  # unpinned passes through
+    # explicit constraints are the user's business
+    out = constrain_pip_install("/v/bin/python -m pip install einops==0.7.0", "2026.07")
+    assert "einops==0.7.0" in out
+
+
+def test_unknown_epoch_fails_build_loudly(supervisor, monkeypatch):
+    import modal_tpu
+
+    # the client's configured epoch stamps every image layer (image.py _load)
+    monkeypatch.setenv("MODAL_TPU_IMAGE_BUILDER_VERSION", "1999.01")
+    image = modal_tpu.Image.debian_slim().env({"X": "1"})
+    app = modal_tpu.App("img-bad-epoch")
+
+    @app.function(image=image, serialized=True)
+    def probe(x):
+        return x
+
+    with app.run():
+        with pytest.raises(Exception, match="1999.01|unknown image builder|init"):
+            probe.remote(1)
+
+
+def test_epoch_env_lands_in_container(supervisor, tmp_path):
+    """The epoch's base tpu_env is applied to built images (a real layer
+    forces a build; trivial chains run the host venv untouched)."""
+    import modal_tpu
+
+    image = modal_tpu.Image.debian_slim().env({"IMG_MARK": "1"})
+    app = modal_tpu.App("img-epoch-env")
+
+    def read_env():
+        import os
+
+        return os.environ.get("JAX_COMPILATION_CACHE_DIR", ""), os.environ.get("IMG_MARK")
+
+    f = app.function(image=image, serialized=True)(read_env)
+    with app.run():
+        cache_dir, mark = f.remote()
+    assert mark == "1"
+    assert cache_dir  # from builder/base_images.json tpu_env for the epoch

@@ -1,0 +1,118 @@
+"""Remote traceback rehydration: `f.remote()` failures re-raise with the
+remote stack's frames attached (reference _traceback.py + vendored tblib —
+ours is an independent frame-synthesis implementation,
+modal_tpu/_utils/traceback_utils.py)."""
+
+from __future__ import annotations
+
+import traceback
+
+import pytest
+
+
+def test_capture_rebuild_roundtrip():
+    from modal_tpu._utils.traceback_utils import (
+        capture_traceback_frames,
+        deserialize_traceback,
+        serialize_traceback,
+    )
+
+    def inner():
+        raise ValueError("boom")
+
+    def outer():
+        inner()
+
+    try:
+        outer()
+    except ValueError as exc:
+        tb = exc.__traceback__
+
+    frames = capture_traceback_frames(tb)
+    names = [f["name"] for f in frames]
+    assert names == ["test_capture_rebuild_roundtrip", "outer", "inner"]
+
+    rebuilt = deserialize_traceback(serialize_traceback(tb))
+    assert rebuilt is not None
+    summary = traceback.extract_tb(rebuilt)
+    assert [s.name for s in summary] == names
+    assert [s.lineno for s in summary] == [f["lineno"] for f in frames]
+    assert all(s.filename == __file__ for s in summary)
+    # the source file exists locally, so the actual source line is rendered
+    rendered = "".join(traceback.format_tb(rebuilt))
+    assert 'raise ValueError("boom")' in rendered
+
+
+def test_serialize_exception_carries_frames():
+    from modal_tpu.serialization import deserialize_exception, serialize_exception
+
+    def user_fn():
+        raise RuntimeError("remote failure")
+
+    try:
+        user_fn()
+    except RuntimeError as exc:
+        data, exc_repr, tb_str, serialized_tb = serialize_exception(exc)
+
+    assert serialized_tb
+    rebuilt = deserialize_exception(data, exc_repr, tb_str, None, serialized_tb)
+    assert isinstance(rebuilt, RuntimeError)
+    frames = traceback.extract_tb(rebuilt.__traceback__)
+    assert any(f.name == "user_fn" for f in frames)
+
+
+def test_nonpicklable_exception_still_ships_stack():
+    """The exception body may refuse to pickle (holds a socket/lock); the
+    stack must still rehydrate on the fallback ExecutionError."""
+    import socket
+
+    from modal_tpu.exception import ExecutionError
+    from modal_tpu.serialization import deserialize_exception, serialize_exception
+
+    class Unpicklable(Exception):
+        def __init__(self):
+            super().__init__("holds a live socket")
+            self.sock = socket.socket()  # refuses to pickle
+
+        def __reduce__(self):
+            raise TypeError("cannot pickle")
+
+    def doomed():
+        raise Unpicklable()
+
+    try:
+        doomed()
+    except Unpicklable as exc:
+        data, exc_repr, tb_str, serialized_tb = serialize_exception(exc)
+        exc.sock.close()
+
+    rebuilt = deserialize_exception(data, exc_repr, tb_str, None, serialized_tb)
+    assert isinstance(rebuilt, ExecutionError)  # pickling fell back
+    frames = traceback.extract_tb(rebuilt.__traceback__)
+    assert any(f.name == "doomed" for f in frames)  # ...but the stack survived
+
+
+def test_remote_call_reraises_with_user_frame(supervisor):
+    """End to end through the real stack: the client-side raise carries the
+    container-side user function's frame."""
+    import modal_tpu
+
+    app = modal_tpu.App("tb-test")
+
+    @app.function(serialized=True)
+    def exploding(x):
+        def deep_helper(y):
+            raise ValueError(f"exploded on {y}")
+
+        return deep_helper(x)
+
+    with app.run():
+        with pytest.raises(ValueError, match="exploded on 7") as excinfo:
+            exploding.remote(7)
+
+    frames = traceback.extract_tb(excinfo.value.__traceback__)
+    names = [f.name for f in frames]
+    assert "exploding" in names, names
+    assert "deep_helper" in names, names
+    # the formatted traceback text cause is preserved as well
+    assert "exploded on 7" in str(excinfo.value.__cause__)
