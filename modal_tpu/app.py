@@ -186,6 +186,7 @@ class _App:
         name: Optional[str] = None,
         i6pn: bool = False,
         runtime_debug: bool = False,
+        payload_format: str = "pickle",
         experimental_options: Optional[dict[str, str]] = None,
     ) -> Callable[[Union[Callable, _PartialFunction]], _Function]:
         """Register a function with this app (reference app.py:778).
@@ -195,6 +196,8 @@ class _App:
         """
         if _warn_parentheses_missing is not None:
             raise InvalidError("Did you forget parentheses? Use @app.function().")
+        if payload_format not in ("pickle", "cbor"):
+            raise InvalidError(f"payload_format must be 'pickle' or 'cbor', got {payload_format!r}")
 
         def wrapper(f: Union[Callable, _PartialFunction]) -> _Function:
             nonlocal is_generator
@@ -240,6 +243,7 @@ class _App:
                 cloud=cloud,
                 enable_memory_snapshot=enable_memory_snapshot,
                 restrict_output=restrict_output,
+                payload_format=payload_format,
                 experimental_options={
                     # runtime_debug rides experimental_options like the
                     # reference's perf knobs (api.proto:1863,1944): each

@@ -40,7 +40,13 @@ from .partial_function import _PartialFunction, _PartialFunctionFlags
 from .proto import api_pb2
 from .retries import Retries, RetryManager
 from .schedule import Schedule, SchedulerPlacement
-from .serialization import deserialize, deserialize_data_format, deserialize_exception, serialize
+from .serialization import (
+    deserialize,
+    deserialize_data_format,
+    deserialize_exception,
+    serialize,
+    serialize_data_format,
+)
 from .tpu_config import TPUSliceSpec, parse_tpu_config
 
 def build_function_options(
@@ -130,6 +136,10 @@ class _FunctionSpec:
     cloud: Optional[str] = None
     enable_memory_snapshot: bool = False
     restrict_output: bool = False
+    # "pickle" (rich Python payloads) or "cbor" (cross-language wire format,
+    # reference _serialization.py:359) — negotiated per-input, echoed on
+    # results by the container
+    payload_format: str = "pickle"
     experimental_options: dict[str, str] = field(default_factory=dict)
 
     def resources_proto(self) -> api_pb2.Resources:
@@ -397,6 +407,13 @@ class _Function(_Object, type_prefix="fu"):
         return self._spec
 
     @property
+    def _data_format(self) -> int:
+        """Wire format this handle's inputs are serialized with."""
+        if self._spec is not None and self._spec.payload_format == "cbor":
+            return api_pb2.DATA_FORMAT_CBOR
+        return api_pb2.DATA_FORMAT_PICKLE
+
+    @property
     def is_generator(self) -> bool:
         return bool(self._is_generator)
 
@@ -556,12 +573,24 @@ class _Function(_Object, type_prefix="fu"):
 
 
 async def _create_input(
-    args: tuple, kwargs: dict, stub, *, idx: int = 0, method_name: str = ""
+    args: tuple,
+    kwargs: dict,
+    stub,
+    *,
+    idx: int = 0,
+    method_name: str = "",
+    data_format: int = api_pb2.DATA_FORMAT_PICKLE,
 ) -> api_pb2.FunctionPutInputsItem:
     """Serialize (args, kwargs); offload to blob store over the inline limit
-    (reference _create_input, _functions.py)."""
-    data = serialize((args, kwargs))
-    input_pb = api_pb2.FunctionInput(data_format=api_pb2.DATA_FORMAT_PICKLE, method_name=method_name)
+    (reference _create_input, _functions.py). data_format is negotiated
+    per-input: the container deserializes by this format and echoes it on
+    the result (reference _serialization.py:359 — CBOR is how non-Python
+    SDKs call deployed functions)."""
+    if data_format == api_pb2.DATA_FORMAT_CBOR:
+        data = serialize_data_format([list(args), kwargs], data_format)
+    else:
+        data = serialize((args, kwargs))
+    input_pb = api_pb2.FunctionInput(data_format=data_format, method_name=method_name)
     if len(data) > MAX_OBJECT_SIZE_BYTES:
         input_pb.args_blob_id = await blob_upload(data, stub)
     else:
@@ -612,7 +641,13 @@ class _Invocation:
         method_name: str = "",
     ) -> "_Invocation":
         stub = client.stub
-        item = await _create_input(args, kwargs, stub, method_name=method_name or function._use_method_name)
+        item = await _create_input(
+            args,
+            kwargs,
+            stub,
+            method_name=method_name or function._use_method_name,
+            data_format=function._data_format,
+        )
         request = api_pb2.FunctionMapRequest(
             function_id=function.object_id,
             function_call_type=api_pb2.FUNCTION_CALL_TYPE_UNARY,
@@ -719,7 +754,11 @@ class _InputPlaneInvocation:
     ) -> "_InputPlaneInvocation":
         stub = await client.get_stub(client.input_plane_url)
         item = await _create_input(
-            args, kwargs, client.stub, method_name=method_name or function._use_method_name
+            args,
+            kwargs,
+            client.stub,
+            method_name=method_name or function._use_method_name,
+            data_format=function._data_format,
         )
         metadata = await client.get_input_plane_metadata()
         response = await retry_transient_errors(

@@ -261,7 +261,12 @@ async def _map_invocation(
             async with aclosing(raw_input_gen) as gen:
                 async for args, kwargs in gen:
                     item = await _create_input(
-                        args, kwargs, stub, idx=idx, method_name=function._use_method_name
+                        args,
+                        kwargs,
+                        stub,
+                        idx=idx,
+                        method_name=function._use_method_name,
+                        data_format=function._data_format,
                     )
                     nbytes = len(item.input.args) if item.input.WhichOneof("args_oneof") == "args" else 64
                     if budget is not None:

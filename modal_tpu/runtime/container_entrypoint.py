@@ -158,8 +158,11 @@ async def call_user_code(service: Service, ctx: IOContext, io: ContainerIOManage
                         f"@batched function must return a list with one item per input "
                         f"({len(ctx.input_ids)} inputs, got {type(value).__name__})"
                     )
-                return [await io.format_result(v) for v in value]
-            return [await io.format_result(value)]
+                return [
+                    await io.format_result(v, ctx.data_format or api_pb2.DATA_FORMAT_PICKLE)
+                    for v in value
+                ]
+            return [await io.format_result(value, ctx.data_format or api_pb2.DATA_FORMAT_PICKLE)]
     except BaseException as exc:  # noqa: BLE001 — every failure becomes a result
         if isinstance(exc, (KeyboardInterrupt, SystemExit)):
             raise

@@ -41,6 +41,9 @@ class IOContext:
     retry_counts: list[int]
     inputs: list[tuple[tuple, dict]]  # deserialized (args, kwargs) per input
     method_name: str = ""
+    # per-input wire format (pickle/cbor), echoed on results so a CBOR
+    # caller gets a CBOR answer (reference _serialization.py:359)
+    data_format: int = 0  # api_pb2.DATA_FORMAT_* (0 = unspecified -> pickle)
     _cancelled: bool = False
 
     @property
@@ -176,15 +179,27 @@ class ContainerIOManager:
                 # deserialize up front (blob-aware)
                 ctx_inputs: list[tuple[tuple, dict]] = []
                 method_name = ""
+                ctx_format = api_pb2.DATA_FORMAT_PICKLE
                 for item in items:
                     raw = item.input.args
                     if item.input.args_blob_id:
                         from .._utils.blob_utils import blob_download
 
                         raw = await blob_download(item.input.args_blob_id, self.stub)
-                    args, kwargs = deserialize(raw, self.client) if raw else ((), {})
+                    fmt = item.input.data_format or api_pb2.DATA_FORMAT_PICKLE
+                    if not raw:
+                        args, kwargs = (), {}
+                    elif fmt == api_pb2.DATA_FORMAT_CBOR:
+                        # cross-language convention: [args array, kwargs map]
+                        from ..serialization import deserialize_data_format
+
+                        payload = deserialize_data_format(raw, fmt, self.client)
+                        args, kwargs = tuple(payload[0]), dict(payload[1])
+                    else:
+                        args, kwargs = deserialize(raw, self.client)
                     ctx_inputs.append((args, kwargs))
                     method_name = item.input.method_name or method_name
+                    ctx_format = fmt
                 ctx = IOContext(
                     input_ids=[i.input_id for i in items],
                     function_call_ids=[i.function_call_id for i in items],
@@ -192,6 +207,7 @@ class ContainerIOManager:
                     retry_counts=[i.retry_count for i in items],
                     inputs=ctx_inputs,
                     method_name=method_name,
+                    data_format=ctx_format,
                 )
                 self.current_input_ids |= set(ctx.input_ids)
                 slot_held = False  # transferred to the runner
@@ -236,7 +252,12 @@ class ContainerIOManager:
         self.input_slots.release()
 
     async def format_result(self, value: Any, data_format: int = api_pb2.DATA_FORMAT_PICKLE) -> api_pb2.GenericResult:
-        data = serialize(value)
+        if data_format == api_pb2.DATA_FORMAT_CBOR:
+            from ..serialization import serialize_data_format
+
+            data = serialize_data_format(value, data_format)
+        else:
+            data = serialize(value)
         result = api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS, data_format=data_format)
         if len(data) > MAX_OBJECT_SIZE_BYTES:
             result.data_blob_id = await blob_upload(data, self.stub)

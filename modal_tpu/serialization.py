@@ -95,6 +95,10 @@ def deserialize(s: bytes, client: Any = None) -> Any:
 def serialize_data_format(obj: Any, data_format: int) -> bytes:
     if data_format == api_pb2.DATA_FORMAT_PICKLE:
         return serialize(obj)
+    elif data_format == api_pb2.DATA_FORMAT_CBOR:
+        from ._utils import cbor
+
+        return cbor.dumps(obj)
     elif data_format == api_pb2.DATA_FORMAT_MSGPACK:
         import msgpack
 
@@ -109,6 +113,10 @@ def serialize_data_format(obj: Any, data_format: int) -> bytes:
 def deserialize_data_format(s: bytes, data_format: int, client: Any = None) -> Any:
     if data_format in (api_pb2.DATA_FORMAT_PICKLE, api_pb2.DATA_FORMAT_UNSPECIFIED):
         return deserialize(s, client)
+    elif data_format == api_pb2.DATA_FORMAT_CBOR:
+        from ._utils import cbor
+
+        return cbor.loads(s)
     elif data_format == api_pb2.DATA_FORMAT_MSGPACK:
         import msgpack
 
