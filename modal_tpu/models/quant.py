@@ -80,21 +80,37 @@ def qembed(embed: Any, tokens: jax.Array) -> jax.Array:
     return embed[tokens]
 
 
-def init_params_quantized(cfg, key: jax.Array) -> dict:
+def init_params_quantized(cfg, key: jax.Array, fast_host_init: bool = False) -> dict:
     """Random int8 params created DIRECTLY in quantized form — no bf16
     staging, so an 8B model initializes on a 16 GB chip that could never
     hold the bf16 tree (used by throughput benches; real weights arrive via
-    checkpoint.load + quantize_params)."""
+    checkpoint.load + quantize_params).
+
+    fast_host_init: fill int8 weights by tiling a small numpy random block
+    instead of jax.random.randint — counter-based RNG for 8e9 int8 values
+    takes minutes on a single CPU core, which is exactly where the
+    chip-unreachable 8B smoke runs (bench.py smoke8b_main). Values still
+    span the int8 range; only their statistical independence is reduced,
+    which throughput/memory smokes don't care about."""
     from .llama import init_params_abstract
 
     abstract = init_params_abstract(cfg)
+    if fast_host_init:
+        import numpy as np
+
+        host_tile = np.random.default_rng(0).integers(-127, 128, size=1 << 20, dtype=np.int8)
 
     def make(path_key: str, spec):
         if path_key in _WEIGHT_KEYS and len(spec.shape) >= 2:
             import zlib
 
-            kq = jax.random.fold_in(key, zlib.crc32(path_key.encode()))
-            q = jax.random.randint(kq, spec.shape, -127, 128, dtype=jnp.int8)
+            if fast_host_init:
+                size = int(np.prod(spec.shape))
+                reps = -(-size // host_tile.size)
+                q = jnp.asarray(np.tile(host_tile, reps)[:size].reshape(spec.shape))
+            else:
+                kq = jax.random.fold_in(key, zlib.crc32(path_key.encode()))
+                q = jax.random.randint(kq, spec.shape, -127, 128, dtype=jnp.int8)
             s_shape = spec.shape[:-2] + (1, spec.shape[-1])
             return {"q": q, "s": jnp.full(s_shape, 0.01, jnp.bfloat16)}
         return jnp.ones(spec.shape, spec.dtype)

@@ -116,6 +116,31 @@ def _maybe_profile():
     return _guarded()
 
 
+async def _call_sync(callable_: Any, args: tuple, kwargs: dict, ctx: IOContext, io: ContainerIOManager) -> Any:
+    """Run a sync user callable cancellable-by-signal when possible.
+
+    First choice: the main-thread executor (SIGUSR1 → InputCancellation can
+    interrupt it even inside a blocking C call — reference
+    _container_entrypoint.py:194-264). When the main thread is already busy
+    with another input (concurrency > 1) or no executor exists (tests driving
+    main_async directly), fall back to asyncio.to_thread — cancellable only
+    at the await, exactly the reference's behavior for its extra-thread
+    inputs."""
+    from .main_thread_exec import get_executor
+
+    executor = get_executor()
+    if executor is not None and executor.idle():
+        job = executor.submit(callable_, *args, **kwargs)
+        for iid in ctx.input_ids:
+            io._mt_jobs[iid] = job
+        try:
+            return await asyncio.wrap_future(job.future)
+        finally:
+            for iid in ctx.input_ids:
+                io._mt_jobs.pop(iid, None)
+    return await asyncio.to_thread(callable_, *args, **kwargs)
+
+
 async def call_user_code(service: Service, ctx: IOContext, io: ContainerIOManager) -> list[api_pb2.GenericResult]:
     """Run one IOContext (single input or batch) to results (reference
     call_function, _container_entrypoint.py:114)."""
@@ -150,7 +175,7 @@ async def call_user_code(service: Service, ctx: IOContext, io: ContainerIOManage
                 if inspect.iscoroutinefunction(callable_):
                     value = await callable_(*args, **kwargs)
                 else:
-                    value = await asyncio.to_thread(callable_, *args, **kwargs)
+                    value = await _call_sync(callable_, args, kwargs, ctx, io)
             io.note_call_time(time.monotonic() - t0)
             if ctx.is_batch:
                 if not isinstance(value, (list, tuple)) or len(value) != len(ctx.input_ids):
@@ -407,6 +432,7 @@ def main() -> None:
     import signal
 
     from .._utils.async_utils import synchronizer
+    from .main_thread_exec import MainThreadExecutor, set_executor
 
     loop = synchronizer._ensure_loop()
     task_holder: dict = {}
@@ -420,6 +446,13 @@ def main() -> None:
 
     signal.signal(signal.SIGTERM, _handle_term)
 
+    # Cancellable sync inputs: the asyncio machinery lives on the
+    # synchronizer's daemon thread, leaving THIS (main) thread free to host
+    # sync user code where SIGUSR1 → InputCancellation can reach it.
+    executor = MainThreadExecutor()
+    executor.install_signal_handler()
+    set_executor(executor)
+
     async def _runner() -> int:
         task = asyncio.ensure_future(main_async())
         task_holder["task"] = task
@@ -431,7 +464,15 @@ def main() -> None:
         except asyncio.CancelledError:
             return 0  # graceful termination already reported via TaskResult
 
-    sys.exit(synchronizer.run(_runner()))
+    cf = asyncio.run_coroutine_threadsafe(_runner(), loop)
+    try:
+        executor.run_until(cf)
+    except KeyboardInterrupt:
+        cf.cancel()
+        raise
+    finally:
+        set_executor(None)
+    sys.exit(cf.result())
 
 
 if __name__ == "__main__":

@@ -83,6 +83,9 @@ class ContainerIOManager:
         self.current_input_ids: set[str] = set()
         self.cancelled_input_ids: set[str] = set()
         self._running_tasks: dict[str, asyncio.Task] = {}
+        # input_id -> main-thread executor job (sync inputs only): cancelled
+        # via SIGUSR1 instead of task.cancel (container_entrypoint._call_sync)
+        self._mt_jobs: dict[str, Any] = {}
         self.terminate = False
         self._waiting_for_checkpoint = False
         self.heartbeat_condition = asyncio.Condition()
@@ -125,11 +128,27 @@ class ContainerIOManager:
             await asyncio.sleep(max(1.0, interval))
 
     def _cancel_inputs(self, input_ids: set[str]) -> None:
-        """Cancel running/pending inputs (reference IOContext.cancel →
-        SIGUSR1/task.cancel; here: asyncio cancellation of the input task)."""
-        for input_id in input_ids & set(self._running_tasks.keys()):
-            logger.debug(f"cancelling input {input_id}")
-            self._running_tasks[input_id].cancel()
+        """Cancel running/pending inputs (reference IOContext.cancel,
+        _container_entrypoint.py:194-264): sync inputs on the main-thread
+        executor get SIGUSR1 → InputCancellation raised INSIDE the running
+        frame (interrupts even a blocking time.sleep); everything else gets
+        asyncio task cancellation. A delayed task.cancel backstops the signal
+        path in case user code swallows BaseException and keeps running."""
+        from .main_thread_exec import get_executor
+
+        executor = get_executor()
+        loop = asyncio.get_running_loop()
+        for input_id in input_ids:
+            job = self._mt_jobs.get(input_id)
+            task = self._running_tasks.get(input_id)
+            if job is not None and executor is not None:
+                logger.debug(f"cancelling sync input {input_id} via SIGUSR1")
+                executor.cancel(job)
+                if task is not None:
+                    loop.call_later(5.0, task.cancel)  # no-op if already done
+            elif task is not None:
+                logger.debug(f"cancelling input {input_id}")
+                task.cancel()
         self.cancelled_input_ids |= input_ids
 
     # -- input loop ---------------------------------------------------------
@@ -170,7 +189,7 @@ class ContainerIOManager:
                     if (
                         time.monotonic() - idle_since > scaledown
                         and not self.current_input_ids
-                        and self._min_containers_satisfied()
+                        and not resp.scaledown_blocked
                     ):
                         logger.debug(f"idle for {scaledown}s; scaling down")
                         return
@@ -215,11 +234,6 @@ class ContainerIOManager:
             finally:
                 if slot_held:
                     self.input_slots.release()
-
-    def _min_containers_satisfied(self) -> bool:
-        # v0: always allow scaledown; min_containers is re-satisfied by the
-        # control-plane autoscaler relaunching.
-        return True
 
     _function_id: str = ""
 

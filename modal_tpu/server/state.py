@@ -100,6 +100,10 @@ class FunctionState:
     bound_parent: Optional[str] = None  # parametrized variant parent id
     serialized_params: bytes = b""
     autoscaler_override: Optional[api_pb2.AutoscalerSettings] = None
+    # EWMA of per-call wall time, as reported by containers on
+    # FunctionGetInputs (io_manager.note_call_time) — shapes the autoscaler's
+    # drain-time estimate (reference autoscaler surface app.py:778)
+    reported_call_time: float = 0.0
 
     @property
     def autoscaler(self) -> api_pb2.AutoscalerSettings:

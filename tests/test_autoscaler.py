@@ -1,0 +1,142 @@
+"""Autoscaler fidelity (VERDICT r4 #4): concurrency-aware sizing, warm
+min_containers, concurrent gangs.
+
+Reference surface being matched: app.py:778 (autoscaler knobs) +
+container_io_manager.py:845 (input concurrency / scaledown cooperation).
+"""
+
+import os
+import time
+
+import pytest
+
+
+def _live_task_count(supervisor, fn_tag_suffix=""):
+    from modal_tpu.proto import api_pb2
+
+    live_states = (
+        api_pb2.TASK_STATE_QUEUED,
+        api_pb2.TASK_STATE_WORKER_ASSIGNED,
+        api_pb2.TASK_STATE_CREATED,
+        api_pb2.TASK_STATE_ACTIVE,
+        api_pb2.TASK_STATE_IDLE,
+    )
+    return sum(1 for t in supervisor.state.tasks.values() if t.state in live_states)
+
+
+def test_concurrency_aware_container_count(supervisor):
+    """N pending inputs on a function with max_concurrent_inputs=C must spawn
+    ceil(N/C) containers, not N (r4: 100 inputs at concurrency 50 spawned the
+    8-container cap instead of 2)."""
+    import modal_tpu
+
+    app = modal_tpu.App("scale-conc")
+
+    @app.function(serialized=True)
+    @modal_tpu.concurrent(max_inputs=4)
+    def f(x):
+        import time as _t
+
+        _t.sleep(3)  # long enough that the backlog is visible to the scheduler
+        return x * 2
+
+    with app.run():
+        assert sorted(f.map(range(8))) == [x * 2 for x in range(8)]
+        fn_state = list(supervisor.state.functions.values())[-1]
+        # ceil(8/4) = 2 containers; allow the odd race but never near 8
+        assert len(fn_state.task_ids) <= 3, (
+            f"expected ~2 containers for 8 inputs @ concurrency 4, got {len(fn_state.task_ids)}"
+        )
+
+
+def test_min_containers_stays_warm_through_idle(supervisor):
+    """min_containers=1 with a 1s scaledown window: the container must
+    survive idle (scaledown_blocked from the server) and serve the next call
+    from the same process — no second cold start (r4: containers scaled to
+    zero below min_containers)."""
+    import modal_tpu
+
+    app = modal_tpu.App("scale-minwarm")
+
+    def pid_of(x):
+        import os as _os
+
+        return x, _os.getpid()
+
+    f = app.function(serialized=True, min_containers=1, scaledown_window=1)(pid_of)
+    with app.run():
+        _, pid1 = f.remote(1)
+        time.sleep(4)  # several scaledown windows + GetInputs long-poll laps
+        _, pid2 = f.remote(2)
+        assert pid1 == pid2, "min_containers=1 container was drained during idle"
+
+
+def test_scale_to_zero_without_min_containers(supervisor):
+    """The inverse guard: min_containers=0 functions still drain after the
+    scaledown window (scaledown_blocked must default False)."""
+    import modal_tpu
+    from modal_tpu.proto import api_pb2
+
+    app = modal_tpu.App("scale-tozero")
+
+    def fast(x):
+        return x
+
+    f = app.function(serialized=True, scaledown_window=1)(fast)
+    with app.run():
+        assert f.remote(1) == 1
+        fn_state = list(supervisor.state.functions.values())[-1]
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            live = [
+                t
+                for t in fn_state.task_ids
+                if supervisor.state.tasks[t].state
+                in (api_pb2.TASK_STATE_CREATED, api_pb2.TASK_STATE_ACTIVE, api_pb2.TASK_STATE_IDLE)
+            ]
+            if not live:
+                break
+            time.sleep(0.5)
+        assert not live, "scaledown_window=1 container never drained"
+
+
+def test_two_gangs_run_concurrently(supervisor):
+    """Two pending calls on a @clustered function must get two concurrent
+    gangs when capacity allows (r4: the v0 one-gang-ever policy serialized
+    every clustered call behind the first)."""
+    import modal_tpu
+
+    app = modal_tpu.App("gang-parallel")
+
+    @app.function(serialized=True, timeout=60)
+    @modal_tpu.clustered(size=2)
+    def slow_gang(tag):
+        import time as _t
+
+        from modal_tpu import get_cluster_info
+
+        _t.sleep(4)
+        return {"tag": tag, "rank": get_cluster_info().rank}
+
+    os.environ["MODAL_TPU_SKIP_JAX_DISTRIBUTED"] = "1"
+    try:
+        with app.run():
+            c1 = slow_gang.spawn("a")
+            c2 = slow_gang.spawn("b")
+            # while both are executing, two distinct clusters must be live
+            saw_two = False
+            deadline = time.monotonic() + 25
+            while time.monotonic() < deadline and not saw_two:
+                clusters = [
+                    c
+                    for c in supervisor.state.clusters.values()
+                    if len(c.task_ids) == 2
+                ]
+                saw_two = len(clusters) >= 2
+                time.sleep(0.3)
+            r1 = c1.get(timeout=40)
+            r2 = c2.get(timeout=40)
+            assert saw_two, "second gang never launched while the first was running"
+            assert {r1["tag"], r2["tag"]} == {"a", "b"}
+    finally:
+        os.environ.pop("MODAL_TPU_SKIP_JAX_DISTRIBUTED", None)

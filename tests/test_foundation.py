@@ -68,8 +68,8 @@ def test_serialize_exception_roundtrip():
     try:
         raise ValueError("boom")
     except ValueError as exc:
-        data, exc_repr, tb_str = serialize_exception(exc)
-    restored = deserialize_exception(data, exc_repr, tb_str)
+        data, exc_repr, tb_str, serialized_tb = serialize_exception(exc)
+    restored = deserialize_exception(data, exc_repr, tb_str, serialized_tb=serialized_tb)
     assert isinstance(restored, ValueError)
     assert "boom" in str(restored)
     assert "test_foundation" in restored.__cause__.tb
