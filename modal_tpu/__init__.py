@@ -72,6 +72,7 @@ __all__ = [
     "NetworkFileSystem",
     "CloudBucketMount",
     "Period",
+    "Proxy",
     "Queue",
     "Retries",
     "Sandbox",
@@ -115,6 +116,10 @@ def __getattr__(name: str):
         from .queue import Queue
 
         return Queue
+    if name == "Proxy":
+        from .proxy import Proxy
+
+        return Proxy
     if name == "Sandbox":
         try:
             from .sandbox import Sandbox

@@ -164,6 +164,7 @@ class _App:
         secrets: Sequence[_Secret] = (),
         volumes: dict[str, Any] = {},
         mounts: Sequence[Any] = (),
+        proxy: Optional[Any] = None,
         tpu: Optional[str] = None,
         mesh: Optional[dict[str, int]] = None,
         cpu: Optional[float] = None,
@@ -219,6 +220,7 @@ class _App:
                 secrets=[*self._secrets, *secrets],
                 volumes={**self._volumes, **volumes},
                 mounts=list(mounts),
+                proxy=proxy,
                 tpu=parse_tpu_config(params.tpu_slice or tpu, mesh),
                 cpu=cpu,
                 memory=memory,
@@ -237,6 +239,7 @@ class _App:
                 cluster_size=params.cluster_size or 0,
                 broadcast_inputs=params.broadcast_inputs,
                 fabric_size=params.fabric_size or 0,
+                require_single_slice=params.require_single_slice,
                 i6pn=i6pn,
                 schedule=schedule,
                 scheduler_placement=placement,

@@ -10,6 +10,7 @@ import inspect
 import json
 import os
 import sys
+import time
 from typing import Optional
 
 import click
@@ -533,6 +534,50 @@ def secret_delete(name: str) -> None:
     click.echo(f"deleted secret {name}")
 
 
+# ---------------------------------------------------------------------------
+# proxy (static egress; reference proxy.py:1 — dashboard-provisioned there,
+# CLI-provisioned here)
+# ---------------------------------------------------------------------------
+
+
+@cli.group("proxy")
+def proxy_group() -> None:
+    """Manage static-egress proxies."""
+
+
+@proxy_group.command("create")
+@click.argument("name")
+def proxy_create(name: str) -> None:
+    from ..proxy import Proxy
+
+    p = Proxy.create(name)
+    click.echo(f"created proxy {name} ({p.object_id})")
+
+
+@proxy_group.command("list")
+def proxy_list() -> None:
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(c.stub.ProxyList, api_pb2.ProxyListRequest())
+
+    resp = synchronizer.run(go(client))
+    for p in resp.proxies:
+        click.echo(f"{p.proxy_id}  {p.proxy_ip:<15}  {p.name}")
+
+
+@proxy_group.command("delete")
+@click.argument("name")
+def proxy_delete(name: str) -> None:
+    from ..proxy import Proxy
+
+    Proxy.delete(name)
+    click.echo(f"deleted proxy {name}")
+
+
 @cli.group("dict")
 def dict_group() -> None:
     """Manage dicts."""
@@ -912,6 +957,56 @@ def token_group() -> None:
 def token_set(token_id: str, token_secret: str, profile: Optional[str]) -> None:
     _store_user_config({"token_id": token_id, "token_secret": token_secret}, profile)
     click.echo("token stored")
+
+
+@token_group.command("new")
+@click.option("--profile", default=None)
+@click.option("--no-browser", is_flag=True, help="print the auth URL instead of opening a browser")
+@click.option("--headless", is_flag=True, help="skip the browser leg entirely (local immediate grant)")
+@click.option("--timeout", default=300.0, help="seconds to wait for browser approval")
+def token_new(profile: Optional[str], no_browser: bool, headless: bool, timeout: float) -> None:
+    """Issue new credentials via the browser flow (reference token_flow.py:1):
+    opens the control plane's auth page; the CLI polls until the page is
+    visited with the verification code, then stores the granted token."""
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def create(c):
+        return await retry_transient_errors(c.stub.TokenFlowCreate, api_pb2.TokenFlowCreateRequest())
+
+    flow = synchronizer.run(create(client))
+    use_browser = not headless and flow.web_url.startswith("http")
+    if use_browser:
+        click.echo(f"Complete authentication in your browser:\n  {flow.web_url}")
+        click.echo(f"Verification code: {flow.code}")
+        if not no_browser:
+            import webbrowser
+
+            webbrowser.open(flow.web_url)
+
+    if use_browser and timeout <= 0:
+        raise click.ClickException("--timeout must be > 0 for the browser flow (or pass --headless)")
+
+    async def wait(c):
+        deadline = time.time() + timeout
+        while True:
+            # browser mode must never send timeout=0 — the server reads 0 as
+            # the headless immediate grant, which would skip approval
+            step = min(5.0, max(0.5, deadline - time.time())) if use_browser else 0.0
+            resp = await retry_transient_errors(
+                c.stub.TokenFlowWait,
+                api_pb2.TokenFlowWaitRequest(token_flow_id=flow.token_flow_id, timeout=step),
+            )
+            if not resp.timeout:
+                return resp
+            if time.time() >= deadline:
+                raise click.ClickException("token flow timed out waiting for browser approval")
+
+    resp = synchronizer.run(wait(client))
+    _store_user_config({"token_id": resp.token_id, "token_secret": resp.token_secret}, profile)
+    click.echo(f"token stored for workspace {resp.workspace_name!r}")
 
 
 def main() -> None:

@@ -41,7 +41,7 @@ class _Dict(_Object, type_prefix="di"):
             client.stub.DictGetOrCreate,
             api_pb2.DictGetOrCreateRequest(object_creation_type=api_pb2.OBJECT_CREATION_TYPE_EPHEMERAL),
         )
-        return cls._new_hydrated(resp.dict_id, client, None)
+        return cls._new_hydrated_ephemeral(resp.dict_id, client)
 
     @staticmethod
     async def lookup(name: str, *, client: Optional[_Client] = None, create_if_missing: bool = False) -> "_Dict":

@@ -130,6 +130,9 @@ class _FunctionSpec:
     cluster_size: int = 0
     broadcast_inputs: bool = True
     fabric_size: int = 0
+    # gang placement must stay within one ICI domain (reference rdma /
+    # fabric constraint, api.proto:1922,3262)
+    require_single_slice: bool = False
     i6pn: bool = False
     schedule: Optional[Schedule] = None
     scheduler_placement: Optional[SchedulerPlacement] = None
@@ -141,6 +144,8 @@ class _FunctionSpec:
     # results by the container
     payload_format: str = "pickle"
     experimental_options: dict[str, str] = field(default_factory=dict)
+    # static-egress binding (reference proxy.py:1): a _Proxy object
+    proxy: Optional[Any] = None
 
     def resources_proto(self) -> api_pb2.Resources:
         res = api_pb2.Resources(
@@ -150,6 +155,8 @@ class _FunctionSpec:
         )
         if self.tpu is not None:
             res.tpu_config.CopyFrom(self.tpu.to_proto())
+        if self.require_single_slice:
+            res.tpu_config.require_single_slice = True
         return res
 
     def retry_policy_proto(self) -> Optional[api_pb2.RetryPolicy]:
@@ -217,6 +224,8 @@ class _Function(_Object, type_prefix="fu"):
             deps.extend(spec.secrets)
             deps.extend(v for v in spec.volumes.values() if isinstance(v, _Object))
             deps.extend(m for m in spec.mounts if isinstance(m, _Object))
+            if spec.proxy is not None:
+                deps.append(spec.proxy)
             return deps
 
         async def _load(self: "_Function", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
@@ -282,6 +291,8 @@ class _Function(_Object, type_prefix="fu"):
                 f_def.image_id = spec.image.object_id
             f_def.secret_ids.extend([s.object_id for s in spec.secrets])
             f_def.mount_ids.extend([m.object_id for m in spec.mounts if isinstance(m, _Object)])
+            if spec.proxy is not None:
+                f_def.proxy_id = spec.proxy.object_id
             from .cloud_bucket_mount import CloudBucketMount
 
             for path, vol in spec.volumes.items():

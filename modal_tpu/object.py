@@ -172,6 +172,19 @@ class _Object:
         return obj
 
     @classmethod
+    def _new_hydrated_ephemeral(cls: type[O], object_id: str, client: _Client, metadata: Optional[Any] = None) -> O:
+        """Hydrate an ephemeral object AND keep it alive: a background task
+        heartbeats it (reference _object.py:21 EPHEMERAL_OBJECT_HEARTBEAT_
+        SLEEP) so the server's reaper knows the client still holds it; the
+        object disappears server-side ~TTL after this client exits."""
+        obj = cls._new_hydrated(object_id, client, metadata)
+        obj._ephemeral_heartbeat_task = asyncio.create_task(
+            _ephemeral_heartbeat_loop(client, object_id),
+            name=f"ephemeral-heartbeat-{object_id}",
+        )
+        return obj
+
+    @classmethod
     def _new_hydrated_from_pickle(cls, object_id: str, client: _Client, metadata_bytes: bytes) -> "_Object":
         prefix = object_id.split("-", 1)[0]
         subcls = cls._prefix_to_type.get(prefix)
@@ -326,3 +339,39 @@ class Resolver:
     @property
     def objects(self) -> list[_Object]:
         return [fut.result() for fut in self._local_uuid_to_future.values() if fut.done() and not fut.exception()]
+
+
+async def _ephemeral_heartbeat_loop(client: _Client, object_id: str) -> None:
+    """Keep an ephemeral object alive while this client holds it (reference
+    _object.py:21). Sleeps in short slices so a closed client stops the loop
+    within seconds rather than one full heartbeat period."""
+    from .proto import api_pb2
+
+    from ._utils.grpc_utils import retry_transient_errors
+
+    interval = float(__import__("os").environ.get("MODAL_TPU_EPHEMERAL_HEARTBEAT", "300"))
+    elapsed = 0.0
+    while not client._closed:
+        await asyncio.sleep(min(5.0, interval))
+        elapsed += min(5.0, interval)
+        if elapsed < interval:
+            continue
+        elapsed = 0.0
+        if client._closed:
+            return
+        try:
+            await retry_transient_errors(
+                client.stub.EphemeralObjectHeartbeat,
+                api_pb2.EphemeralObjectHeartbeatRequest(object_id=object_id),
+                max_retries=3,
+            )
+        except Exception as exc:  # noqa: BLE001
+            # NOT_FOUND = the object was deleted: stop for good. Anything
+            # else is transient beyond the retries — keep the loop alive, a
+            # single blip must not doom the object to the reaper.
+            import grpc as _grpc
+
+            if isinstance(exc, _grpc.aio.AioRpcError) and exc.code() == _grpc.StatusCode.NOT_FOUND:
+                logger.debug(f"ephemeral object {object_id} gone; stopping heartbeats")
+                return
+            logger.debug(f"ephemeral heartbeat for {object_id} failed (will retry): {exc}")

@@ -48,6 +48,7 @@ class _PartialFunctionParams:
     broadcast_inputs: bool = True
     tpu_slice: Optional[str] = None  # e.g. "v5p-64": the whole gang's slice
     fabric_size: Optional[int] = None
+    require_single_slice: bool = False  # gang must share one ICI domain
     # web endpoints (reference @modal.asgi_app/wsgi_app/web_endpoint)
     webhook_type: Optional[int] = None  # api_pb2.WebEndpointType
     web_method: Optional[str] = None  # plain-function endpoints: HTTP method
@@ -222,6 +223,7 @@ def clustered(
     broadcast_inputs: bool = True,
     tpu_slice: Optional[str] = None,
     fabric_size: Optional[int] = None,
+    require_single_slice: bool = False,
 ) -> Callable:
     """Gang-schedule `size` containers per input on one TPU pod slice.
 
@@ -243,6 +245,7 @@ def clustered(
             broadcast_inputs=broadcast_inputs,
             tpu_slice=tpu_slice,
             fabric_size=fabric_size,
+            require_single_slice=require_single_slice,
         )
         if isinstance(raw_f, _PartialFunction):
             if not (raw_f.flags & _PartialFunctionFlags.FUNCTION):

@@ -128,6 +128,13 @@ _RPC_NAMES = [
     "SecretGetOrCreate",
     "SecretList",
     "SecretDelete",
+    # Proxies (static egress)
+    "ProxyGet",
+    "ProxyCreate",
+    "ProxyList",
+    "ProxyDelete",
+    # Ephemeral-object liveness
+    "EphemeralObjectHeartbeat",
     # Dicts
     "DictGetOrCreate",
     "DictUpdate",
@@ -163,6 +170,10 @@ _RPC_NAMES = [
     "SandboxSnapshot",
     "SandboxSnapshotGet",
     "SandboxRestore",
+    "SandboxSidecarCreate",
+    "SandboxSidecarList",
+    "SandboxSidecarStop",
+    "SandboxSidecarExit",
     "SandboxGetTunnels",
     "TaskTunnelsUpdate",
     "TaskReady",

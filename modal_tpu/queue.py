@@ -50,7 +50,7 @@ class _Queue(_Object, type_prefix="qu"):
             client.stub.QueueGetOrCreate,
             api_pb2.QueueGetOrCreateRequest(object_creation_type=api_pb2.OBJECT_CREATION_TYPE_EPHEMERAL),
         )
-        return cls._new_hydrated(resp.queue_id, client, None)
+        return cls._new_hydrated_ephemeral(resp.queue_id, client)
 
     @staticmethod
     async def lookup(name: str, *, client: Optional[_Client] = None, create_if_missing: bool = False) -> "_Queue":

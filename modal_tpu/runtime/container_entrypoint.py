@@ -421,6 +421,30 @@ async def main_async() -> int:
     return 0 if exit_status in (api_pb2.GENERIC_STATUS_SUCCESS, api_pb2.GENERIC_STATUS_TERMINATED) else 1
 
 
+def check_thread_leaks() -> list:
+    """Log user threads still alive at container exit (reference
+    _container_entrypoint.py:500-510): a leaked non-daemon thread blocks
+    process exit until the worker's SIGKILL escalation — surface it loudly
+    instead of dying silently. Returns the leaked threads (for tests)."""
+    import threading
+
+    known = {"modal-tpu-synchronizer"}  # our own daemon loop thread
+    leaked = [
+        t
+        for t in threading.enumerate()
+        if t is not threading.main_thread()
+        and t.is_alive()
+        and not t.daemon
+        and t.name not in known
+    ]
+    for t in leaked:
+        logger.warning(
+            f"user code leaked non-daemon thread {t.name!r} still running at "
+            f"container exit — it will block process shutdown until the worker kills it"
+        )
+    return leaked
+
+
 def main() -> None:
     # Run the entrypoint's async main on the synchronizer loop: all SDK
     # coroutines (which the dual-surface wrappers pin to that loop) then run
@@ -472,6 +496,7 @@ def main() -> None:
         raise
     finally:
         set_executor(None)
+        check_thread_leaks()
     sys.exit(cf.result())
 
 

@@ -307,6 +307,14 @@ class _Sandbox(_Object, type_prefix="sb"):
         return _ContainerProcess(router, exec_id, text=text)
 
     @property
+    def _experimental_sidecars(self) -> "_SidecarManager":
+        """Manage sidecar containers attached to this sandbox (reference
+        sandbox.py:2157): auxiliary processes — a database, a helper service —
+        that share the sandbox's filesystem and lifecycle but run their own
+        command, env, and (optionally) image."""
+        return _SidecarManager(self)
+
+    @property
     def fs(self):
         """Typed filesystem API inside the sandbox (reference sandbox_fs.py)."""
         if self._fs is None:
@@ -410,6 +418,95 @@ class _Sandbox(_Object, type_prefix="sb"):
         return list(resp.sandboxes)
 
 
+class _SidecarContainer:
+    """Handle for one sidecar (reference _SidecarContainer, sandbox.py:2680)."""
+
+    def __init__(self, sandbox: "_Sandbox", name: str):
+        self._sandbox = sandbox
+        self.name = name
+
+    async def poll(self) -> Optional[int]:
+        """None while running, else the sidecar's exit code."""
+        resp = await retry_transient_errors(
+            self._sandbox.client.stub.SandboxSidecarList,
+            api_pb2.SandboxSidecarListRequest(sandbox_id=self._sandbox.object_id),
+        )
+        for sc in resp.sidecars:
+            if sc.name == self.name:
+                return None if sc.running else sc.returncode
+        raise NotFoundError(f"sidecar {self.name!r} not found")
+
+    async def wait(self, timeout: float = 60.0) -> int:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            code = await self.poll()
+            if code is not None:
+                return code
+            if _time.monotonic() >= deadline:
+                raise TimeoutError(f"sidecar {self.name!r} still running after {timeout}s")
+            await asyncio.sleep(0.2)
+
+    async def stop(self) -> None:
+        await retry_transient_errors(
+            self._sandbox.client.stub.SandboxSidecarStop,
+            api_pb2.SandboxSidecarStopRequest(sandbox_id=self._sandbox.object_id, name=self.name),
+        )
+
+
+class _SidecarManager:
+    """Create/get/list sidecars of a sandbox (reference _SidecarManager,
+    sandbox.py:2752)."""
+
+    def __init__(self, sandbox: "_Sandbox"):
+        self._sandbox = sandbox
+
+    async def create(
+        self,
+        *args: str,
+        name: str,
+        image: Optional[Any] = None,
+        env: Optional[dict[str, str]] = None,
+    ) -> _SidecarContainer:
+        if not args:
+            raise InvalidError("sidecar needs a command")
+        if name == "main":
+            raise InvalidError("the name 'main' is reserved for the sandbox's main container")
+        image_id = ""
+        if image is not None:
+            await image.hydrate(self._sandbox.client)
+            image_id = image.object_id
+        await retry_transient_errors(
+            self._sandbox.client.stub.SandboxSidecarCreate,
+            api_pb2.SandboxSidecarCreateRequest(
+                sandbox_id=self._sandbox.object_id,
+                sidecar=api_pb2.SandboxSidecar(
+                    name=name, entrypoint_args=list(args), env=env or {}, image_id=image_id
+                ),
+            ),
+        )
+        return _SidecarContainer(self._sandbox, name)
+
+    async def get(self, *, name: str) -> _SidecarContainer:
+        resp = await retry_transient_errors(
+            self._sandbox.client.stub.SandboxSidecarList,
+            api_pb2.SandboxSidecarListRequest(sandbox_id=self._sandbox.object_id),
+        )
+        if not any(sc.name == name for sc in resp.sidecars):
+            raise NotFoundError(f"sidecar {name!r} not found")
+        return _SidecarContainer(self._sandbox, name)
+
+    async def list(self) -> list[api_pb2.SandboxSidecar]:
+        resp = await retry_transient_errors(
+            self._sandbox.client.stub.SandboxSidecarList,
+            api_pb2.SandboxSidecarListRequest(sandbox_id=self._sandbox.object_id),
+        )
+        return list(resp.sidecars)
+
+
 Sandbox = synchronize_api(_Sandbox)
 StreamReader = synchronize_api(_StreamReader)
 StreamWriter = synchronize_api(_StreamWriter)
+SidecarManager = synchronize_api(_SidecarManager)
+SidecarContainer = synchronize_api(_SidecarContainer)

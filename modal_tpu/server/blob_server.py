@@ -31,6 +31,10 @@ class BlobServer:
         app.router.add_get("/blob/{blob_id}", self._get)
         app.router.add_put("/blob/{blob_id}/part/{part}", self._put_part)
         app.router.add_put("/blob/{blob_id}/complete/{n_parts}", self._complete)
+        # browser leg of the token flow (reference token_flow.py:1): this is
+        # the control plane's "dashboard page" — visiting it with the
+        # verification code approves the pending flow
+        app.router.add_get("/auth/token-flow/{flow_id}", self._token_flow_approve)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -43,6 +47,21 @@ class BlobServer:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+
+    async def _token_flow_approve(self, request: web.Request) -> web.Response:
+        flow_id = request.match_info["flow_id"]
+        flow = self.state.pending_token_flows.get(flow_id)
+        if flow is None or request.query.get("code") != flow["code"]:
+            return web.Response(status=404, text="unknown or expired token flow")
+        flow["approved"].set()
+        return web.Response(
+            content_type="text/html",
+            text=(
+                "<html><body><h2>modal-tpu: token granted</h2>"
+                "<p>You can close this window and return to the terminal.</p>"
+                "</body></html>"
+            ),
+        )
 
     async def _put(self, request: web.Request) -> web.Response:
         blob_id = request.match_info["blob_id"]

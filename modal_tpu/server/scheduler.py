@@ -63,6 +63,8 @@ class Scheduler:
                     self._last_reap = time.time()
                     await self.reap_dead_tasks()
                     self._gc_scheduled_calls()
+                    if self.servicer is not None:
+                        self.servicer.reap_stale_ephemerals()
             except Exception:
                 logger.exception("scheduler iteration failed")
             try:
@@ -286,22 +288,30 @@ class Scheduler:
         chips_needed: int,
         reserved: Optional[dict[str, int]] = None,
         placement=None,
+        slice_index: Optional[int] = None,
+        rank_load: Optional[dict[str, int]] = None,
     ) -> Optional[WorkerState]:
         """Least-loaded worker with enough free chips that satisfies the
         placement constraints. `reserved` counts chips tentatively claimed by
         a gang being placed (so multi-rank placement on one host can't
-        double-book chips)."""
+        double-book chips); `rank_load` counts ranks already reserved per
+        worker so a gang spreads one-rank-per-host when hosts are available.
+        `slice_index` restricts to one ICI domain (require_single_slice)."""
         best: Optional[WorkerState] = None
+        best_score = 0
         for worker in self.s.workers.values():
             if time.time() - worker.last_heartbeat > 60.0:
                 continue
             if not self._placement_ok(worker, placement):
                 continue
+            if slice_index is not None and worker.slice_index != slice_index:
+                continue
             free = len(worker.free_chips()) - (reserved or {}).get(worker.worker_id, 0)
             if chips_needed > 0 and free < chips_needed:
                 continue
-            if best is None or len(worker.active_tasks) < len(best.active_tasks):
-                best = worker
+            score = len(worker.active_tasks) + (rank_load or {}).get(worker.worker_id, 0)
+            if best is None or score < best_score:
+                best, best_score = worker, score
         return best
 
     async def _launch_task(
@@ -349,6 +359,46 @@ class Scheduler:
         logger.debug(f"scheduled task {task_id} for {fn.tag} on {worker.worker_id} chips={chip_ids}")
         return task
 
+    def _pick_gang_workers(
+        self, fn: FunctionState, group_size: int, chips_needed: int, single_slice: bool
+    ) -> Optional[list[WorkerState]]:
+        """Workers for all ranks, or None if capacity is short.
+
+        require_single_slice (reference rdma/fabric constraint,
+        api.proto:1922,3262): the whole gang must land within ONE ICI domain
+        — collectives then ride ICI, never DCN. Each candidate slice is tried
+        until one can host every rank. Without the constraint, ranks may
+        spread across slices (cross-slice collectives go over DCN, which
+        jax.distributed handles)."""
+        placement = self._fn_placement(fn)
+
+        def _try(slice_index: Optional[int]) -> Optional[list[WorkerState]]:
+            chosen: list[WorkerState] = []
+            reserved: dict[str, int] = {}
+            rank_load: dict[str, int] = {}
+            for _r in range(group_size):
+                w = self._pick_worker(
+                    chips_needed,
+                    reserved=reserved,
+                    placement=placement,
+                    slice_index=slice_index,
+                    rank_load=rank_load,
+                )
+                if w is None:
+                    return None
+                reserved[w.worker_id] = reserved.get(w.worker_id, 0) + chips_needed
+                rank_load[w.worker_id] = rank_load.get(w.worker_id, 0) + 1
+                chosen.append(w)
+            return chosen
+
+        if not single_slice:
+            return _try(None)
+        for slice_index in sorted({w.slice_index for w in self.s.workers.values()}):
+            chosen = _try(slice_index)
+            if chosen is not None:
+                return chosen
+        return None
+
     async def _launch_gang(self, fn: FunctionState, group_size: int) -> bool:
         """Atomic gang allocation: reserve all members before launching any
         (SURVEY §7 hard part 1: atomicity, rank stability). Returns False
@@ -360,14 +410,9 @@ class Scheduler:
         # pick workers for all ranks first; allow worker reuse when there are
         # fewer workers than ranks (local dev: many "hosts" on one machine)
         chips_needed = self._chips_needed(fn)
-        chosen: list[WorkerState] = []
-        reserved: dict[str, int] = {}
-        for r in range(group_size):
-            w = self._pick_worker(chips_needed, reserved=reserved, placement=self._fn_placement(fn))
-            if w is None:
-                return False  # not enough capacity; retry next tick
-            reserved[w.worker_id] = reserved.get(w.worker_id, 0) + chips_needed
-            chosen.append(w)
+        chosen = self._pick_gang_workers(fn, group_size, chips_needed, tpu.require_single_slice)
+        if chosen is None:
+            return False  # not enough capacity; retry next tick
         cluster = ClusterState(
             cluster_id=make_id("cl"),
             function_id=fn.function_id,
@@ -424,6 +469,13 @@ class Scheduler:
                     args.env[k] = v
         if fn.serialized_params:
             args.env["MODAL_TPU_BOUND_PARAMS"] = fn.serialized_params.hex()
+        if fn.definition.proxy_id:
+            proxy = self.s.proxies.get(fn.definition.proxy_id)
+            if proxy is not None:
+                # the container's static egress address (reference ProxyInfo
+                # on task layout, api.proto:1074); locally exported as env —
+                # a production worker binds SNAT to it
+                args.env["MODAL_TPU_PROXY_IP"] = proxy.proxy_ip
         if cluster is not None:
             args.rank = task.rank
             args.world_size = cluster.size

@@ -30,6 +30,7 @@ from .state import (
     FunctionState,
     ImageState,
     InputState,
+    ProxyState,
     QueueState,
     SecretState,
     ServerState,
@@ -118,25 +119,51 @@ class ModalTPUServicer:
         return api_pb2.EnvironmentUpdateResponse()
 
     async def TokenFlowCreate(self, request, context):
-        # local token issuance: real random credentials, stored server-side
-        # (the reference's browser flow is replaced by immediate grant)
+        """Browser-completed token issuance (reference token_flow.py:1): the
+        flow's web_url is an HTTP page served by this control plane's blob
+        server; visiting it with the verification code approves the flow and
+        unblocks TokenFlowWait. Headless callers pass timeout=0 to Wait for
+        an immediate local grant."""
         import secrets as _secrets
 
         flow_id = make_id("tf")
-        token_id = "tk-" + _secrets.token_hex(8)
-        token_secret = "ts-" + _secrets.token_hex(16)
-        self.s.tokens[token_id] = token_secret
-        self.s.pending_token_flows[flow_id] = (token_id, token_secret)
+        self.s.pending_token_flows[flow_id] = {
+            "token_id": "tk-" + _secrets.token_hex(8),
+            "token_secret": "ts-" + _secrets.token_hex(16),
+            "code": _secrets.token_hex(3),
+            "approved": asyncio.Event(),
+            "localhost_port": request.localhost_port,
+        }
+        flow = self.s.pending_token_flows[flow_id]
+        base = self.s.blob_url_base or ""
+        web_url = (
+            f"{base}/auth/token-flow/{flow_id}?code={flow['code']}"
+            if base
+            else "local://token-granted"
+        )
         return api_pb2.TokenFlowCreateResponse(
-            token_flow_id=flow_id, web_url="local://token-granted", code=token_id[-6:]
+            token_flow_id=flow_id, web_url=web_url, code=flow["code"]
         )
 
     async def TokenFlowWait(self, request, context):
-        pair = self.s.pending_token_flows.pop(request.token_flow_id, None)
-        if pair is None:
+        flow = self.s.pending_token_flows.get(request.token_flow_id)
+        if flow is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "unknown token flow")
+        if request.timeout > 0:
+            # browser flow: block until the web page approves (or time out —
+            # the CLI polls, reference token_flow.py finish loop)
+            try:
+                await asyncio.wait_for(flow["approved"].wait(), request.timeout)
+            except asyncio.TimeoutError:
+                return api_pb2.TokenFlowWaitResponse(timeout=True)
+        # timeout == 0: headless local grant, no browser leg.
+        # pop-not-del: a retried Wait (dropped response) may race another
+        # waiter for the same flow — the grant is idempotent, both get the
+        # same credentials.
+        self.s.tokens[flow["token_id"]] = flow["token_secret"]
+        self.s.pending_token_flows.pop(request.token_flow_id, None)
         return api_pb2.TokenFlowWaitResponse(
-            token_id=pair[0], token_secret=pair[1], workspace_name="local"
+            token_id=flow["token_id"], token_secret=flow["token_secret"], workspace_name="local"
         )
 
     # ------------------------------------------------------------------
@@ -1418,12 +1445,18 @@ class ModalTPUServicer:
         rank = cluster.task_ids.index(request.task_id)
         rank0_addr = cluster.reported[cluster.task_ids[0]]
         coordinator_host = rank0_addr.rsplit(":", 1)[0] if ":" in rank0_addr else rank0_addr
+        def _slice_of(tid: str) -> int:
+            worker = self.s.workers.get(self.s.tasks[tid].worker_id)
+            return worker.slice_index if worker is not None else 0
+
         resp = api_pb2.TaskClusterHelloResponse(
             rank=rank,
             world_size=cluster.size,
             coordinator_address=f"{coordinator_host}:{cluster.coordinator_port}",
             peer_addresses=[cluster.reported[tid] for tid in cluster.task_ids],
             cluster_id=cluster.cluster_id,
+            peer_slice_indices=[_slice_of(tid) for tid in cluster.task_ids],
+            slice_index=_slice_of(request.task_id),
         )
         if cluster.slice_info is not None:
             resp.slice_info.CopyFrom(cluster.slice_info)
@@ -1546,6 +1579,80 @@ class ModalTPUServicer:
                 )
         sb.state = api_pb2.SANDBOX_STATE_TERMINATED
         return api_pb2.SandboxTerminateResponse()
+
+    # -- sidecars (reference sandbox.py:2157 _experimental_sidecars) --------
+
+    async def SandboxSidecarCreate(
+        self, request: api_pb2.SandboxSidecarCreateRequest, context
+    ) -> api_pb2.SandboxSidecarCreateResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        sc = request.sidecar
+        if not sc.name or sc.name == "main":
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "sidecar name required ('main' is reserved)"
+            )
+        if sc.name in sb.sidecars and sb.sidecars[sc.name].running:
+            await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"sidecar {sc.name!r} is running")
+        if not sc.entrypoint_args:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "sidecar command required")
+        task = self.s.tasks.get(sb.task_id)
+        worker = self.s.workers.get(task.worker_id) if task is not None else None
+        if task is None or task.result is not None or worker is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, "sandbox is not running — cannot attach a sidecar"
+            )
+        rec = api_pb2.SandboxSidecar()
+        rec.CopyFrom(sc)
+        rec.running = True
+        sb.sidecars[sc.name] = rec
+        await worker.events.put(
+            api_pb2.WorkerPollResponse(
+                sidecar=api_pb2.SidecarLaunchEvent(
+                    task_id=task.task_id, sandbox_id=sb.sandbox_id, sidecar=rec
+                )
+            )
+        )
+        return api_pb2.SandboxSidecarCreateResponse(name=sc.name)
+
+    async def SandboxSidecarList(
+        self, request: api_pb2.SandboxSidecarListRequest, context
+    ) -> api_pb2.SandboxSidecarListResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        return api_pb2.SandboxSidecarListResponse(sidecars=list(sb.sidecars.values()))
+
+    async def SandboxSidecarStop(
+        self, request: api_pb2.SandboxSidecarStopRequest, context
+    ) -> api_pb2.SandboxSidecarStopResponse:
+        sb = self.s.sandboxes.get(request.sandbox_id)
+        if sb is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "sandbox not found")
+        if request.name not in sb.sidecars:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"no sidecar {request.name!r}")
+        task = self.s.tasks.get(sb.task_id)
+        worker = self.s.workers.get(task.worker_id) if task is not None else None
+        if worker is not None:
+            await worker.events.put(
+                api_pb2.WorkerPollResponse(
+                    stop=api_pb2.TaskStopEvent(
+                        task_id=sb.task_id, force=True, sidecar_name=request.name
+                    )
+                )
+            )
+        return api_pb2.SandboxSidecarStopResponse()
+
+    async def SandboxSidecarExit(
+        self, request: api_pb2.SandboxSidecarExitRequest, context
+    ) -> api_pb2.SandboxSidecarExitResponse:
+        for sb in self.s.sandboxes.values():
+            if sb.task_id == request.task_id and request.name in sb.sidecars:
+                sb.sidecars[request.name].running = False
+                sb.sidecars[request.name].returncode = request.returncode
+                break
+        return api_pb2.SandboxSidecarExitResponse()
 
     async def SandboxList(self, request, context) -> api_pb2.SandboxListResponse:
         out = []
@@ -2040,7 +2147,12 @@ class ModalTPUServicer:
     async def VolumeGetOrCreate(self, request: api_pb2.VolumeGetOrCreateRequest, context) -> api_pb2.VolumeGetOrCreateResponse:
         if request.object_creation_type == EPHEMERAL or not request.deployment_name:
             volume_id = make_id("vo")
-            self.s.volumes[volume_id] = VolumeState(volume_id=volume_id, version=request.version)
+            self.s.volumes[volume_id] = VolumeState(
+                volume_id=volume_id,
+                version=request.version,
+                ephemeral=request.object_creation_type == EPHEMERAL,
+                last_heartbeat=time.time(),
+            )
             return api_pb2.VolumeGetOrCreateResponse(
                 volume_id=volume_id, metadata=api_pb2.VolumeMetadata(version=request.version)
             )
@@ -2244,10 +2356,117 @@ class ModalTPUServicer:
     # Dicts
     # ------------------------------------------------------------------
 
+    # -- proxies (static egress; reference proxy.py:1) ----------------------
+
+    async def ProxyCreate(self, request: api_pb2.ProxyCreateRequest, context) -> api_pb2.ProxyCreateResponse:
+        if not request.name:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "proxy name required")
+        key = (request.environment_name, request.name)
+        if key in self.s.deployed_proxies:
+            await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"proxy {request.name!r} exists")
+        proxy_id = make_id("pr")
+        # static IP from a private range, never reusing one a live proxy
+        # holds (a count-derived octet would collide after deletes) — the
+        # worker exports it to containers as their egress address (locally:
+        # env only; a production deployment binds SNAT to it)
+        in_use = {p.proxy_ip for p in self.s.proxies.values()}
+        ip = next(
+            (
+                f"10.250.{block}.{octet}"
+                for block in range(256)
+                for octet in range(2, 252)
+                if f"10.250.{block}.{octet}" not in in_use
+            ),
+            None,
+        )
+        if ip is None:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "proxy IP range exhausted")
+        proxy = ProxyState(
+            proxy_id=proxy_id,
+            name=request.name,
+            proxy_ip=ip,
+            environment_name=request.environment_name,
+        )
+        self.s.proxies[proxy_id] = proxy
+        self.s.deployed_proxies[key] = proxy_id
+        return api_pb2.ProxyCreateResponse(
+            proxy=api_pb2.Proxy(proxy_id=proxy_id, name=proxy.name, proxy_ip=proxy.proxy_ip)
+        )
+
+    async def ProxyGet(self, request: api_pb2.ProxyGetRequest, context) -> api_pb2.ProxyGetResponse:
+        proxy_id = self.s.deployed_proxies.get((request.environment_name, request.name))
+        if proxy_id is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"proxy {request.name!r} not found — provision it with `modal-tpu proxy create`",
+            )
+        proxy = self.s.proxies[proxy_id]
+        return api_pb2.ProxyGetResponse(
+            proxy=api_pb2.Proxy(proxy_id=proxy_id, name=proxy.name, proxy_ip=proxy.proxy_ip)
+        )
+
+    async def ProxyList(self, request: api_pb2.ProxyListRequest, context) -> api_pb2.ProxyListResponse:
+        return api_pb2.ProxyListResponse(
+            proxies=[
+                api_pb2.Proxy(proxy_id=p.proxy_id, name=p.name, proxy_ip=p.proxy_ip)
+                for p in self.s.proxies.values()
+                if not request.environment_name or p.environment_name == request.environment_name
+            ]
+        )
+
+    async def ProxyDelete(self, request: api_pb2.ProxyDeleteRequest, context) -> api_pb2.ProxyDeleteResponse:
+        proxy = self.s.proxies.pop(request.proxy_id, None)
+        if proxy is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "proxy not found")
+        self.s.deployed_proxies.pop((proxy.environment_name, proxy.name), None)
+        return api_pb2.ProxyDeleteResponse()
+
+    # -- ephemeral-object liveness (reference _object.py:21) ----------------
+
+    async def EphemeralObjectHeartbeat(
+        self, request: api_pb2.EphemeralObjectHeartbeatRequest, context
+    ) -> api_pb2.EphemeralObjectHeartbeatResponse:
+        pools = {"di": self.s.dicts, "qu": self.s.queues, "vo": self.s.volumes}
+        pool = pools.get(request.object_id[:2])
+        obj = pool.get(request.object_id) if pool is not None else None
+        if obj is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"no such object {request.object_id}")
+        obj.last_heartbeat = time.time()
+        return api_pb2.EphemeralObjectHeartbeatResponse(ttl_seconds=self.ephemeral_ttl_seconds())
+
+    @staticmethod
+    def ephemeral_ttl_seconds() -> float:
+        """How long an ephemeral object outlives its last heartbeat. The
+        client heartbeats at a third of this (object.py), mirroring the
+        reference's 300s heartbeat sleep."""
+        return float(os.environ.get("MODAL_TPU_EPHEMERAL_TTL", "900"))
+
+    def reap_stale_ephemerals(self) -> int:
+        """Delete ephemeral dicts/queues/volumes whose client stopped
+        heartbeating (called from the scheduler's reap tick). Returns the
+        number reaped."""
+        ttl = self.ephemeral_ttl_seconds()
+        cutoff = time.time() - ttl
+        reaped = 0
+        for pool in (self.s.dicts, self.s.queues, self.s.volumes):
+            for obj_id in [
+                oid
+                for oid, obj in pool.items()
+                if obj.ephemeral and obj.last_heartbeat and obj.last_heartbeat < cutoff
+            ]:
+                logger.debug(f"reaping stale ephemeral object {obj_id}")
+                del pool[obj_id]
+                reaped += 1
+        return reaped
+
     async def DictGetOrCreate(self, request: api_pb2.DictGetOrCreateRequest, context) -> api_pb2.DictGetOrCreateResponse:
         if request.object_creation_type == EPHEMERAL or not request.deployment_name:
             dict_id = make_id("di")
-            self.s.dicts[dict_id] = DictState(dict_id=dict_id)
+            self.s.dicts[dict_id] = DictState(
+                dict_id=dict_id,
+                ephemeral=request.object_creation_type == EPHEMERAL,
+                last_heartbeat=time.time(),
+            )
             return api_pb2.DictGetOrCreateResponse(dict_id=dict_id)
         key = (request.environment_name, request.deployment_name)
         dict_id = self.s.deployed_dicts.get(key)
@@ -2337,7 +2556,11 @@ class ModalTPUServicer:
     async def QueueGetOrCreate(self, request: api_pb2.QueueGetOrCreateRequest, context) -> api_pb2.QueueGetOrCreateResponse:
         if request.object_creation_type == EPHEMERAL or not request.deployment_name:
             queue_id = make_id("qu")
-            self.s.queues[queue_id] = QueueState(queue_id=queue_id)
+            self.s.queues[queue_id] = QueueState(
+                queue_id=queue_id,
+                ephemeral=request.object_creation_type == EPHEMERAL,
+                last_heartbeat=time.time(),
+            )
             return api_pb2.QueueGetOrCreateResponse(queue_id=queue_id)
         key = (request.environment_name, request.deployment_name)
         queue_id = self.s.deployed_queues.get(key)

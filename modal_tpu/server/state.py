@@ -183,6 +183,22 @@ class VolumeState:
     created_at: float = field(default_factory=time.time)
     files: dict[str, api_pb2.VolumeFile] = field(default_factory=dict)
     committed_version: int = 0
+    # ephemeral objects are reaped when their client's heartbeat goes stale
+    # (reference _object.py:21); 0.0 heartbeat = not ephemeral
+    ephemeral: bool = False
+    last_heartbeat: float = 0.0
+
+
+@dataclass
+class ProxyState:
+    """Static-egress proxy (reference proxy.py:1): a named, stable outbound
+    IP that functions can bind to via `proxy=`."""
+
+    proxy_id: str
+    name: str = ""
+    proxy_ip: str = ""
+    environment_name: str = ""
+    created_at: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -200,6 +216,8 @@ class DictState:
     name: str = ""
     data: dict[bytes, bytes] = field(default_factory=dict)
     created_at: float = field(default_factory=time.time)
+    ephemeral: bool = False
+    last_heartbeat: float = 0.0
 
 
 @dataclass
@@ -215,6 +233,8 @@ class QueueState:
     name: str = ""
     partitions: dict[str, QueuePartition] = field(default_factory=dict)
     created_at: float = field(default_factory=time.time)
+    ephemeral: bool = False
+    last_heartbeat: float = 0.0
 
     def partition(self, key: str) -> QueuePartition:
         return self.partitions.setdefault(key, QueuePartition())
@@ -248,6 +268,9 @@ class SandboxState_:
     tunnels_reported: bool = False
     ready: bool = False  # readiness probe passed (or no probe configured)
     workdir: str = ""  # worker-reported ACTUAL cwd (fs snapshots tar this)
+    # name -> SandboxSidecar proto (reference sandbox.py:2157 sidecars):
+    # running/returncode updated by SandboxSidecarExit from the worker
+    sidecars: dict[str, api_pb2.SandboxSidecar] = field(default_factory=dict)
 
 
 @dataclass
@@ -288,6 +311,8 @@ class ServerState:
         self.deployed_dicts: dict[tuple[str, str], str] = {}
         self.queues: dict[str, QueueState] = {}
         self.deployed_queues: dict[tuple[str, str], str] = {}
+        self.proxies: dict[str, "ProxyState"] = {}
+        self.deployed_proxies: dict[tuple[str, str], str] = {}
         self.images: dict[str, ImageState] = {}
         self.images_by_hash: dict[str, str] = {}
         self.sandboxes: dict[str, SandboxState_] = {}
@@ -297,7 +322,10 @@ class ServerState:
         self.tunnels: dict[tuple[str, int], object] = {}
         self.environments: dict[str, str] = {"main": ""}  # name -> web suffix
         self.tokens: dict[str, str] = {}  # token_id -> token_secret
-        self.pending_token_flows: dict[str, tuple[str, str]] = {}
+        # flow_id -> {token_id, token_secret, code, approved: asyncio.Event,
+        # localhost_port} — browser-completed token issuance (services.py
+        # TokenFlowCreate + blob_server auth route)
+        self.pending_token_flows: dict[str, dict] = {}
         self.blob_url_base: str = ""  # set by supervisor once blob server is up
         # input plane (region-local data plane): url advertised in
         # ClientHello; HS256 secret shared between AuthTokenGet (control

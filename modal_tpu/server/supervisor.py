@@ -34,12 +34,14 @@ class LocalSupervisor:
         worker_chips: Optional[int] = None,
         worker_tpu_type: Optional[str] = None,
         servicer_cls: type = ModalTPUServicer,  # tests inject fault-wrapping subclasses
+        hosts_per_slice: int = 0,  # 0 = all workers share slice 0
     ):
         self.num_workers = num_workers
         self.port = port
         self.state_dir = state_dir or config["state_dir"]
         self.worker_chips = worker_chips
         self.worker_tpu_type = worker_tpu_type
+        self.hosts_per_slice = hosts_per_slice
         self.state = ServerState(self.state_dir)
         self.servicer = servicer_cls(self.state)
         self.scheduler = Scheduler(self.state, self.servicer)
@@ -73,6 +75,7 @@ class LocalSupervisor:
                 num_chips=self.worker_chips,
                 tpu_type=self.worker_tpu_type,
                 state_dir=self.state_dir,
+                slice_index=(i // self.hosts_per_slice) if self.hosts_per_slice else 0,
             )
             await worker.start()
             self.workers.append(worker)

@@ -88,6 +88,7 @@ class WorkerAgent:
         zone: Optional[str] = None,
         spot: Optional[bool] = None,
         instance_type: Optional[str] = None,
+        slice_index: int = 0,
     ):
         self.server_url = server_url
         self.worker_id = worker_id or ""
@@ -98,11 +99,17 @@ class WorkerAgent:
         self.region = region if region is not None else config.get("worker_region")
         self.zone = zone if zone is not None else config.get("worker_zone")
         self.spot = spot if spot is not None else bool(config.get("worker_spot"))
+        # which ICI domain (pod slice) this host belongs to: gangs with
+        # require_single_slice are placed within one slice_index
+        self.slice_index = slice_index
         self.instance_type = (
             instance_type if instance_type is not None else config.get("worker_instance_type")
         )
         self.state_dir = state_dir or config["state_dir"]
         self._procs: dict[str, asyncio.subprocess.Process] = {}
+        # task_id -> (cwd, env) of a running sandbox: sidecars launch into the
+        # same filesystem/env (the local analogue of sharing the pod)
+        self._sandbox_runtime: dict[str, tuple[str, dict]] = {}
         self._image_builder = None  # lazy ImageBuilder (created on first use)
         # stop events that raced ahead of their assignment (e.g. gang
         # rollback): the task is killed at/before registration instead of
@@ -150,6 +157,7 @@ class WorkerAgent:
                 memory_mb=16384,
                 container_address="127.0.0.1",
                 router_address=self.router_address,
+                slice_index=self.slice_index,
                 region=self.region or "",
                 zone=self.zone or "",
                 spot=self.spot,
@@ -217,6 +225,8 @@ class WorkerAgent:
                             asyncio.create_task(self._run_task(event.assignment))
                     elif which == "stop":
                         await self._stop_task(event.stop)
+                    elif which == "sidecar":
+                        asyncio.create_task(self._run_sidecar(event.sidecar))
             except asyncio.CancelledError:
                 return
             except Exception as exc:
@@ -226,6 +236,22 @@ class WorkerAgent:
                 await asyncio.sleep(0.5)
 
     async def _stop_task(self, stop: api_pb2.TaskStopEvent) -> None:
+        if stop.sidecar_name:
+            # sidecar stop: kill only the named auxiliary process. A stop
+            # racing ahead of the spawn is recorded like main-task early
+            # stops — _run_sidecar consumes it at/after registration.
+            key = f"{stop.task_id}/sc/{stop.sidecar_name}"
+            proc = self._procs.get(key)
+            if proc is None:
+                self._early_stops[key] = None
+                while len(self._early_stops) > self._early_stops_max:
+                    self._early_stops.pop(next(iter(self._early_stops)))
+                return
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            return
         proc = self._procs.get(stop.task_id)
         if proc is None:
             self._early_stops[stop.task_id] = None
@@ -327,6 +353,125 @@ class WorkerAgent:
         except Exception as exc:
             logger.warning(f"failed reporting never-started task {task_id}: {exc}")
 
+    async def _run_sidecar(self, event: api_pb2.SidecarLaunchEvent) -> None:
+        """Launch a sandbox sidecar (reference sandbox.py:2157): an auxiliary
+        process sharing the sandbox's working directory and base env, with its
+        own command/env/image. Its stdout/stderr stream into the sandbox's
+        logs tagged by fd, and its exit is reported via SandboxSidecarExit."""
+        task_id = event.task_id
+        sc = event.sidecar
+        # the launch event can race the sandbox's own boot — including image
+        # materialization, which can take minutes — so the wait window must
+        # cover a full image build, not just process spawn
+        key = f"{task_id}/sc/{sc.name}"
+        runtime = None
+        boot_deadline = time.monotonic() + float(
+            os.environ.get("MODAL_TPU_SIDECAR_BOOT_WAIT", "600")
+        )
+        while time.monotonic() < boot_deadline:
+            if self._consume_early_stop(key):
+                await retry_transient_errors(
+                    self._stub.SandboxSidecarExit,
+                    api_pb2.SandboxSidecarExitRequest(task_id=task_id, name=sc.name, returncode=-1),
+                    max_retries=2,
+                )
+                return
+            runtime = self._sandbox_runtime.get(task_id)
+            if runtime is not None:
+                break
+            await asyncio.sleep(0.2)
+        if runtime is None:
+            await retry_transient_errors(
+                self._stub.SandboxSidecarExit,
+                api_pb2.SandboxSidecarExitRequest(task_id=task_id, name=sc.name, returncode=-1),
+                max_retries=2,
+            )
+            return
+        cwd, base_env = runtime
+        env = dict(base_env)
+        if sc.image_id:
+            # NOT _prepare_image: its failure path reports TaskResult
+            # INIT_FAILURE for the whole task, which would kill the main
+            # sandbox over a sidecar-only image problem
+            try:
+                built = await self._materialize_image(sc.image_id)
+                if built is not None:
+                    env.update(built.env)
+                    env["MODAL_TPU_IMAGE_ROOT"] = built.rootfs
+                    env["PATH"] = os.path.dirname(built.python_bin) + os.pathsep + env.get("PATH", "")
+            except Exception as exc:  # noqa: BLE001
+                logger.warning(f"sidecar {sc.name!r} image build failed: {exc}")
+                await retry_transient_errors(
+                    self._stub.SandboxSidecarExit,
+                    api_pb2.SandboxSidecarExitRequest(task_id=task_id, name=sc.name, returncode=-1),
+                    max_retries=2,
+                )
+                return
+        env.update(dict(sc.env))
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                *sc.entrypoint_args,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+                cwd=cwd,
+                env=env,
+            )
+        except Exception as exc:  # noqa: BLE001 — reported as exit -1
+            logger.warning(f"sidecar {sc.name!r} failed to spawn: {exc}")
+            await retry_transient_errors(
+                self._stub.SandboxSidecarExit,
+                api_pb2.SandboxSidecarExitRequest(task_id=task_id, name=sc.name, returncode=-1),
+                max_retries=2,
+            )
+            return
+        self._procs[key] = proc
+        if self._consume_early_stop(key):  # stop raced in during spawn
+            proc.kill()
+
+        async def _pump(stream, fd: int) -> None:
+            while True:
+                data = await stream.read(64 * 1024)
+                if not data:
+                    return
+                try:
+                    await self._stub.ContainerLog(
+                        api_pb2.ContainerLogRequest(
+                            task_id=task_id,
+                            logs=[
+                                api_pb2.TaskLogs(
+                                    data=f"[{sc.name}] " + data.decode("utf-8", "replace"),
+                                    task_id=task_id,
+                                    file_descriptor=fd,
+                                    timestamp=time.time(),
+                                )
+                            ],
+                        ),
+                        timeout=10.0,
+                    )
+                except Exception:
+                    pass
+
+        pumps = [
+            asyncio.create_task(_pump(proc.stdout, 1)),
+            asyncio.create_task(_pump(proc.stderr, 2)),
+        ]
+        try:
+            returncode = await proc.wait()
+        finally:
+            self._procs.pop(key, None)
+            for p in pumps:
+                p.cancel()
+        try:
+            await retry_transient_errors(
+                self._stub.SandboxSidecarExit,
+                api_pb2.SandboxSidecarExitRequest(
+                    task_id=task_id, name=sc.name, returncode=returncode
+                ),
+                max_retries=2,
+            )
+        except Exception:
+            pass
+
     async def _run_sandbox(self, assignment: api_pb2.TaskAssignment) -> None:
         """Run a sandbox command as a supervised subprocess: stdin drained
         from the control plane, stdout/stderr streamed back as logs."""
@@ -406,6 +551,7 @@ class WorkerAgent:
         self._procs[task_id] = proc
         if self._consume_early_stop(task_id):  # stop raced in during spawn
             proc.kill()
+        self._sandbox_runtime[task_id] = (sandbox_cwd or os.getcwd(), env)
         self.router.register_task(task_id, env, sandbox_cwd or os.getcwd(), token=assignment.router_token)
 
         async def _heartbeat() -> None:
@@ -605,6 +751,15 @@ class WorkerAgent:
             exception = f"sandbox exceeded timeout of {timeout_s}s"
         finally:
             self._procs.pop(task_id, None)
+            self._sandbox_runtime.pop(task_id, None)
+            # sidecars share the sandbox's lifecycle: main container exit
+            # tears them down too (reference sidecar semantics)
+            for key, sc_proc in list(self._procs.items()):
+                if key.startswith(f"{task_id}/sc/"):
+                    try:
+                        sc_proc.kill()
+                    except ProcessLookupError:
+                        pass
             self.router.unregister_task(task_id)
             stdin_task.cancel()
             hb_task.cancel()

@@ -42,6 +42,9 @@ class TPUSliceSpec:
     chips_per_host: int
     topology: str         # e.g. "4x4x4" (chips per torus dimension)
     mesh: dict[str, int]  # user-provided logical mesh hints (may be empty)
+    # gang must land within ONE ICI domain (slice); False = may span slices
+    # over DCN (reference rdma/fabric constraint, api.proto:1922,3262)
+    require_single_slice: bool = False
 
     @property
     def cores(self) -> int:
@@ -64,6 +67,7 @@ class TPUSliceSpec:
         )
         for k, v in self.mesh.items():
             cfg.mesh[k] = v
+        cfg.require_single_slice = self.require_single_slice
         return cfg
 
 

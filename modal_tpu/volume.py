@@ -99,7 +99,7 @@ class _Volume(_Object, type_prefix="vo"):
             version=api_pb2.VOLUME_FS_VERSION_V2,
         )
         resp = await retry_transient_errors(client.stub.VolumeGetOrCreate, req)
-        return cls._new_hydrated(resp.volume_id, client, resp.metadata)
+        return cls._new_hydrated_ephemeral(resp.volume_id, client, resp.metadata)
 
     @staticmethod
     async def lookup(name: str, *, client: Optional[_Client] = None, create_if_missing: bool = False) -> "_Volume":

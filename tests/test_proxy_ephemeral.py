@@ -1,0 +1,148 @@
+"""Proxy objects (reference proxy.py:1), ephemeral-object reaping
+(reference _object.py:21), and thread-leak detection at container exit
+(reference _container_entrypoint.py:500-510) — VERDICT r4 #6/#7."""
+
+import time
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# proxies
+# ---------------------------------------------------------------------------
+
+
+def test_proxy_create_lookup_delete(supervisor):
+    import modal_tpu
+    from modal_tpu.exception import NotFoundError, RemoteError
+
+    p = modal_tpu.Proxy.create("egress-1")
+    assert p.object_id.startswith("pr-")
+    looked = modal_tpu.Proxy.lookup("egress-1")
+    assert looked.object_id == p.object_id
+    state = supervisor.state.proxies[p.object_id]
+    assert state.proxy_ip.startswith("10.250.0.")
+    modal_tpu.Proxy.delete("egress-1")
+    with pytest.raises(Exception):  # NOT_FOUND surfaces as a grpc error
+        modal_tpu.Proxy.lookup("egress-1")
+
+
+def test_function_with_proxy_sees_static_ip(supervisor):
+    """proxy= on @app.function lands proxy_id on the definition and the
+    container sees its egress address as MODAL_TPU_PROXY_IP."""
+    import modal_tpu
+
+    created = modal_tpu.Proxy.create("egress-fn")
+    expected_ip = supervisor.state.proxies[created.object_id].proxy_ip
+
+    app = modal_tpu.App("proxy-fn")
+
+    def report_ip():
+        import os as _os
+
+        return _os.environ.get("MODAL_TPU_PROXY_IP", "")
+
+    f = app.function(serialized=True, proxy=modal_tpu.Proxy.from_name("egress-fn"))(report_ip)
+    with app.run():
+        fn_state = list(supervisor.state.functions.values())[-1]
+        assert fn_state.definition.proxy_id == created.object_id
+        assert f.remote() == expected_ip
+
+
+# ---------------------------------------------------------------------------
+# ephemeral-object reaping
+# ---------------------------------------------------------------------------
+
+
+def test_ephemeral_objects_reaped_when_heartbeat_stale(supervisor):
+    """An ephemeral Dict/Queue/Volume whose client stopped heartbeating is
+    deleted by the reaper; deployed (named) objects are untouched."""
+    import modal_tpu
+
+    d = modal_tpu.Dict.ephemeral()
+    q = modal_tpu.Queue.ephemeral()
+    v = modal_tpu.Volume.ephemeral()
+    named = modal_tpu.Dict.lookup("keepme", create_if_missing=True)
+    d.put("k", 1)
+    assert d.get("k") == 1
+
+    # all three exist server-side, marked ephemeral with a fresh heartbeat
+    for pool, oid in (
+        (supervisor.state.dicts, d.object_id),
+        (supervisor.state.queues, q.object_id),
+        (supervisor.state.volumes, v.object_id),
+    ):
+        assert pool[oid].ephemeral and pool[oid].last_heartbeat > 0
+
+    # simulate the client dying: age the heartbeats past the TTL
+    stale = time.time() - supervisor.servicer.ephemeral_ttl_seconds() - 10
+    supervisor.state.dicts[d.object_id].last_heartbeat = stale
+    supervisor.state.queues[q.object_id].last_heartbeat = stale
+    supervisor.state.volumes[v.object_id].last_heartbeat = stale
+
+    reaped = supervisor.servicer.reap_stale_ephemerals()
+    assert reaped == 3
+    assert d.object_id not in supervisor.state.dicts
+    assert q.object_id not in supervisor.state.queues
+    assert v.object_id not in supervisor.state.volumes
+    assert named.object_id in supervisor.state.dicts, "named dict must survive"
+
+
+def test_ephemeral_heartbeat_rpc_keeps_object_alive(supervisor):
+    import modal_tpu
+
+    d = modal_tpu.Dict.ephemeral()
+    state = supervisor.state.dicts[d.object_id]
+    state.last_heartbeat = time.time() - supervisor.servicer.ephemeral_ttl_seconds() + 5
+
+    # a heartbeat arrives just in time
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.proto import api_pb2
+
+    async def hb(c):
+        return await c.stub.EphemeralObjectHeartbeat(
+            api_pb2.EphemeralObjectHeartbeatRequest(object_id=d.object_id)
+        )
+
+    resp = synchronizer.run(hb(d.client))
+    assert resp.ttl_seconds > 0
+    assert supervisor.servicer.reap_stale_ephemerals() == 0
+    assert d.object_id in supervisor.state.dicts
+
+
+def test_ephemeral_heartbeat_loop_sends(supervisor, monkeypatch):
+    """The client-side background loop actually heartbeats at the configured
+    interval (reference EPHEMERAL_OBJECT_HEARTBEAT_SLEEP, here compressed)."""
+    import modal_tpu
+
+    monkeypatch.setenv("MODAL_TPU_EPHEMERAL_HEARTBEAT", "1")
+    d = modal_tpu.Dict.ephemeral()
+    state = supervisor.state.dicts[d.object_id]
+    created_hb = state.last_heartbeat
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and state.last_heartbeat == created_hb:
+        time.sleep(0.3)
+    assert state.last_heartbeat > created_hb, "heartbeat loop never fired"
+
+
+# ---------------------------------------------------------------------------
+# thread-leak detection
+# ---------------------------------------------------------------------------
+
+
+def test_thread_leak_detection_reports_user_threads():
+    import threading
+
+    from modal_tpu.runtime.container_entrypoint import check_thread_leaks
+
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="user-leaked-thread", daemon=False)
+    t.start()
+    try:
+        leaked = check_thread_leaks()
+        assert any(x.name == "user-leaked-thread" for x in leaked)
+    finally:
+        stop.set()
+        t.join()
+    # once joined, nothing reports
+    assert not any(x.name == "user-leaked-thread" for x in check_thread_leaks())

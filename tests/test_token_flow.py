@@ -1,0 +1,78 @@
+"""Browser-completed token flow (reference token_flow.py:1, VERDICT r4 #6):
+TokenFlowCreate issues a real web URL on the control plane's HTTP server;
+visiting it with the verification code approves the flow; TokenFlowWait
+blocks until then. Headless (timeout=0) grant still works for local use."""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from modal_tpu._utils.async_utils import synchronizer
+from modal_tpu.proto import api_pb2
+
+
+def _stub(supervisor):
+    from modal_tpu.client import _Client
+
+    async def go():
+        client = await _Client.from_env()
+        return client.stub
+
+    return synchronizer.run(go())
+
+
+def test_browser_flow_approval_unblocks_wait(supervisor):
+    stub = _stub(supervisor)
+
+    async def create():
+        return await stub.TokenFlowCreate(api_pb2.TokenFlowCreateRequest())
+
+    flow = synchronizer.run(create())
+    assert flow.web_url.startswith("http://127.0.0.1:"), flow.web_url
+    assert flow.code in flow.web_url
+
+    # wrong code is rejected and does NOT approve
+    bad_url = flow.web_url.replace(flow.code, "badc0d")
+    try:
+        urllib.request.urlopen(bad_url, timeout=5)
+        raise AssertionError("wrong code should 404")
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 404
+
+    # approve from a "browser" thread while Wait blocks
+    def visit():
+        time.sleep(0.5)
+        body = urllib.request.urlopen(flow.web_url, timeout=5).read()
+        assert b"token granted" in body
+
+    t = threading.Thread(target=visit)
+    t.start()
+
+    async def wait():
+        return await stub.TokenFlowWait(
+            api_pb2.TokenFlowWaitRequest(token_flow_id=flow.token_flow_id, timeout=15.0)
+        )
+
+    t0 = time.monotonic()
+    resp = synchronizer.run(wait())
+    t.join()
+    assert not resp.timeout
+    assert resp.token_id.startswith("tk-") and resp.token_secret.startswith("ts-")
+    assert time.monotonic() - t0 < 10, "Wait should unblock promptly on approval"
+    # the credential is now live server-side
+    assert supervisor.state.tokens[resp.token_id] == resp.token_secret
+
+
+def test_wait_times_out_without_approval(supervisor):
+    stub = _stub(supervisor)
+
+    async def go():
+        flow = await stub.TokenFlowCreate(api_pb2.TokenFlowCreateRequest())
+        return await stub.TokenFlowWait(
+            api_pb2.TokenFlowWaitRequest(token_flow_id=flow.token_flow_id, timeout=0.5)
+        )
+
+    resp = synchronizer.run(go())
+    assert resp.timeout
+    assert not resp.token_id
