@@ -85,6 +85,7 @@ __all__ = [
     "Secret",
     "TPUSliceSpec",
     "Volume",
+    "Workspace",
     "batched",
     "clustered",
     "concurrent",
@@ -120,6 +121,10 @@ def __getattr__(name: str):
         from .proxy import Proxy
 
         return Proxy
+    if name == "Workspace":
+        from .workspace import Workspace
+
+        return Workspace
     if name == "Sandbox":
         try:
             from .sandbox import Sandbox

@@ -922,6 +922,18 @@ def image_prune(yes: bool) -> None:
     click.echo(f"pruned {len(victims)} image(s)")
 
 
+@image_group.command("prebuild")
+@click.option("--builder-version", default=None, help="epoch to build bases for (default: active)")
+def image_prebuild(builder_version: Optional[str]) -> None:
+    """Pre-build the published base images (reference modal_global_objects):
+    later apps start on a warm venv instead of building one mid-cold-start."""
+    from ..global_objects import publish_base_images
+
+    image_ids = publish_base_images(builder_version)
+    for image_id in image_ids:
+        click.echo(f"prebuilt {image_id}")
+
+
 @cli.group("nfs")
 def nfs_group() -> None:
     """Manage network file systems (alias of volumes — reference marks NFS
@@ -943,6 +955,55 @@ def _alias_volume_command(name: str) -> None:
 
 for _cmd in ("list", "create", "delete", "ls", "put", "get", "rm"):
     _alias_volume_command(_cmd)
+
+
+@cli.group("workspace")
+def workspace_group() -> None:
+    """Workspace identity, members, and settings."""
+
+
+@workspace_group.command("current")
+def workspace_current() -> None:
+    from ..workspace import Workspace
+
+    ws = Workspace.from_context()
+    ws.hydrate()
+    click.echo(ws.name or "local")
+
+
+@workspace_group.command("members")
+def workspace_members() -> None:
+    from ..workspace import Workspace
+
+    ws = Workspace.from_context()
+    ws.hydrate()
+    for m in ws.members.list():
+        click.echo(f"{m.username}  {m.role:<7}  {_fmt_ts(m.created_at)}")
+
+
+@workspace_group.command("settings")
+def workspace_settings() -> None:
+    from ..workspace import Workspace
+
+    ws = Workspace.from_context()
+    ws.hydrate()
+    settings = ws.settings.list()
+    if not settings:
+        click.echo("(no workspace settings set)")
+    for k, v in sorted(settings.items()):
+        click.echo(f"{k} = {v}")
+
+
+@workspace_group.command("set")
+@click.argument("name")
+@click.argument("value")
+def workspace_set(name: str, value: str) -> None:
+    from ..workspace import Workspace
+
+    ws = Workspace.from_context()
+    ws.hydrate()
+    ws.settings.set(name, value)
+    click.echo(f"set {name} = {value}")
 
 
 @cli.group("token")

@@ -1,0 +1,57 @@
+"""Published base images (reference: py/modal_global_objects — scripts that
+pre-build the official `debian_slim`/`micromamba` bases per builder version
+so user apps never pay the base build).
+
+Local equivalent: `publish_base_images()` registers the active epoch's base
+images with the control plane and forces worker materialization by running a
+trivial probe function on each — after it runs, every later app using
+`Image.debian_slim()` starts on a warm, content-addressed venv instead of
+building one inside its first cold start. Exposed as
+`modal-tpu image prebuild`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def supported_python_versions(builder_version: str) -> list[str]:
+    """Epoch-supported python minors that this host can actually materialize
+    (the local backend builds venvs with the host interpreter, so only the
+    matching minor is buildable — mirror of base_images.json 'python')."""
+    from modal_tpu.builder import base_image_config
+
+    host = f"{sys.version_info.major}.{sys.version_info.minor}"
+    configured = base_image_config(builder_version).get("python") or [host]
+    return [v for v in configured if v == host] or [host]
+
+
+def publish_base_images(builder_version: str | None = None) -> list[str]:
+    """Build (or reuse) each base image through the REAL path — a probe
+    function scheduled onto a worker — and return the built image ids."""
+    import modal_tpu
+    from modal_tpu.config import config
+
+    builder_version = builder_version or config["image_builder_version"]
+    app = modal_tpu.App("global-base-images")
+    probes = []
+    for version in supported_python_versions(builder_version):
+        image = modal_tpu.Image.debian_slim(python_version=version)
+
+        def probe() -> str:
+            import sys as _sys
+
+            return f"{_sys.version_info.major}.{_sys.version_info.minor}"
+
+        fn = app.function(serialized=True, image=image, name=f"probe_{version.replace('.', '_')}")(probe)
+        probes.append((version, image, fn))
+    image_ids = []
+    with app.run():
+        for version, image, fn in probes:
+            reported = fn.remote()
+            if reported != version:
+                raise RuntimeError(
+                    f"base image python mismatch: wanted {version}, container reports {reported}"
+                )
+            image_ids.append(image.object_id)
+    return image_ids

@@ -101,11 +101,21 @@ class _Image(_Object, type_prefix="im"):
             return [*base_images.values(), *secrets]
 
         async def _load(self: "_Image", resolver: Resolver, context: LoadContext, existing_object_id: Optional[str]):
+            import os as _os
+
+            # builder-version precedence: explicit env override > the
+            # workspace default advertised at ClientHello (WorkspaceSettings)
+            # > baked default — so `workspace set image_builder_version`
+            # actually governs what clients build with
+            if _os.environ.get("MODAL_TPU_IMAGE_BUILDER_VERSION"):
+                builder_version = config["image_builder_version"]
+            else:
+                builder_version = context.client.image_builder_version or config["image_builder_version"]
             image = api_pb2.Image(
                 dockerfile_commands=dockerfile_commands,
                 base_image_registry_ref=registry_ref or "",
                 secret_ids=[s.object_id for s in secrets],
-                version=config["image_builder_version"],
+                version=builder_version,
             )
             if base_images:
                 # encode base image layer reference as FROM directive
@@ -118,7 +128,7 @@ class _Image(_Object, type_prefix="im"):
             req = api_pb2.ImageGetOrCreateRequest(
                 app_id=context.app_id or "",
                 image=image,
-                builder_version=config["image_builder_version"],
+                builder_version=builder_version,
                 force_build=force_build or config["force_build"],
             )
             resp = await retry_transient_errors(context.client.stub.ImageGetOrCreate, req)

@@ -209,24 +209,50 @@ _TOP_MAP = {
     "final_norm": ("model.norm.weight", False),
     "lm_head": ("lm_head.weight", True),
 }
-_LAYER_MAP = {
+_ATTN_MAP = {
     "attn_norm": ("input_layernorm.weight", False),
     "wq": ("self_attn.q_proj.weight", True),
     "wk": ("self_attn.k_proj.weight", True),
     "wv": ("self_attn.v_proj.weight", True),
     "wo": ("self_attn.o_proj.weight", True),
     "mlp_norm": ("post_attention_layernorm.weight", False),
+}
+_LAYER_MAP = {
+    **_ATTN_MAP,
     "w_gate": ("mlp.gate_proj.weight", True),
     "w_up": ("mlp.up_proj.weight", True),
     "w_down": ("mlp.down_proj.weight", True),
 }
+# MoE layers (Mixtral-style naming: block_sparse_moe.gate + per-expert
+# projections). Our switch FFN has two expert matmuls (w_in/w_out), not
+# Mixtral's SwiGLU triple — each expert's matrices serialize as their own
+# HF-convention [out, in] tensors so a shard never holds the full expert
+# stack.
+_MOE_LAYER_MAP = {
+    **_ATTN_MAP,
+    "router": ("block_sparse_moe.gate.weight", True),
+}
+_EXPERT_MAP = {
+    "w_in": ("w_in.weight", True),
+    "w_out": ("w_out.weight", True),
+}
 
 
-def hf_key(param: str, layer: Optional[int] = None) -> tuple[str, bool]:
+def layer_map(cfg: Optional["LlamaConfig"] = None) -> dict:
+    """Per-layer (non-expert) tensor map for this config's FFN flavor."""
+    return _MOE_LAYER_MAP if cfg is not None and getattr(cfg, "is_moe", False) else _LAYER_MAP
+
+
+def hf_key(
+    param: str, layer: Optional[int] = None, expert: Optional[int] = None, cfg: Optional["LlamaConfig"] = None
+) -> tuple[str, bool]:
     """(hf tensor name, needs_transpose) for one of our param names."""
     if layer is None:
         return _TOP_MAP[param]
-    suffix, t = _LAYER_MAP[param]
+    if expert is not None:
+        suffix, t = _EXPERT_MAP[param]
+        return f"model.layers.{layer}.block_sparse_moe.experts.{expert}.{suffix}", t
+    suffix, t = layer_map(cfg)[param]
     return f"model.layers.{layer}.{suffix}", t
 
 
@@ -277,9 +303,6 @@ def export_checkpoint(
     per-layer unstack) plus OS page cache. Returns the index dict."""
     import jax
 
-    if getattr(cfg, "is_moe", False):
-        raise NotImplementedError("MoE checkpoints (expert weights) use a different HF layout; dense only")
-
     # (hf_name, fetch, nbytes) in deterministic order; fetch is lazy so only
     # one tensor is ever materialized host-side. Sizes come from the leaf
     # shapes — no fetch needed to plan the shards.
@@ -301,7 +324,7 @@ def export_checkpoint(
             (name, _out_shape(leaf.shape, t), _dtype_name(leaf.dtype), partial(_host, leaf, t), _leaf_nbytes(leaf))
         )
     for i in range(cfg.n_layers):
-        for our, (suffix, t) in _LAYER_MAP.items():
+        for our, (suffix, t) in layer_map(cfg).items():
             leaf = params["layers"][our]
             per_layer = _leaf_nbytes(leaf) // leaf.shape[0]
             entries.append(
@@ -313,6 +336,22 @@ def export_checkpoint(
                     per_layer,
                 )
             )
+        if getattr(cfg, "is_moe", False):
+            # per-expert tensors: each expert's [dim, ffn] matrix is its own
+            # entry, so one shard never holds a layer's whole expert stack
+            for our, t in (("w_in", True), ("w_out", True)):
+                leaf = params["layers"][our]  # (L, E, in, out)
+                per_expert = _leaf_nbytes(leaf) // (leaf.shape[0] * leaf.shape[1])
+                for e in range(cfg.n_experts):
+                    entries.append(
+                        (
+                            hf_key(our, i, expert=e)[0],
+                            _out_shape(leaf.shape[2:], t),
+                            _dtype_name(leaf.dtype),
+                            partial(lambda l, j, ex, tr: _host(l[j, ex], tr), leaf, i, e, t),
+                            per_expert,
+                        )
+                    )
 
     local_dir = dest if isinstance(dest, str) else None
     volume_prefix = None if isinstance(dest, str) else dest
@@ -445,18 +484,22 @@ class _LoadPlan:
         import jax.numpy as jnp
         from jax import lax
 
-        if getattr(cfg, "is_moe", False):
-            raise NotImplementedError("MoE checkpoints (expert weights) use a different HF layout; dense only")
-
         self.idx = idx
         self.cfg = cfg
         self.target_dtype = dtype or cfg.dtype
         self.target_name = _dtype_name(np.dtype(self.target_dtype))
         self.params: dict = {"layers": {}}
         self.top_jobs = list(_TOP_MAP)
+        lmap = dict(layer_map(cfg))
+        # expert-stacked tensors ride the same per-layer job pipeline: one
+        # job fetches all of a layer's experts and stacks host-side, so
+        # place_layer/donated-update machinery is identical to dense
+        self.expert_params = tuple(_EXPERT_MAP) if getattr(cfg, "is_moe", False) else ()
+        for our in self.expert_params:
+            lmap[our] = (None, _EXPERT_MAP[our][1])
         self.layer_jobs = [
             (our, transpose, i)
-            for our, (_suffix, transpose) in _LAYER_MAP.items()
+            for our, (_suffix, transpose) in lmap.items()
             for i in range(cfg.n_layers)
         ]
 
@@ -473,7 +516,7 @@ class _LoadPlan:
         self._updates: dict[str, Callable] = {}
         self.slice_shs: dict[str, Any] = {}
         update_fns: dict[tuple, Callable] = {}
-        for our, (_suffix, transpose) in _LAYER_MAP.items():
+        for our, (_suffix, transpose) in lmap.items():
             stacked_sh = _sharding_for(f"layers/{our}")
             if stacked_sh is None:
                 self.slice_shs[our] = None
@@ -482,8 +525,13 @@ class _LoadPlan:
 
                 # P(None, *rest) over the stacked axis -> P(*rest) per layer
                 self.slice_shs[our] = NamedSharding(stacked_sh.mesh, P(*stacked_sh.spec[1:]))
-            _, _, shape0, _, _ = idx.tensors[hf_key(our, 0)[0]]
-            layer_shape = tuple(reversed(shape0)) if transpose else shape0
+            if our in self.expert_params:
+                _, _, shape0, _, _ = idx.tensors[hf_key(our, 0, expert=0)[0]]
+                per_expert = tuple(reversed(shape0)) if transpose else shape0
+                layer_shape = (cfg.n_experts, *per_expert)
+            else:
+                _, _, shape0, _, _ = idx.tensors[hf_key(our, 0, cfg=cfg)[0]]
+                layer_shape = tuple(reversed(shape0)) if transpose else shape0
             stacked_shape = (cfg.n_layers, *layer_shape)
 
             alloc = jax.jit(
@@ -516,7 +564,16 @@ class _LoadPlan:
         return self.cast(await _fetch_tensor(src, self.idx, name), transpose)
 
     async def fetch_layer(self, src: Any, our: str, transpose: bool, i: int) -> np.ndarray:
-        return self.cast(await _fetch_tensor(src, self.idx, hf_key(our, i)[0]), transpose)
+        if our in self.expert_params:
+            # all experts of one layer, fetched in parallel, stacked host-side
+            experts = await asyncio.gather(
+                *[
+                    _fetch_tensor(src, self.idx, hf_key(our, i, expert=e)[0])
+                    for e in range(self.cfg.n_experts)
+                ]
+            )
+            return np.stack([self.cast(arr, transpose) for arr in experts])
+        return self.cast(await _fetch_tensor(src, self.idx, hf_key(our, i, cfg=self.cfg)[0]), transpose)
 
     def place_top(self, our: str, arr: np.ndarray) -> None:
         import jax

@@ -200,6 +200,11 @@ _RPC_NAMES = [
     "ClientHello",
     "TokenFlowCreate",
     "TokenFlowWait",
+    # Workspace (identity/membership/settings; billing is NG)
+    "WorkspaceNameLookup",
+    "WorkspaceMemberList",
+    "WorkspaceSettingsList",
+    "WorkspaceSettingsSet",
     "EnvironmentList",
     "EnvironmentCreate",
     "EnvironmentDelete",

@@ -63,9 +63,18 @@ class ModalTPUServicer:
     async def ClientHello(self, request: api_pb2.ClientHelloRequest, context) -> api_pb2.ClientHelloResponse:
         return api_pb2.ClientHelloResponse(
             server_version="0.1.0",
-            image_builder_version="2026.07",
+            # workspace-wide override (WorkspaceSettingsSet) wins over the
+            # build default — clients pick this up at handshake
+            image_builder_version=self.s.workspace_settings.get("image_builder_version", "2026.07"),
             input_plane_url=self.s.input_plane_url,
         )
+
+    def _resolve_environment(self, name: str) -> str:
+        """Empty environment name resolves to the workspace's configured
+        default (WorkspaceSettingsSet default_environment), falling back to
+        "" (the implicit main) — the reference's per-workspace default
+        environment behavior (_workspace.py:420)."""
+        return name or self.s.workspace_settings.get("default_environment", "")
 
     async def AuthTokenGet(self, request: api_pb2.AuthTokenGetRequest, context) -> api_pb2.AuthTokenGetResponse:
         """Issue an input-plane JWT (reference: AuthTokenGet consumed by
@@ -161,10 +170,74 @@ class ModalTPUServicer:
         # waiter for the same flow — the grant is idempotent, both get the
         # same credentials.
         self.s.tokens[flow["token_id"]] = flow["token_secret"]
+        self.s.token_granted_at.setdefault(flow["token_id"], time.time())
         self.s.pending_token_flows.pop(request.token_flow_id, None)
         return api_pb2.TokenFlowWaitResponse(
             token_id=flow["token_id"], token_secret=flow["token_secret"], workspace_name="local"
         )
+
+    # ------------------------------------------------------------------
+    # Workspace (reference _workspace.py:70; billing RPCs are NG)
+    # ------------------------------------------------------------------
+
+    # settings the local control plane understands; Set validates against
+    # this so a typo'd name fails loudly (reference settings manager has a
+    # curated set too, _workspace.py:387)
+    _WORKSPACE_SETTINGS = ("image_builder_version", "default_environment")
+
+    async def WorkspaceNameLookup(
+        self, request: api_pb2.WorkspaceNameLookupRequest, context
+    ) -> api_pb2.WorkspaceNameLookupResponse:
+        return api_pb2.WorkspaceNameLookupResponse(workspace_name="local", username="local")
+
+    async def WorkspaceMemberList(
+        self, request: api_pb2.WorkspaceMemberListRequest, context
+    ) -> api_pb2.WorkspaceMemberListResponse:
+        members = []
+        ordered = sorted(self.s.tokens, key=lambda t: self.s.token_granted_at.get(t, 0.0))
+        for i, token_id in enumerate(ordered):
+            members.append(
+                api_pb2.WorkspaceMemberInfo(
+                    username=token_id,
+                    role="owner" if i == 0 else "member",
+                    created_at=self.s.token_granted_at.get(token_id, 0.0),
+                )
+            )
+        return api_pb2.WorkspaceMemberListResponse(members=members)
+
+    async def WorkspaceSettingsList(
+        self, request: api_pb2.WorkspaceSettingsListRequest, context
+    ) -> api_pb2.WorkspaceSettingsListResponse:
+        return api_pb2.WorkspaceSettingsListResponse(
+            settings=[
+                api_pb2.WorkspaceSetting(name=k, value=v)
+                for k, v in sorted(self.s.workspace_settings.items())
+            ]
+        )
+
+    async def WorkspaceSettingsSet(
+        self, request: api_pb2.WorkspaceSettingsSetRequest, context
+    ) -> api_pb2.WorkspaceSettingsSetResponse:
+        if request.name not in self._WORKSPACE_SETTINGS:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unknown workspace setting {request.name!r} (known: {', '.join(self._WORKSPACE_SETTINGS)})",
+            )
+        if request.name == "image_builder_version":
+            from ..builder import known_versions
+
+            known = known_versions()
+            if known and request.value not in known:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"unknown image builder version {request.value!r} (known: {', '.join(known)})",
+                )
+        if request.name == "default_environment" and request.value not in self.s.environments:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"environment {request.value!r} does not exist"
+            )
+        self.s.workspace_settings[request.name] = request.value
+        return api_pb2.WorkspaceSettingsSetResponse()
 
     # ------------------------------------------------------------------
     # Apps
@@ -176,12 +249,12 @@ class ModalTPUServicer:
             app_id=app_id,
             description=request.description,
             state=request.app_state or api_pb2.APP_STATE_INITIALIZING,
-            environment_name=request.environment_name,
+            environment_name=self._resolve_environment(request.environment_name),
         )
         return api_pb2.AppCreateResponse(app_id=app_id, app_page_url=f"http://local/apps/{app_id}")
 
     async def AppGetOrCreate(self, request: api_pb2.AppGetOrCreateRequest, context) -> api_pb2.AppGetOrCreateResponse:
-        key = (request.environment_name, request.app_name)
+        key = (self._resolve_environment(request.environment_name), request.app_name)
         app_id = self.s.deployed_apps.get(key)
         if app_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
@@ -192,7 +265,7 @@ class ModalTPUServicer:
                 name=request.app_name,
                 description=request.app_name,
                 state=api_pb2.APP_STATE_DEPLOYED,
-                environment_name=request.environment_name,
+                environment_name=key[0],
             )
             self.s.deployed_apps[key] = app_id
         elif request.object_creation_type == FAIL_IF_EXISTS:

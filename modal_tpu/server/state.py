@@ -322,6 +322,12 @@ class ServerState:
         self.tunnels: dict[tuple[str, int], object] = {}
         self.environments: dict[str, str] = {"main": ""}  # name -> web suffix
         self.tokens: dict[str, str] = {}  # token_id -> token_secret
+        # token_id -> grant timestamp: the local workspace's "members" are
+        # its issued tokens, oldest = owner (services.py WorkspaceMemberList)
+        self.token_granted_at: dict[str, float] = {}
+        # workspace-wide settings (reference _WorkspaceSettingsManager,
+        # _workspace.py:387): validated in WorkspaceSettingsSet
+        self.workspace_settings: dict[str, str] = {}
         # flow_id -> {token_id, token_secret, code, approved: asyncio.Event,
         # localhost_port} — browser-completed token issuance (services.py
         # TokenFlowCreate + blob_server auth route)

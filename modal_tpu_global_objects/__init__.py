@@ -1,0 +1,8 @@
+"""Thin shim mirroring the reference's top-level `modal_global_objects`
+package layout: the implementation lives inside the SDK package
+(`modal_tpu.global_objects`) so the CLI can import it without sys.path
+games."""
+
+from modal_tpu.global_objects import publish_base_images, supported_python_versions
+
+__all__ = ["publish_base_images", "supported_python_versions"]

@@ -39,6 +39,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+# Fast tier (VERDICT r4 #2: the r4 red test shipped because the full 10-min
+# suite was the only tier). `pytest -m fast -q` runs these modules in <2 min
+# on the 1-core CI box: serialization/foundation, kernels-adjacent pure-python
+# units, and one real-gRPC surface per subsystem. Full-stack container tests
+# stay in the default tier.
+_FAST_MODULES = {
+    "test_foundation",
+    "test_quant",
+    "test_traceback",
+    "test_token_flow",
+    "test_proxy_ephemeral",
+    "test_blob_multipart",
+    "test_cli",
+    "test_e2e_function",
+    "test_workspace",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__.rpartition(".")[2] in _FAST_MODULES:
+            item.add_marker(pytest.mark.fast)
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run `async def` tests on a fresh event loop (pytest-asyncio stand-in)."""
     testfunc = pyfuncitem.obj
