@@ -957,6 +957,97 @@ for _cmd in ("list", "create", "delete", "ls", "put", "get", "rm"):
     _alias_volume_command(_cmd)
 
 
+@cli.command("curl", context_settings={"ignore_unknown_options": True})
+@click.argument("target")
+@click.argument("curl_args", nargs=-1, type=click.UNPROCESSED)
+def curl_cmd(target: str, curl_args: tuple[str, ...]) -> None:
+    """HTTP request against a web endpoint (reference cli/curl.py).
+
+    TARGET is either a full URL or an `app-name/function-name` ref, which
+    resolves to the deployed function's web URL (long-polling while its
+    serving container boots). Remaining arguments pass through to system
+    curl, e.g.:  modal-tpu curl my-app/hello -X POST -d '{"x": 1}'
+    """
+    import subprocess
+
+    if target.startswith("http://") or target.startswith("https://"):
+        url = target
+    else:
+        app_name, sep, fn_name = target.partition("/")
+        if not sep or not fn_name:
+            raise click.UsageError("target must be a URL or app-name/function-name")
+        from ..functions import Function
+
+        fn = Function.from_name(app_name, fn_name)
+        fn.hydrate()
+        url = fn.get_web_url()
+    raise SystemExit(subprocess.call(["curl", "-sS", url, *curl_args]))
+
+
+@cli.group("launch")
+def launch_group() -> None:
+    """Open a prebuilt interactive app (reference cli/launch.py)."""
+
+
+@launch_group.command("python")
+@click.option("--tpu", default=None, help="TPU slice for the REPL's container, e.g. v5e-1.")
+def launch_python(tpu: Optional[str]) -> None:
+    """Interactive Python REPL inside a fresh (optionally chip-pinned)
+    container — the TPU-native launch program: `jax.devices()` in the REPL
+    sees the pinned slice."""
+    from .._utils.pty_shell import run_pty_session
+    from ..sandbox import Sandbox
+
+    sb = Sandbox.create("sleep", "86400", tpu=tpu, timeout=86400)
+    try:
+        if sys.stdin.isatty():
+            raise SystemExit(run_pty_session(sb, [sys.executable, "-i"]))
+        # piped stdin: run the code through the REPL non-interactively
+        code = sys.stdin.read()
+        p = sb.exec(sys.executable, "-c", code)
+        rc = p.wait()
+        sys.stdout.write(p.stdout.read())
+        sys.stderr.write(p.stderr.read())
+        raise SystemExit(rc)
+    finally:
+        sb.terminate()
+
+
+@launch_group.command("jupyter")
+@click.option("--tpu", default=None, help="TPU slice for the server's container.")
+@click.option("--port", default=8888, help="Port jupyter binds inside the container.")
+def launch_jupyter(tpu: Optional[str], port: int) -> None:
+    """Jupyter Lab in a container with a tunnel back to this machine
+    (reference cli/programs/run_jupyter.py). Requires jupyterlab in the
+    container image — fails loudly when absent."""
+    from ..sandbox import Sandbox
+
+    # keep-alive entrypoint; jupyter starts via exec AFTER the import probe —
+    # probing a dead sandbox (jupyter-as-entrypoint crashing instantly) would
+    # bury the real problem under a router error
+    sb = Sandbox.create("sleep", "86400", tpu=tpu, timeout=86400, unencrypted_ports=[port])
+    try:
+        probe = sb.exec(sys.executable, "-c", "import jupyterlab")
+        if probe.wait() != 0:
+            raise click.ClickException(
+                "jupyterlab is not importable in this image — add "
+                "`.pip_install('jupyterlab')` to the image (no network egress "
+                "in local dev means the base image must already carry it)"
+            )
+        server = sb.exec(
+            sys.executable, "-m", "jupyterlab",
+            "--allow-root", "--ip=0.0.0.0", f"--port={port}", "--no-browser",
+        )
+        tunnels = sb.tunnels()
+        url = tunnels[port].url if port in tunnels else "(no tunnel reported)"
+        click.echo(f"Jupyter Lab: {url}  (Ctrl-C stops the sandbox)")
+        server.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sb.terminate()
+
+
 @cli.group("workspace")
 def workspace_group() -> None:
     """Workspace identity, members, and settings."""

@@ -29,10 +29,30 @@ def supported_python_versions(builder_version: str) -> list[str]:
 def publish_base_images(builder_version: str | None = None) -> list[str]:
     """Build (or reuse) each base image through the REAL path — a probe
     function scheduled onto a worker — and return the built image ids."""
+    import os
+
     import modal_tpu
     from modal_tpu.config import config
 
     builder_version = builder_version or config["image_builder_version"]
+    # the image epoch is resolved inside Image._load (env override >
+    # ClientHello workspace default > config) — an explicit version here
+    # must pin the env override or the flag would only filter pythons while
+    # the ACTIVE epoch gets built (review r5 finding)
+    prev = os.environ.get("MODAL_TPU_IMAGE_BUILDER_VERSION")
+    os.environ["MODAL_TPU_IMAGE_BUILDER_VERSION"] = builder_version
+    try:
+        return _publish(builder_version)
+    finally:
+        if prev is None:
+            os.environ.pop("MODAL_TPU_IMAGE_BUILDER_VERSION", None)
+        else:
+            os.environ["MODAL_TPU_IMAGE_BUILDER_VERSION"] = prev
+
+
+def _publish(builder_version: str) -> list[str]:
+    import modal_tpu
+
     app = modal_tpu.App("global-base-images")
     probes = []
     for version in supported_python_versions(builder_version):

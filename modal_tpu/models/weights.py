@@ -339,7 +339,7 @@ def export_checkpoint(
         if getattr(cfg, "is_moe", False):
             # per-expert tensors: each expert's [dim, ffn] matrix is its own
             # entry, so one shard never holds a layer's whole expert stack
-            for our, t in (("w_in", True), ("w_out", True)):
+            for our, (_suffix, t) in _EXPERT_MAP.items():
                 leaf = params["layers"][our]  # (L, E, in, out)
                 per_expert = _leaf_nbytes(leaf) // (leaf.shape[0] * leaf.shape[1])
                 for e in range(cfg.n_experts):

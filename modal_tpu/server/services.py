@@ -223,6 +223,10 @@ class ModalTPUServicer:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"unknown workspace setting {request.name!r} (known: {', '.join(self._WORKSPACE_SETTINGS)})",
             )
+        if not request.value:
+            # empty value = unset (there is no separate delete RPC)
+            self.s.workspace_settings.pop(request.name, None)
+            return api_pb2.WorkspaceSettingsSetResponse()
         if request.name == "image_builder_version":
             from ..builder import known_versions
 
@@ -392,7 +396,7 @@ class ModalTPUServicer:
         return api_pb2.AppDeployResponse(url=f"http://local/apps/{app.app_id}")
 
     async def AppGetByDeploymentName(self, request, context) -> api_pb2.AppGetByDeploymentNameResponse:
-        app_id = self.s.deployed_apps.get((request.environment_name, request.name))
+        app_id = self.s.deployed_apps.get((self._resolve_environment(request.environment_name), request.name))
         if app_id is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"deployed app {request.name!r} not found")
         return api_pb2.AppGetByDeploymentNameResponse(app_id=app_id)
@@ -509,7 +513,7 @@ class ModalTPUServicer:
         )
 
     async def FunctionGet(self, request: api_pb2.FunctionGetRequest, context) -> api_pb2.FunctionGetResponse:
-        key = (request.environment_name, request.app_name, request.object_tag)
+        key = (self._resolve_environment(request.environment_name), request.app_name, request.object_tag)
         fn_id = self.s.deployed_functions.get(key)
         if fn_id is None:
             await context.abort(
@@ -2229,7 +2233,7 @@ class ModalTPUServicer:
             return api_pb2.VolumeGetOrCreateResponse(
                 volume_id=volume_id, metadata=api_pb2.VolumeMetadata(version=request.version)
             )
-        key = (request.environment_name, request.deployment_name)
+        key = (self._resolve_environment(request.environment_name), request.deployment_name)
         volume_id = self.s.deployed_volumes.get(key)
         if volume_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
@@ -2390,7 +2394,7 @@ class ModalTPUServicer:
             secret_id = make_id("st")
             self.s.secrets[secret_id] = SecretState(secret_id=secret_id, env_dict=dict(request.env_dict))
             return api_pb2.SecretGetOrCreateResponse(secret_id=secret_id)
-        key = (request.environment_name, request.deployment_name)
+        key = (self._resolve_environment(request.environment_name), request.deployment_name)
         secret_id = self.s.deployed_secrets.get(key)
         if secret_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS) and not request.env_dict:
@@ -2434,7 +2438,7 @@ class ModalTPUServicer:
     async def ProxyCreate(self, request: api_pb2.ProxyCreateRequest, context) -> api_pb2.ProxyCreateResponse:
         if not request.name:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "proxy name required")
-        key = (request.environment_name, request.name)
+        key = (self._resolve_environment(request.environment_name), request.name)
         if key in self.s.deployed_proxies:
             await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"proxy {request.name!r} exists")
         proxy_id = make_id("pr")
@@ -2458,7 +2462,9 @@ class ModalTPUServicer:
             proxy_id=proxy_id,
             name=request.name,
             proxy_ip=ip,
-            environment_name=request.environment_name,
+            # resolved, so ProxyDelete's (environment, name) un-keying
+            # matches the deployed_proxies key written below
+            environment_name=key[0],
         )
         self.s.proxies[proxy_id] = proxy
         self.s.deployed_proxies[key] = proxy_id
@@ -2467,7 +2473,7 @@ class ModalTPUServicer:
         )
 
     async def ProxyGet(self, request: api_pb2.ProxyGetRequest, context) -> api_pb2.ProxyGetResponse:
-        proxy_id = self.s.deployed_proxies.get((request.environment_name, request.name))
+        proxy_id = self.s.deployed_proxies.get((self._resolve_environment(request.environment_name), request.name))
         if proxy_id is None:
             await context.abort(
                 grpc.StatusCode.NOT_FOUND,
@@ -2541,7 +2547,7 @@ class ModalTPUServicer:
                 last_heartbeat=time.time(),
             )
             return api_pb2.DictGetOrCreateResponse(dict_id=dict_id)
-        key = (request.environment_name, request.deployment_name)
+        key = (self._resolve_environment(request.environment_name), request.deployment_name)
         dict_id = self.s.deployed_dicts.get(key)
         if dict_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
@@ -2635,7 +2641,7 @@ class ModalTPUServicer:
                 last_heartbeat=time.time(),
             )
             return api_pb2.QueueGetOrCreateResponse(queue_id=queue_id)
-        key = (request.environment_name, request.deployment_name)
+        key = (self._resolve_environment(request.environment_name), request.deployment_name)
         queue_id = self.s.deployed_queues.get(key)
         if queue_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):

@@ -51,9 +51,9 @@ _FAST_MODULES = {
     "test_token_flow",
     "test_proxy_ephemeral",
     "test_blob_multipart",
-    "test_cli",
     "test_e2e_function",
     "test_workspace",
+    "test_docs_gen",
 }
 
 

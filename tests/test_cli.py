@@ -346,16 +346,24 @@ def test_cli_container_stop_kills_worker_process(cli_runner, supervisor):
 
     f = app.function(serialized=True)(slow)
     with app.run():
-        f.spawn(1)
+        call = f.spawn(1)
         worker = supervisor.workers[0]
-        deadline = time.monotonic() + 20
+        # wait until the input is claimed by a task AND that task's process
+        # is registered on the worker (not just any ta- process)
+        deadline = time.monotonic() + 30
         task_id = None
         while time.monotonic() < deadline and task_id is None:
-            task_id = next((tid for tid in worker._procs if tid.startswith("ta-")), None)
+            claimed = [
+                inp.claimed_by
+                for inp in supervisor.state.inputs.values()
+                if inp.function_call_id == call.object_id and inp.claimed_by
+            ]
+            if claimed and claimed[0] in worker._procs:
+                task_id = claimed[0]
             time.sleep(0.2)
         assert task_id is not None, "container process never appeared on the worker"
         cli_runner("container", "stop", task_id)
-        deadline = time.monotonic() + 25
+        deadline = time.monotonic() + 40
         while time.monotonic() < deadline and task_id in worker._procs:
             time.sleep(0.25)
         assert task_id not in worker._procs, "worker process survived container stop"
@@ -404,6 +412,53 @@ def test_cli_cluster_list_rendezvous_states(cli_runner, supervisor):
             # rendezvous completion is the hard assertion
     finally:
         os.environ.pop("MODAL_TPU_SKIP_JAX_DISTRIBUTED", None)
+
+
+def test_cli_curl_hits_web_endpoint(cli_runner, supervisor):
+    """`modal-tpu curl <url>` (reference cli/curl.py) passes through to
+    system curl against a live web endpoint."""
+    import modal_tpu
+
+    app = modal_tpu.App("curl-app")
+
+    @app.function(serialized=True)
+    @modal_tpu.web_endpoint(method="GET")
+    def hello(name="world"):
+        return f"hi {name}"
+
+    with app.run():
+        url = hello.get_web_url()
+        # system curl writes to the REAL stdout: capture via a subprocess
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable, "-m", "modal_tpu.cli", "curl", url + "?name=curl"],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "hi curl" in proc.stdout
+    # bad ref errors loudly
+    from click.testing import CliRunner
+
+    from modal_tpu.cli.entry_point import cli
+
+    result = CliRunner().invoke(cli, ["curl", "not-a-ref"])
+    assert result.exit_code != 0
+
+
+def test_cli_launch_python_piped(cli_runner, supervisor):
+    """`modal-tpu launch python` with piped stdin runs the code in a fresh
+    container and streams the output back."""
+    from click.testing import CliRunner
+
+    from modal_tpu.cli.entry_point import cli
+
+    result = CliRunner().invoke(cli, ["launch", "python"], input="print('repl says', 6*7)\n")
+    assert result.exit_code == 0, result.output
+    assert "repl says 42" in result.output
 
 
 def test_cli_image_prebuild_publishes_bases(cli_runner, supervisor):

@@ -1,0 +1,41 @@
+"""Docs generator (reference py/modal_docs; VERDICT §2a 'Docs generator'
+row): pure-introspection markdown for the API surface + CLI tree."""
+
+import os
+
+
+def test_reference_docs_cover_public_api(tmp_path):
+    import modal_tpu
+    from modal_tpu_docs import gen_reference_docs
+
+    out = str(tmp_path / "ref")
+    written = gen_reference_docs(out)
+    names = {os.path.basename(p)[:-3] for p in written}
+    # every public export gets a page
+    for required in ("App", "Function", "Volume", "Sandbox", "Proxy", "Workspace", "clustered"):
+        assert required in names, f"missing docs page for {required}"
+    fn_doc = open(os.path.join(out, "Function.md")).read()
+    assert "Function.remote" in fn_doc or "remote(" in fn_doc
+    assert ".aio" in fn_doc, "duality note missing"
+    index = open(os.path.join(out, "index.md")).read()
+    assert "[`App`](App.md)" in index
+
+
+def test_cli_docs_cover_groups(tmp_path):
+    from modal_tpu_docs import gen_cli_docs
+
+    path = gen_cli_docs(str(tmp_path))
+    text = open(path).read()
+    for group in ("app", "volume", "proxy", "workspace", "token", "image", "cluster"):
+        assert f"## `modal-tpu {group}`" in text, f"missing CLI group {group}"
+    assert "modal-tpu run" in text
+    assert "Options:" in text
+
+
+def test_docs_reject_todo_leaks(tmp_path):
+    import pytest
+
+    from modal_tpu_docs import _validate
+
+    with pytest.raises(ValueError, match="unwanted string"):
+        _validate("x", "fine line\nTODO: oops\n")

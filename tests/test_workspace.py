@@ -93,6 +93,43 @@ def test_workspace_settings_take_effect(supervisor):
     assert supervisor.state.apps[app_resp.app_id].environment_name == "staging-ws"
 
 
+def test_default_environment_consistent_across_create_and_lookup(supervisor):
+    """Review r5 finding: with a default_environment set, deploy-then-lookup
+    must resolve the SAME key on both sides — Function.from_name and app
+    get-by-name find what AppDeploy stored; unsetting (empty value) works."""
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.proto import api_pb2
+
+    ws = modal_tpu.Workspace.from_context()
+    ws.hydrate()
+
+    async def rpc(c, name, req):
+        return await getattr(c.stub, name)(req)
+
+    synchronizer.run(rpc(ws.client, "EnvironmentCreate", api_pb2.EnvironmentCreateRequest(name="defenv")))
+    ws.settings.set("default_environment", "defenv")
+
+    app = modal_tpu.App("defenv-app")
+
+    def fn(x):
+        return x + 1
+
+    f = app.function(serialized=True, name="fn")(fn)
+    app.deploy(name="defenv-app")
+    # lookup with NO environment given resolves through the same default
+    looked = modal_tpu.Function.from_name("defenv-app", "fn")
+    looked.hydrate()
+    assert looked.object_id == f.object_id
+    resp = synchronizer.run(
+        rpc(ws.client, "AppGetByDeploymentName", api_pb2.AppGetByDeploymentNameRequest(name="defenv-app"))
+    )
+    assert resp.app_id
+    # unset via empty value; the deployment remains findable under "defenv"
+    ws.settings.set("default_environment", "")
+    assert "default_environment" not in ws.settings.list()
+
+
 def test_workspace_cli(supervisor, tmp_path, monkeypatch):
     from click.testing import CliRunner
 
