@@ -61,9 +61,11 @@ SMOKE8B_TIMEOUT_S = float(os.environ.get("MODAL_TPU_BENCH_SMOKE8B_TIMEOUT", "420
 # watcher's status file is folded into every emitted JSON as proof of
 # continuous sampling. The chip flock serializes the watcher's attempt
 # against this bench's own (one v5e chip, two jax processes = both lose).
-BANKED_PATH = os.path.join(REPO_ROOT, ".tpu_bench_banked.json")
-WATCH_STATUS_PATH = os.path.join(REPO_ROOT, ".relay_watch_status.json")
-CHIP_LOCK_PATH = os.path.join(REPO_ROOT, ".tpu_chip.lock")
+BANKED_PATH = os.environ.get("MODAL_TPU_BANKED_PATH", os.path.join(REPO_ROOT, ".tpu_bench_banked.json"))
+WATCH_STATUS_PATH = os.environ.get(
+    "MODAL_TPU_WATCH_STATUS_PATH", os.path.join(REPO_ROOT, ".relay_watch_status.json")
+)
+CHIP_LOCK_PATH = os.environ.get("MODAL_TPU_CHIP_LOCK_PATH", os.path.join(REPO_ROOT, ".tpu_chip.lock"))
 
 
 def _load_banked() -> dict | None:

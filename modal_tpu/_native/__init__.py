@@ -74,6 +74,17 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.mtpu_hash_blocks.restype = None
             lib.mtpu_sha256.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
             lib.mtpu_sha256.restype = None
+            # guard: a stale cached .so (built before the file API existed)
+            # must degrade ONLY file hashing, not disable hash_blocks too
+            if hasattr(lib, "mtpu_hash_file_blocks"):
+                lib.mtpu_hash_file_blocks.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_uint64,
+                    ctypes.c_char_p,
+                    ctypes.c_uint64,
+                    ctypes.c_int,
+                ]
+                lib.mtpu_hash_file_blocks.restype = ctypes.c_int64
             _lib = lib
         except Exception as exc:
             logger.debug(f"native blockhash unavailable ({exc}); using hashlib")
@@ -106,6 +117,33 @@ def hash_blocks(data: bytes, block_size: int, n_threads: int = 0) -> list[str]:
         raw = out.raw
         return [raw[i * 32 : (i + 1) * 32].hex() for i in range(n_blocks)]
     return hashlib_blocks(data, block_size)
+
+
+def hash_file_blocks(path: str, block_size: int, n_threads: int = 0) -> "list[str] | None":
+    """SHA-256 hex digest of each `block_size` block of a FILE, hashed by
+    worker threads preading through private buffers — the file never
+    materializes in this process as Python bytes (the chunked-IO engine for
+    volume/checkpoint uploads). Returns None when the native library is
+    unavailable or IO fails (caller falls back to the python loop)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "mtpu_hash_file_blocks"):
+        return None
+    try:
+        size = os.stat(path).st_size
+        encoded = os.fsencode(path)  # surrogate-escaped names must not crash
+        n_blocks = 1 if size == 0 else (size + block_size - 1) // block_size
+        out = ctypes.create_string_buffer(n_blocks * 32)
+        # the C side re-checks the block count against `n_blocks` and refuses
+        # to write on mismatch (file grew between stat and hash)
+        got = lib.mtpu_hash_file_blocks(encoded, block_size, out, n_blocks, n_threads)
+    except Exception as exc:  # noqa: BLE001 — any failure = python fallback
+        logger.debug(f"native file hashing errored for {path!r} ({exc}); falling back")
+        return None
+    if got != n_blocks:
+        logger.debug(f"native file hashing failed for {path!r} (rc={got}); falling back")
+        return None
+    raw = out.raw
+    return [raw[i * 32 : (i + 1) * 32].hex() for i in range(n_blocks)]
 
 
 def sha256_hex(data: bytes) -> str:

@@ -95,3 +95,30 @@ def get_blocks_sha256(data: bytes, block_size: int = BLOCK_SIZE) -> list[str]:
     if os.environ.get("MODAL_TPU_NATIVE_HASH") == "1" and native_available():
         return hash_blocks(data, block_size)
     return hashlib_blocks(data, block_size)
+
+
+def get_file_blocks_sha256(path, block_size: int = BLOCK_SIZE) -> list[str]:
+    """Per-block sha256 hex digests of a file on disk.
+
+    With MODAL_TPU_NATIVE_HASH=1 the native engine preads + hashes blocks in
+    worker threads — no per-block Python bytes, no GIL serialization (the
+    chunked-IO path for multi-GB checkpoint uploads on many-core workers).
+    Fallback: chunked hashlib reads, constant memory."""
+    import os
+
+    if os.environ.get("MODAL_TPU_NATIVE_HASH") == "1":
+        from .._native import hash_file_blocks
+
+        native = hash_file_blocks(str(path), block_size)
+        if native is not None:
+            return native
+    shas: list[str] = []
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(block_size)
+            if not block and shas:
+                break
+            shas.append(hashlib.sha256(block).hexdigest())
+            if len(block) < block_size:
+                break
+    return shas

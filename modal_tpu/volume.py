@@ -317,17 +317,27 @@ class _VolumeUploadContextManager:
                     src.seek(0)
                     mode = 0o644
                     reader = lambda off, ln, f=src: _read_fileobj_range(f, off, ln)
-            shas = []
-            off = 0
-            while off < size or (size == 0 and off == 0):
-                ln = min(BLOCK_SIZE, size - off)
-                data = reader(off, ln)
-                sha = get_sha256_hex(data)
-                shas.append(sha)
-                block_data[sha] = (reader, off, ln)
-                off += BLOCK_SIZE
-                if size == 0:
-                    break
+            if path is not None:
+                # whole-file block hashing in one call (native threaded
+                # pread engine when opted in — no per-block Python bytes)
+                from ._utils.hash_utils import get_file_blocks_sha256
+
+                shas = get_file_blocks_sha256(path, BLOCK_SIZE)
+                for i, sha in enumerate(shas):
+                    off = i * BLOCK_SIZE
+                    block_data[sha] = (reader, off, min(BLOCK_SIZE, max(0, size - off)))
+            else:
+                shas = []
+                off = 0
+                while off < size or (size == 0 and off == 0):
+                    ln = min(BLOCK_SIZE, size - off)
+                    data = reader(off, ln)
+                    sha = get_sha256_hex(data)
+                    shas.append(sha)
+                    block_data[sha] = (reader, off, ln)
+                    off += BLOCK_SIZE
+                    if size == 0:
+                        break
             files.append(
                 api_pb2.VolumeFile(
                     path=remote_path.lstrip("/"), size=size, mode=mode, block_sha256_hex=shas

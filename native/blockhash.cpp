@@ -13,6 +13,11 @@
 #include <thread>
 #include <vector>
 #include <algorithm>
+#include <atomic>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 namespace {
 
@@ -140,5 +145,70 @@ void mtpu_hash_blocks(const uint8_t* data, uint64_t len, uint64_t block_size,
 // Single-shot sha256 (for parity checks).
 void mtpu_sha256(const uint8_t* data, uint64_t len, uint8_t* out) {
   sha256(data, len, out);
+}
+
+// Hash a FILE's blocks without ever materializing it in the caller's
+// address space: each worker thread preads its own blocks through a private
+// block_size buffer (thread-safe on one fd; no GIL, no Python bytes per
+// block). Writes 32 bytes per block into `out`, which holds `out_blocks`
+// slots — the caller sized it from its own stat, and a file that GREW in
+// between must NOT overflow the buffer: a count mismatch returns -2 and
+// writes nothing. Returns the number of blocks hashed, or -1 on IO error.
+// Zero-length files hash one empty block (same convention as
+// mtpu_hash_blocks).
+int64_t mtpu_hash_file_blocks(const char* path, uint64_t block_size,
+                              uint8_t* out, uint64_t out_blocks,
+                              int n_threads) {
+  if (block_size == 0) return -1;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  uint64_t len = (uint64_t)st.st_size;
+  uint64_t n_blocks = len == 0 ? 1 : (len + block_size - 1) / block_size;
+  if (n_blocks != out_blocks) {
+    ::close(fd);
+    return -2;  // file changed size since the caller sized `out`
+  }
+  if (n_threads <= 0) n_threads = (int)std::thread::hardware_concurrency();
+  n_threads = std::max(1, std::min<int>(n_threads, (int)n_blocks));
+
+  std::atomic<bool> io_error{false};
+  auto worker = [&](uint64_t start, uint64_t end) {
+    std::vector<uint8_t> buf(block_size);
+    for (uint64_t b = start; b < end && !io_error.load(std::memory_order_relaxed); b++) {
+      uint64_t off = b * block_size;
+      uint64_t blen = (off >= len) ? 0 : std::min<uint64_t>(block_size, len - off);
+      uint64_t got = 0;
+      while (got < blen) {
+        ssize_t r = ::pread(fd, buf.data() + got, blen - got, (off_t)(off + got));
+        if (r <= 0) {
+          io_error.store(true, std::memory_order_relaxed);
+          break;
+        }
+        got += (uint64_t)r;
+      }
+      if (io_error.load(std::memory_order_relaxed)) break;
+      sha256(buf.data(), blen, out + b * 32);
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n_blocks);
+  } else {
+    std::vector<std::thread> threads;
+    uint64_t per = (n_blocks + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; t++) {
+      uint64_t start = t * per;
+      uint64_t end = std::min(n_blocks, start + per);
+      if (start >= end) break;
+      threads.emplace_back(worker, start, end);
+    }
+    for (auto& th : threads) th.join();
+  }
+  ::close(fd);
+  return io_error.load() ? -1 : (int64_t)n_blocks;
 }
 }

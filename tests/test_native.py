@@ -25,6 +25,49 @@ def test_native_hash_matches_hashlib(monkeypatch):
     assert hashes == expected
 
 
+def test_native_file_hash_matches_hashlib(tmp_path, monkeypatch):
+    """The threaded pread engine and the pure-python loop agree, including
+    the ragged tail block and the empty file (one empty-block hash)."""
+    monkeypatch.setenv("MODAL_TPU_NATIVE_HASH", "1")
+    from modal_tpu._native import hash_file_blocks, native_available
+    from modal_tpu._utils.hash_utils import get_file_blocks_sha256
+
+    if not native_available():
+        pytest.skip("native library unavailable (no toolchain)")
+
+    block = 8192
+    f = tmp_path / "payload.bin"
+    data = bytes(range(256)) * 700 + b"ragged-tail"
+    f.write_bytes(data)
+    expected = [
+        hashlib.sha256(data[off : off + block]).hexdigest() for off in range(0, len(data), block)
+    ]
+    assert hash_file_blocks(str(f), block) == expected
+    assert get_file_blocks_sha256(f, block) == expected
+    # empty file: one empty-block hash (mtpu_hash_blocks convention)
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    assert hash_file_blocks(str(empty), block) == [hashlib.sha256(b"").hexdigest()]
+    assert get_file_blocks_sha256(empty, block) == [hashlib.sha256(b"").hexdigest()]
+    # missing file: native returns None, hash_utils raises like open() would
+    assert hash_file_blocks(str(tmp_path / "ghost"), block) is None
+
+
+def test_volume_file_upload_uses_block_hash_path(supervisor, tmp_path):
+    """End-to-end: a file uploaded to a Volume via the whole-file hashing
+    path round-trips byte-identically."""
+    import modal_tpu
+
+    data = os.urandom(3 * 1024 * 1024 + 17)
+    src = tmp_path / "blob.bin"
+    src.write_bytes(data)
+    vol = modal_tpu.Volume.from_name("native-hash-vol", create_if_missing=True)
+    vol.hydrate()
+    with vol.batch_upload() as batch:
+        batch.put_file(str(src), "blob.bin")
+    assert b"".join(vol.read_file("blob.bin")) == data
+
+
 @pytest.mark.slow
 def test_blockhash_under_thread_sanitizer(tmp_path):
     """Build the hasher with -fsanitize=thread and hammer it with 16 threads

@@ -1,0 +1,165 @@
+"""tools/relay_watcher.py — the round-long TPU evidence harness (VERDICT r4
+#1). These tests drive the real watcher process against a stub relay (a
+plain TCP listener) and a stub bench child, asserting the full chain: poll →
+confirm-alive → attempt under the chip flock → bank → stop attempting."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCHER = os.path.join(REPO, "tools", "relay_watcher.py")
+
+STUB_BENCH = """\
+import json, sys
+result = {"metric": "decode_tokens_per_s_per_chip[stub]", "value": 777.0,
+           "unit": "tokens/s/chip", "vs_baseline": 1.0, "platform": "tpu"}
+print("BENCH_RESULT " + json.dumps(result))
+"""
+
+
+def _watch_env(tmp_path, port, extra=None):
+    env = dict(os.environ)
+    stub = tmp_path / "stub_bench.py"
+    stub.write_text(STUB_BENCH)
+    env.update(
+        {
+            "MODAL_TPU_RELAY_PORT": str(port),
+            "MODAL_TPU_WATCH_POLL": "0.2",
+            "MODAL_TPU_WATCH_DEADLINE": "30",
+            "MODAL_TPU_WATCH_ALIVE_CONFIRM": "2",
+            "MODAL_TPU_WATCH_ATTEMPT_TIMEOUT": "30",
+            "MODAL_TPU_BANKED_PATH": str(tmp_path / "banked.json"),
+            "MODAL_TPU_WATCH_STATUS_PATH": str(tmp_path / "status.json"),
+            "MODAL_TPU_WATCH_LOG_PATH": str(tmp_path / "watch.log"),
+            "MODAL_TPU_CHIP_LOCK_PATH": str(tmp_path / "chip.lock"),
+            "MODAL_TPU_WATCH_BENCH_CMD": f"{sys.executable} {stub}",
+        }
+    )
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_watcher_banks_result_when_relay_rises(tmp_path):
+    """Dead relay → polling evidence accumulates; relay rises → one bench
+    attempt runs and its TPU result is banked; no further attempts after."""
+    port = _free_port()
+    env = _watch_env(tmp_path, port)
+    proc = subprocess.Popen([sys.executable, WATCHER], env=env)
+    try:
+        # phase 1: relay dead — status accumulates dead checks
+        deadline = time.monotonic() + 10
+        status = {}
+        while time.monotonic() < deadline:
+            try:
+                status = json.loads((tmp_path / "status.json").read_text())
+            except (FileNotFoundError, json.JSONDecodeError):
+                status = {}
+            if status.get("checks", 0) >= 3:
+                break
+            time.sleep(0.1)
+        assert status.get("checks", 0) >= 3 and status.get("alive_checks") == 0
+
+        # phase 2: the relay rises
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", port))
+        listener.listen(16)
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not (tmp_path / "banked.json").exists():
+                time.sleep(0.2)
+            banked = json.loads((tmp_path / "banked.json").read_text())
+            assert banked["platform"] == "tpu" and banked["value"] == 777.0
+            assert banked["banked_by_watcher"] is True and banked["banked_at"] > 0
+
+            # no further attempts once banked (but polling continues)
+            time.sleep(1.5)
+            status = json.loads((tmp_path / "status.json").read_text())
+            assert status["banked"] is True
+            assert len(status["attempts"]) == 1
+            assert status["alive_checks"] > 0
+        finally:
+            listener.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_watcher_archives_stale_bank_at_startup(tmp_path):
+    """A banked result from a previous round must be archived, not shipped
+    as this round's evidence."""
+    port = _free_port()
+    (tmp_path / "banked.json").write_text(json.dumps({"platform": "tpu", "value": 1.0}))
+    env = _watch_env(tmp_path, port)
+    proc = subprocess.Popen([sys.executable, WATCHER], env=env)
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not (tmp_path / "banked.json.prev").exists():
+            time.sleep(0.1)
+        assert (tmp_path / "banked.json.prev").exists(), "stale bank not archived"
+        assert not (tmp_path / "banked.json").exists()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_bench_phase0_prefers_banked_and_embeds_watch_stats(tmp_path):
+    """bench.py phase 0 ships the watcher-banked TPU result, folding in the
+    round-long sampling evidence."""
+    banked = {
+        "metric": "decode_tokens_per_s_per_chip[stub]",
+        "value": 777.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 1.0,
+        "platform": "tpu",
+        "banked_by_watcher": True,
+    }
+    (tmp_path / "banked.json").write_text(json.dumps(banked))
+    (tmp_path / "status.json").write_text(
+        json.dumps(
+            {
+                "started_at": 1000.0,
+                "last_write_at": 8200.0,
+                "checks": 480,
+                "alive_checks": 12,
+                "attempts": [{"at": 8100.0, "outcome": "result platform=tpu"}],
+            }
+        )
+    )
+    env = dict(os.environ)
+    env.update(
+        {
+            "MODAL_TPU_BANKED_PATH": str(tmp_path / "banked.json"),
+            "MODAL_TPU_WATCH_STATUS_PATH": str(tmp_path / "status.json"),
+            "MODAL_TPU_CHIP_LOCK_PATH": str(tmp_path / "chip.lock"),
+            "MODAL_TPU_BENCH_TIMEOUT": "60",
+        }
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # relay-dead path: no live attempt
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=50,
+        env=env,
+    )
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["platform"] == "tpu" and result["value"] == 777.0
+    assert result["banked_by_watcher"] is True
+    assert result["relay_watch_seconds"] == 7200
+    assert result["relay_watch_checks"] == 480
+    assert result["relay_watch_attempts"][0]["outcome"].startswith("result platform=tpu")

@@ -49,10 +49,12 @@ MAX_ATTEMPTS = int(os.environ.get("MODAL_TPU_WATCH_MAX_ATTEMPTS", "6"))
 # one probe should not burn a 25-minute attempt budget.
 ALIVE_CONFIRM = int(os.environ.get("MODAL_TPU_WATCH_ALIVE_CONFIRM", "2"))
 
-BANKED_PATH = os.path.join(REPO_ROOT, ".tpu_bench_banked.json")
-STATUS_PATH = os.path.join(REPO_ROOT, ".relay_watch_status.json")
-LOG_PATH = os.path.join(REPO_ROOT, ".relay_watch.log")
-CHIP_LOCK_PATH = os.path.join(REPO_ROOT, ".tpu_chip.lock")
+# state-file locations (env-overridable so tests run against a tmp dir —
+# bench.py reads the same two knobs)
+BANKED_PATH = os.environ.get("MODAL_TPU_BANKED_PATH", os.path.join(REPO_ROOT, ".tpu_bench_banked.json"))
+STATUS_PATH = os.environ.get("MODAL_TPU_WATCH_STATUS_PATH", os.path.join(REPO_ROOT, ".relay_watch_status.json"))
+LOG_PATH = os.environ.get("MODAL_TPU_WATCH_LOG_PATH", os.path.join(REPO_ROOT, ".relay_watch.log"))
+CHIP_LOCK_PATH = os.environ.get("MODAL_TPU_CHIP_LOCK_PATH", os.path.join(REPO_ROOT, ".tpu_chip.lock"))
 
 
 def _log(msg: str) -> None:
@@ -91,11 +93,20 @@ def _run_tpu_attempt(status: dict) -> dict | None:
     attempt = {"at": time.time(), "outcome": "started"}
     status["attempts"].append(attempt)
     _write_status(status)
+    # test seam: the full bench child takes minutes; tests substitute a stub
+    # that prints a canned BENCH_RESULT line
+    bench_cmd = os.environ.get("MODAL_TPU_WATCH_BENCH_CMD")
+    if bench_cmd:
+        import shlex
+
+        argv = shlex.split(bench_cmd)
+    else:
+        argv = [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--mode", "tpu"]
     lock_f = open(CHIP_LOCK_PATH, "w")
     try:
         fcntl.flock(lock_f, fcntl.LOCK_EX)  # serialize vs bench.py's own attempt
         proc = subprocess.Popen(
-            [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--mode", "tpu"],
+            argv,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             env=env,
