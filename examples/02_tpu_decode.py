@@ -1,0 +1,50 @@
+"""LLM decode on a TPU slice: pin a chip, load weights once per container
+with @enter(snap=True) (warm-state snapshots skip it on later cold boots),
+serve decodes.
+
+    python examples/02_tpu_decode.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo checkout
+
+import modal_tpu
+
+app = modal_tpu.App("example-decode")
+
+
+@app.cls(tpu="v5e-1", enable_memory_snapshot=True, serialized=True)
+class Decoder:
+    @modal_tpu.enter(snap=True)
+    def load(self):
+        import jax
+
+        from modal_tpu.models.llama import get_config, init_params
+
+        # real deployments stream HF safetensors from a Volume:
+        #   from modal_tpu.models.weights import load_params
+        #   self.params = load_params(modal_tpu.Volume.from_name("weights"), cfg)
+        self.cfg = get_config("tiny")
+        self.params = init_params(self.cfg, jax.random.PRNGKey(0))
+
+    @modal_tpu.method()
+    def decode(self, prompt_len: int = 16, gen_len: int = 8) -> list[int]:
+        import jax.numpy as jnp
+
+        from modal_tpu.models.llama import KVCache
+        from modal_tpu.models.sampling import decode_tokens, prefill
+
+        prompt = jnp.ones((1, prompt_len), jnp.int32)
+        cache = KVCache.create(self.cfg, 1, prompt_len + gen_len + 8)
+        logits, cache = prefill(self.params, self.cfg, prompt, cache)
+        next_tok = logits.argmax(-1, keepdims=True).astype(jnp.int32)
+        toks, _, _ = decode_tokens(self.params, self.cfg, next_tok, cache, gen_len)
+        return [int(t) for t in toks[0]]
+
+
+if __name__ == "__main__":
+    with modal_tpu.enable_output(), app.run():
+        d = Decoder()
+        print("decoded tokens:", d.decode.remote())

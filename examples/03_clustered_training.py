@@ -1,0 +1,41 @@
+"""Gang-scheduled distributed training: @clustered(size=N) places N
+containers atomically (one per pod-slice host), the control plane hands out
+ranks, and jax.distributed is initialized before your code runs — collectives
+ride ICI in-slice (require_single_slice=True pins the gang to one slice).
+
+    python examples/03_clustered_training.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo checkout
+
+import modal_tpu
+
+app = modal_tpu.App("example-gang")
+
+
+@app.function(serialized=True, timeout=300)
+@modal_tpu.clustered(size=2, require_single_slice=True)
+def train_step(step: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from modal_tpu import get_cluster_info
+
+    info = get_cluster_info()
+    devices = jax.devices()  # global across the gang
+    mesh = Mesh(np.asarray(devices).reshape(len(devices)), ("dp",))
+    x = jax.device_put(
+        jnp.arange(float(len(devices))), NamedSharding(mesh, PartitionSpec("dp"))
+    )
+    total = float(jax.jit(jnp.sum)(x))  # cross-process psum under the hood
+    return {"rank": info.rank, "world": info.world_size, "sum": total, "step": step}
+
+
+if __name__ == "__main__":
+    with modal_tpu.enable_output(), app.run():
+        print(train_step.remote(1))

@@ -1,0 +1,29 @@
+"""Volumes + streaming checkpoints: content-addressed block storage, HF
+safetensors export, and the Volume->HBM streaming load (each process reads
+only its own shards under a sharded mesh).
+
+    python examples/04_volumes_and_checkpoints.py
+"""
+
+import jax
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo checkout
+
+import modal_tpu
+from modal_tpu.models.llama import get_config, init_params
+from modal_tpu.models.weights import export_checkpoint, load_params
+
+if __name__ == "__main__":
+    vol = modal_tpu.Volume.from_name("example-ckpt", create_if_missing=True)
+    vol.hydrate()
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    index = export_checkpoint(params, cfg, (vol, "ckpt"))
+    print("exported", index["metadata"]["total_size"], "bytes to the volume")
+
+    restored = load_params((vol, "ckpt"), cfg)
+    print("restored param leaves:", len(jax.tree_util.tree_leaves(restored)))
