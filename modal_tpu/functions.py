@@ -714,12 +714,17 @@ class _Invocation:
 
     async def run_generator(self) -> AsyncGenerator[Any, None]:
         """Stream generator outputs via FunctionCallGetData (reference data
-        chunk streaming)."""
+        chunk streaming). A generator that RAISES mid-stream produces no
+        GENERATOR_DONE data chunk — only a FAILURE unary output — so every
+        empty data poll also checks the unary channel and re-raises the
+        remote exception instead of spinning forever."""
         last_index = 0
         done = False
         while not done:
+            got_chunk = False
             req = api_pb2.FunctionCallGetDataRequest(function_call_id=self.function_call_id, last_index=last_index)
             async for chunk in self.stub.FunctionCallGetData(req):
+                got_chunk = True
                 last_index = chunk.index
                 if chunk.data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
                     done = True
@@ -730,8 +735,38 @@ class _Invocation:
 
                     data = await blob_download(chunk.data_blob_id, self.stub)
                 yield deserialize_data_format(data, chunk.data_format, self.client)
-            else:
-                await asyncio.sleep(0.01)
+            if done or got_chunk:
+                continue
+            # data channel idle: did the call END without a DONE chunk? (the
+            # server also ends the data stream early once the call finishes,
+            # so a mid-stream failure reaches this check within one round)
+            response = await self.pop_function_call_outputs(timeout=0.0, clear_on_success=False)
+            if response.outputs:
+                item = response.outputs[0]
+                if item.result.status != api_pb2.GENERIC_STATUS_SUCCESS:
+                    # drain chunks that raced the failure output (items the
+                    # generator DID yield must reach the consumer), then
+                    # raise the rehydrated remote exception
+                    async for chunk in self.stub.FunctionCallGetData(
+                        api_pb2.FunctionCallGetDataRequest(
+                            function_call_id=self.function_call_id, last_index=last_index
+                        )
+                    ):
+                        last_index = chunk.index
+                        if chunk.data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
+                            break
+                        data = chunk.data
+                        if chunk.data_blob_id:
+                            from ._utils.blob_utils import blob_download
+
+                            data = await blob_download(chunk.data_blob_id, self.stub)
+                        yield deserialize_data_format(data, chunk.data_format, self.client)
+                    await _process_result(item.result, item.data_format, self.stub, self.client)
+                    return
+                # success (GeneratorDone): the DONE data chunk is already
+                # queued — the next outer GetData returns it immediately
+                continue
+            await asyncio.sleep(0.01)
 
 
 MAX_INTERNAL_FAILURE_COUNT = 9

@@ -766,6 +766,12 @@ class ModalTPUServicer:
                     return
                 deadline = time.monotonic() + 55.0
                 continue
+            if call.num_done >= call.num_inputs and call.num_inputs > 0:
+                # the call FINISHED without a GENERATOR_DONE chunk (generator
+                # raised mid-stream): end the stream now so the client's
+                # unary-channel check sees the failure immediately instead of
+                # after this long-poll's full 55s window
+                return
             async with call.data_condition:
                 try:
                     await asyncio.wait_for(call.data_condition.wait(), timeout=1.0)
