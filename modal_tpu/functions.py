@@ -719,24 +719,29 @@ class _Invocation:
         empty data poll also checks the unary channel and re-raises the
         remote exception instead of spinning forever."""
         last_index = 0
-        done = False
-        while not done:
+        failed_item = None  # failure output seen; raise after draining chunks
+        while True:
             got_chunk = False
             req = api_pb2.FunctionCallGetDataRequest(function_call_id=self.function_call_id, last_index=last_index)
             async for chunk in self.stub.FunctionCallGetData(req):
                 got_chunk = True
                 last_index = chunk.index
                 if chunk.data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
-                    done = True
-                    break
+                    return
                 data = chunk.data
                 if chunk.data_blob_id:
                     from ._utils.blob_utils import blob_download
 
                     data = await blob_download(chunk.data_blob_id, self.stub)
                 yield deserialize_data_format(data, chunk.data_format, self.client)
-            if done or got_chunk:
+            if got_chunk:
                 continue
+            if failed_item is not None:
+                # the stream is dry and the call failed: items the generator
+                # DID yield were drained above — raise the rehydrated
+                # remote exception
+                await _process_result(failed_item.result, failed_item.data_format, self.stub, self.client)
+                return
             # data channel idle: did the call END without a DONE chunk? (the
             # server also ends the data stream early once the call finishes,
             # so a mid-stream failure reaches this check within one round)
@@ -744,27 +749,18 @@ class _Invocation:
             if response.outputs:
                 item = response.outputs[0]
                 if item.result.status != api_pb2.GENERIC_STATUS_SUCCESS:
-                    # drain chunks that raced the failure output (items the
-                    # generator DID yield must reach the consumer), then
-                    # raise the rehydrated remote exception
-                    async for chunk in self.stub.FunctionCallGetData(
-                        api_pb2.FunctionCallGetDataRequest(
-                            function_call_id=self.function_call_id, last_index=last_index
-                        )
-                    ):
-                        last_index = chunk.index
-                        if chunk.data_format == api_pb2.DATA_FORMAT_GENERATOR_DONE:
-                            break
-                        data = chunk.data
-                        if chunk.data_blob_id:
-                            from ._utils.blob_utils import blob_download
-
-                            data = await blob_download(chunk.data_blob_id, self.stub)
-                        yield deserialize_data_format(data, chunk.data_format, self.client)
-                    await _process_result(item.result, item.data_format, self.stub, self.client)
-                    return
-                # success (GeneratorDone): the DONE data chunk is already
-                # queued — the next outer GetData returns it immediately
+                    failed_item = item
+                    continue  # one more GetData round collects raced chunks
+                if item.data_format != api_pb2.DATA_FORMAT_GENERATOR_DONE:
+                    # a unary call consumed through the generator surface
+                    # (e.g. FunctionCall.from_id(...).get_gen() on a plain
+                    # function): no DONE chunk will EVER arrive — raise
+                    # instead of spinning on two instant RPCs per iteration
+                    raise InvalidError(
+                        "call produced a unary result, not a generator stream — use .get()"
+                    )
+                # success (GeneratorDone): the DONE data chunk precedes the
+                # unary output, so the next GetData returns it immediately
                 continue
             await asyncio.sleep(0.01)
 

@@ -1,0 +1,138 @@
+"""Invocation-surface coverage the generator-hang bug showed was missing:
+starmap / for_each / spawn-side get_gen / FunctionCall.gather — every public
+call form must be exercised end-to-end (reference _functions.py surface)."""
+
+import time
+
+import pytest
+
+
+def test_starmap_unpacks_tuples(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("inv-starmap")
+
+    @app.function(serialized=True)
+    def add(a, b):
+        return a + b
+
+    with app.run():
+        assert sorted(add.starmap([(1, 2), (10, 20), (100, 200)])) == [3, 30, 300]
+
+
+def test_for_each_runs_side_effects(supervisor):
+    """for_each discards results; effects must still happen (observed via a
+    named Dict), and ignore_exceptions swallows failures."""
+    import modal_tpu
+
+    app = modal_tpu.App("inv-foreach")
+
+    @app.function(serialized=True)
+    def record(x):
+        import modal_tpu as m
+
+        d = m.Dict.lookup("foreach-sink", create_if_missing=True)
+        if x < 0:
+            raise ValueError("negative")
+        d.put(f"k{x}", x * x)
+
+    with app.run():
+        record.for_each([1, 2, 3])
+        sink = modal_tpu.Dict.lookup("foreach-sink", create_if_missing=True)
+        assert [sink.get(f"k{i}") for i in (1, 2, 3)] == [1, 4, 9]
+        # a failing input doesn't break the pass with ignore_exceptions
+        record.for_each([4, -1], ignore_exceptions=True)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and sink.get("k4") is None:
+            time.sleep(0.2)
+        assert sink.get("k4") == 16
+
+
+def test_spawned_generator_get_gen(supervisor):
+    """A spawned generator call streams via FunctionCall.get_gen — including
+    the detached-then-reattach shape (FunctionCall.from_id)."""
+    import modal_tpu
+
+    app = modal_tpu.App("inv-getgen")
+
+    @app.function(serialized=True)
+    def gen(n):
+        for i in range(n):
+            yield i * 3
+
+    with app.run():
+        call = gen.spawn(4)
+        assert list(call.get_gen()) == [0, 3, 6, 9]
+        # reattach by id: the streamed chunks are still there
+        again = modal_tpu.FunctionCall.from_id(call.object_id)
+        again._is_generator = True
+        assert list(again.get_gen()) == [0, 3, 6, 9]
+
+
+def test_get_gen_on_unary_call_raises(supervisor):
+    """Consuming a plain function's call through the generator surface must
+    raise InvalidError promptly — not hang or spin (review r5 finding: no
+    GENERATOR_DONE chunk will ever arrive for a unary result)."""
+    import modal_tpu
+    from modal_tpu.exception import InvalidError
+
+    app = modal_tpu.App("inv-getgen-misuse")
+
+    @app.function(serialized=True)
+    def unary(x):
+        return x
+
+    with app.run():
+        call = unary.spawn(5)
+        assert call.get(timeout=30) == 5
+        detached = modal_tpu.FunctionCall.from_id(call.object_id)
+        detached._is_generator = True  # simulate a caller's wrong assumption
+        t0 = time.monotonic()
+        with pytest.raises(InvalidError, match="unary result"):
+            list(detached.get_gen())
+        assert time.monotonic() - t0 < 10
+
+
+def test_secret_resolves_into_container_env(supervisor):
+    """Secrets (from_dict and deployed from_name) land as environment
+    variables inside the container — resolved at task assignment
+    (scheduler), never shipped through user-visible args."""
+    import modal_tpu
+
+    modal_tpu.Secret.create_deployed("deployed-creds", {"DEPLOYED_KEY": "dk-123"})
+    app = modal_tpu.App("inv-secrets")
+
+    @app.function(
+        serialized=True,
+        secrets=[
+            modal_tpu.Secret.from_dict({"INLINE_KEY": "ik-456"}),
+            modal_tpu.Secret.from_name("deployed-creds"),
+        ],
+    )
+    def read_env():
+        import os as _os
+
+        return _os.environ.get("INLINE_KEY"), _os.environ.get("DEPLOYED_KEY")
+
+    with app.run():
+        assert read_env.remote() == ("ik-456", "dk-123")
+
+
+def test_function_call_gather(supervisor):
+    import modal_tpu
+    from modal_tpu.exception import RemoteError
+
+    app = modal_tpu.App("inv-gather")
+
+    @app.function(serialized=True)
+    def work(x):
+        if x == 13:
+            raise ValueError("unlucky")
+        return x * 2
+
+    with app.run():
+        calls = [work.spawn(i) for i in (1, 2, 3)]
+        assert modal_tpu.FunctionCall.gather(*calls) == [2, 4, 6]
+        bad = work.spawn(13)
+        with pytest.raises((RemoteError, ValueError)):
+            modal_tpu.FunctionCall.gather(work.spawn(1), bad)
