@@ -54,6 +54,7 @@ _FAST_MODULES = {
     "test_e2e_function",
     "test_workspace",
     "test_docs_gen",
+    "test_cbor",
 }
 
 

@@ -66,7 +66,10 @@ def test_min_containers_stays_warm_through_idle(supervisor):
     f = app.function(serialized=True, min_containers=1, scaledown_window=1)(pid_of)
     with app.run():
         _, pid1 = f.remote(1)
-        time.sleep(4)  # several scaledown windows + GetInputs long-poll laps
+        # the container only evaluates scaledown on an EMPTY GetInputs
+        # response, which arrives after the server's ~10s long-poll lap —
+        # a shorter sleep would pass vacuously (review r5 finding)
+        time.sleep(13)
         _, pid2 = f.remote(2)
         assert pid1 == pid2, "min_containers=1 container was drained during idle"
 

@@ -69,6 +69,63 @@ def test_spawned_generator_get_gen(supervisor):
         assert list(again.get_gen()) == [0, 3, 6, 9]
 
 
+def test_app_include_merges_registrations(supervisor):
+    """app.include (reference app.py:1475): functions of a library app run
+    under the including app."""
+    import modal_tpu
+
+    lib = modal_tpu.App("inv-lib")
+
+    @lib.function(serialized=True, name="lib_fn")
+    def lib_fn(x):
+        return x * 10
+
+    main = modal_tpu.App("inv-main")
+
+    @main.function(serialized=True, name="main_fn")
+    def main_fn(x):
+        return x + 1
+
+    main.include(lib)
+    assert set(main.registered_functions) >= {"lib_fn", "main_fn"}
+    with main.run():
+        assert main_fn.remote(1) == 2
+        assert lib_fn.remote(3) == 30
+
+
+def test_update_autoscaler_at_runtime(supervisor):
+    """Function.update_autoscaler overrides the deployed autoscaler settings
+    server-side (reference keep_warm/update_autoscaler surface): a
+    min_containers=1 override keeps a warm container through idle."""
+    import modal_tpu
+
+    app = modal_tpu.App("inv-autoscale")
+
+    @app.function(serialized=True, scaledown_window=1, name="warmable")
+    def warmable(x):
+        import os as _os
+
+        return x, _os.getpid()
+
+    with app.run():
+        warmable.remote(1)
+        fn_state = next(
+            f for f in supervisor.state.functions.values() if f.tag == "warmable"
+        )
+        task = next(
+            supervisor.state.tasks[tid]
+            for tid in fn_state.task_ids
+        )
+        # without the override: the container is allowed to scale to zero
+        assert not supervisor.servicer._scaledown_blocked(fn_state, task)
+        warmable.update_autoscaler(min_containers=1)
+        assert fn_state.autoscaler.min_containers == 1
+        # the override flips the server's scaledown decision for the live
+        # container (warm-survival behavior itself is covered by
+        # tests/test_autoscaler.py::test_min_containers_stays_warm_through_idle)
+        assert supervisor.servicer._scaledown_blocked(fn_state, task)
+
+
 def test_get_gen_on_unary_call_raises(supervisor):
     """Consuming a plain function's call through the generator surface must
     raise InvalidError promptly — not hang or spin (review r5 finding: no
