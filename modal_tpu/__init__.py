@@ -40,8 +40,10 @@ from .partial_function import (
     concurrent,
     enter,
     exit,
+    fastapi_endpoint,
     method,
     web_endpoint,
+    web_server,
     wsgi_app,
 )
 from .retries import Retries
@@ -64,7 +66,9 @@ __all__ = [
     "ClusterInfo",
     "Cron",
     "Dict",
+    "Environment",
     "Error",
+    "FilePatternMatcher",
     "Function",
     "FunctionCall",
     "Image",
@@ -72,6 +76,7 @@ __all__ = [
     "NetworkFileSystem",
     "CloudBucketMount",
     "Period",
+    "Probe",
     "Proxy",
     "Queue",
     "Retries",
@@ -100,9 +105,12 @@ __all__ = [
     "get_fabric_peers",
     "is_local",
     "method",
+    "parameter",
     "parse_tpu_config",
     "asgi_app",
+    "fastapi_endpoint",
     "web_endpoint",
+    "web_server",
     "wsgi_app",
 ]
 
@@ -125,6 +133,22 @@ def __getattr__(name: str):
         from .workspace import Workspace
 
         return Workspace
+    if name == "parameter":
+        from .cls import parameter
+
+        return parameter
+    if name == "Environment":
+        from .environments import Environment
+
+        return Environment
+    if name == "FilePatternMatcher":
+        from .file_pattern_matcher import FilePatternMatcher
+
+        return FilePatternMatcher
+    if name == "Probe":
+        from .sandbox import Probe
+
+        return Probe
     if name == "Sandbox":
         try:
             from .sandbox import Sandbox

@@ -267,6 +267,11 @@ class _App:
             )
             if params.web_method:
                 spec.experimental_options["web_method"] = params.web_method
+            if params.web_server_port:
+                spec.experimental_options["web_server_port"] = str(params.web_server_port)
+                spec.experimental_options["web_server_startup_timeout"] = str(
+                    params.web_server_startup_timeout or 60.0
+                )
             self._add_function(function)
             return function
 

@@ -37,6 +37,100 @@ if typing.TYPE_CHECKING:
     from .app import _App
 
 
+class _NoDefault:
+    def __repr__(self) -> str:  # pragma: no cover — repr only
+        return "<no default>"
+
+
+_no_default = _NoDefault()
+
+
+class _Parameter:
+    """Marker returned by `modal_tpu.parameter()` (reference cls.py:947)."""
+
+    def __init__(self, default: Any, init: bool):
+        self.default = default
+        self.init = init
+
+
+def parameter(*, default: Any = _no_default, init: bool = True) -> Any:
+    """Declare a class parameter dataclass-field-style (reference
+    modal.parameter, cls.py:947):
+
+        @app.cls()
+        class Model:
+            name: str = modal_tpu.parameter(default="tiny")
+            cache: dict = modal_tpu.parameter(init=False)
+
+    A synthesized keyword-only constructor accepts the `init=True` fields;
+    `init=False` exists purely to type-annotate state set by lifecycle
+    hooks. Returns Any so it is assignable under any annotation."""
+    return _Parameter(default=default, init=init)
+
+
+def _apply_parameter_constructor(user_cls: type) -> None:
+    """Synthesize `__init__` from `parameter()` annotations when the class
+    declares them and no explicit constructor. Runs BEFORE the class is
+    cloudpickled, so the container instantiates through the same synthesized
+    constructor without any server-side knowledge of the mechanism."""
+    fields: dict[str, _Parameter] = {
+        name: value
+        for name, value in vars(user_cls).items()
+        if isinstance(value, _Parameter)
+    }
+    if not fields:
+        return
+    if "__init__" in vars(user_cls):
+        raise InvalidError(
+            f"class {user_cls.__name__} mixes modal_tpu.parameter() fields with an "
+            "explicit __init__ — use one or the other"
+        )
+    init_fields = {n: p for n, p in fields.items() if p.init}
+
+    def __init__(self, **kwargs: Any) -> None:  # noqa: N807
+        unknown = set(kwargs) - set(init_fields)
+        if unknown:
+            raise TypeError(
+                f"{type(self).__name__}() got unexpected parameters {sorted(unknown)} "
+                f"(declared: {sorted(init_fields)})"
+            )
+        for name, param in init_fields.items():
+            if name in kwargs:
+                setattr(self, name, kwargs[name])
+            elif not isinstance(param.default, _NoDefault):
+                setattr(self, name, param.default)
+            else:
+                raise TypeError(f"{type(self).__name__}() missing required parameter {name!r}")
+        # init=False fields WITH a default still get it (a default that
+        # silently vanished would be a trap); defaultless ones stay unset
+        # until a lifecycle hook assigns them
+        for name, param in fields.items():
+            if not param.init and not isinstance(param.default, _NoDefault):
+                setattr(self, name, param.default)
+
+    # a real signature so binding context and docs see the parameter names
+    __init__.__signature__ = inspect.Signature(
+        [inspect.Parameter("self", inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+        + [
+            inspect.Parameter(
+                name,
+                inspect.Parameter.KEYWORD_ONLY,
+                default=(
+                    inspect.Parameter.empty
+                    if isinstance(p.default, _NoDefault)
+                    else p.default
+                ),
+            )
+            for name, p in init_fields.items()
+        ]
+    )
+    user_cls.__init__ = __init__
+    # the markers must not linger as class attributes (an un-set init=False
+    # field should raise AttributeError, not return the marker)
+    for name in fields:
+        delattr(user_cls, name)
+
+
 class _Obj:
     """An instance of a remote class: binds constructor params + methods
     (reference _Obj, cls.py:142)."""
@@ -47,6 +141,15 @@ class _Obj:
         self._kwargs = kwargs
         self._bound_function: Optional[_Function] = None
         self._method_cache: dict[str, _Function] = {}
+        # eager parameter validation (reference _Obj validates at creation):
+        # a bad parameterization must raise HERE, not as a container init
+        # failure minutes later
+        user_cls = getattr(cls, "_user_cls", None)
+        if user_cls is not None and "__init__" in vars(user_cls):
+            try:
+                inspect.signature(user_cls.__init__).bind(None, *args, **kwargs)
+            except TypeError as exc:
+                raise InvalidError(f"invalid parameters for {user_cls.__name__}: {exc}") from None
 
     async def _get_bound_function(self) -> _Function:
         if self._bound_function is not None:
@@ -156,6 +259,7 @@ class _Cls(_Object, type_prefix="cs"):
     def from_local(user_cls: type, app: "_App", **function_kwargs: Any) -> "_Cls":
         """Compile a user class into a service function + method table
         (reference cls.py from_local/_Cls)."""
+        _apply_parameter_constructor(user_cls)
         method_partials = find_partial_methods_for_user_cls(user_cls, _PartialFunctionFlags.FUNCTION)
         for pf in method_partials.values():
             pf.wrapped = True

@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 from pathlib import Path, PurePosixPath
-from typing import Callable, Optional, Union
+from typing import Callable, Optional, Sequence, Union
 
 from ._utils.async_utils import synchronize_api
 from ._utils.grpc_utils import retry_transient_errors
@@ -93,16 +93,34 @@ class _Mount(_Object, type_prefix="mo"):
         *,
         remote_path: Optional[str] = None,
         condition: Optional[Callable[[str], bool]] = None,
+        ignore: "Union[Sequence[str], Callable[[str], bool], None]" = None,
         recursive: bool = True,
     ) -> "_Mount":
         local = Path(local_path)
         if not local.is_dir():
             raise InvalidError(f"{local_path} is not a directory")
+        if ignore is not None and condition is not None:
+            raise InvalidError("pass either ignore or condition, not both")
+        ignore_fn: Optional[Callable[[str], bool]] = None
+        if ignore is not None:
+            if callable(ignore):
+                ignore_fn = ignore
+            else:
+                from .file_pattern_matcher import FilePatternMatcher
+
+                if isinstance(ignore, str):
+                    # a bare string would splat char-by-char ("*" alone
+                    # silently excludes everything)
+                    ignore = [ignore]
+                ignore_fn = FilePatternMatcher(*ignore)
         remote = PurePosixPath(remote_path or f"/root/{local.name}")
         entries = []
         it = local.rglob("*") if recursive else local.glob("*")
         for p in sorted(it):
             if not p.is_file():
+                continue
+            # ignore patterns match the path RELATIVE to the mounted dir
+            if ignore_fn is not None and ignore_fn(str(p.relative_to(local))):
                 continue
             if condition is not None and not condition(str(p)):
                 continue

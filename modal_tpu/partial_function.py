@@ -52,6 +52,9 @@ class _PartialFunctionParams:
     # web endpoints (reference @modal.asgi_app/wsgi_app/web_endpoint)
     webhook_type: Optional[int] = None  # api_pb2.WebEndpointType
     web_method: Optional[str] = None  # plain-function endpoints: HTTP method
+    # @web_server: the in-container port the user's server binds
+    web_server_port: Optional[int] = None
+    web_server_startup_timeout: Optional[float] = None
 
     def update(self, other: "_PartialFunctionParams") -> None:
         for f in self.__dataclass_fields__:
@@ -309,6 +312,49 @@ def web_endpoint(
     if _warn_parentheses_missing is not None:
         raise InvalidError("Use @modal_tpu.web_endpoint() with parentheses.")
     return _web_decorator("WEB_ENDPOINT_TYPE_FUNCTION", method=method)
+
+
+def fastapi_endpoint(
+    _warn_parentheses_missing: Any = None,
+    *,
+    method: str = "POST",
+) -> Callable[[Callable], _PartialFunction]:
+    """Alias of web_endpoint matching the reference's current decorator name
+    (modal.fastapi_endpoint) — here a dependency-free JSON adapter rather
+    than a fastapi wrapper, same request/response contract."""
+    return web_endpoint(_warn_parentheses_missing, method=method)
+
+
+def web_server(
+    _warn_parentheses_missing: Any = None,
+    *,
+    port: int,
+    startup_timeout: float = 60.0,
+) -> Callable[[Callable], _PartialFunction]:
+    """Expose a server the function starts on `port` (reference
+    @modal.web_server): the decorated function launches its own HTTP server
+    (subprocess or thread) and returns; the container reverse-proxies the
+    web URL to 127.0.0.1:port once it accepts connections."""
+    if _warn_parentheses_missing is not None:
+        raise InvalidError("Use @modal_tpu.web_server() with parentheses.")
+    if port < 1 or port > 65535:
+        raise InvalidError(f"invalid port {port}")
+
+    def wrapper(raw_f: Callable) -> _PartialFunction:
+        from .proto import api_pb2
+
+        params = _PartialFunctionParams(
+            webhook_type=api_pb2.WEB_ENDPOINT_TYPE_WEB_SERVER,
+            web_server_port=port,
+            web_server_startup_timeout=startup_timeout,
+        )
+        if isinstance(raw_f, _PartialFunction):
+            if raw_f.params.webhook_type is not None:
+                raise InvalidError(f"{raw_f.name} already has a web decorator")
+            return raw_f.add_flags(_PartialFunctionFlags.WEB_ENDPOINT, params)
+        return _PartialFunction(raw_f, _PartialFunctionFlags.WEB_ENDPOINT, params)
+
+    return wrapper
 
 
 def asgi_app(

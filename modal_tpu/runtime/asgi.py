@@ -360,3 +360,93 @@ def function_to_asgi(fn: Callable, method: str = "POST") -> Callable:
             await respond(500, {"error": f"{type(exc).__name__}: {exc}"})
 
     return app
+
+
+def proxy_to_port(port: int) -> Callable:
+    """Reverse-proxy ASGI app for @web_server (reference @modal.web_server):
+    every request forwards to the user's own HTTP server on
+    127.0.0.1:<port>, streaming the response back. The platform's web URL
+    thus fronts whatever framework the user launched."""
+    import aiohttp
+
+    base = f"http://127.0.0.1:{port}"
+    # one long-lived session (created lazily ON the serving loop): per-request
+    # sessions would pay a fresh TCP connect each hit, and aiohttp's default
+    # 5-minute total timeout would kill long streams (SSE, big downloads)
+    state: dict = {"session": None}
+
+    async def app(scope, receive, send):
+        if scope["type"] == "lifespan":
+            return await _lifespan_protocol(receive, send)
+        if state["session"] is None:
+            state["session"] = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None)
+            )
+        session = state["session"]
+        body = b""
+        while True:
+            msg = await receive()
+            if msg["type"] == "http.request":
+                body += msg.get("body", b"")
+                if not msg.get("more_body"):
+                    break
+            else:
+                return
+        qs = scope.get("query_string", b"").decode()
+        url = base + scope["path"] + (f"?{qs}" if qs else "")
+        headers = [(k.decode(), v.decode()) for k, v in scope.get("headers", [])]
+        headers = [(k, v) for k, v in headers if k.lower() not in ("host", "content-length")]
+        started = False
+        try:
+            async with session.request(
+                scope["method"], url, data=body or None, headers=headers,
+                allow_redirects=False,
+            ) as resp:
+                out_headers = [
+                    (k.encode(), v.encode())
+                    for k, v in resp.headers.items()
+                    # aiohttp auto-decompresses and re-frames the body, so
+                    # upstream framing/encoding headers must not be replayed
+                    if k.lower() not in ("transfer-encoding", "content-encoding", "content-length")
+                ]
+                await send(
+                    {"type": "http.response.start", "status": resp.status, "headers": out_headers}
+                )
+                started = True
+                async for chunk in resp.content.iter_chunked(64 * 1024):
+                    await send({"type": "http.response.body", "body": chunk, "more_body": True})
+                await send({"type": "http.response.body", "body": b""})
+        except aiohttp.ClientError as exc:
+            if started:
+                # response already underway: ASGI forbids a second start —
+                # end the body; the truncated stream is the error signal
+                await send({"type": "http.response.body", "body": b""})
+                return
+            data = json.dumps({"error": f"upstream server on :{port} unreachable: {exc}"}).encode()
+            await send(
+                {
+                    "type": "http.response.start",
+                    "status": 502,
+                    "headers": [(b"content-type", b"application/json")],
+                }
+            )
+            await send({"type": "http.response.body", "body": data})
+
+    return app
+
+
+async def wait_for_port(port: int, timeout: float) -> None:
+    """Block until 127.0.0.1:<port> accepts connections (the user's server
+    starting up) — @web_server registers its URL only after this."""
+    import socket
+
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.close()
+            return
+        except OSError:
+            if asyncio.get_event_loop().time() >= deadline:
+                raise TimeoutError(f"@web_server port {port} never came up within {timeout}s")
+            await asyncio.sleep(0.2)

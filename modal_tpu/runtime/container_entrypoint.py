@@ -239,7 +239,7 @@ async def run_web_endpoint(
     (reference run_server/asgi flow, _container_entrypoint.py:394 +
     _runtime/asgi.py): build the ASGI app, bind a local port, register the
     URL with the control plane, serve until drained."""
-    from .asgi import AsgiHttpServer, function_to_asgi, wsgi_to_asgi
+    from .asgi import AsgiHttpServer, function_to_asgi, proxy_to_port, wait_for_port, wsgi_to_asgi
 
     function_def = container_args.function_def
     webhook_type = function_def.webhook_type
@@ -251,6 +251,22 @@ async def run_web_endpoint(
     elif webhook_type == api_pb2.WEB_ENDPOINT_TYPE_FUNCTION:
         method = function_def.experimental_options.get("web_method", "POST")
         asgi = function_to_asgi(callable_, method=method)
+    elif webhook_type == api_pb2.WEB_ENDPOINT_TYPE_WEB_SERVER:
+        # @web_server: the user function STARTS a server on the declared
+        # port (thread/subprocess) and returns; we wait for the port, then
+        # reverse-proxy the platform URL to it
+        port = int(function_def.experimental_options.get("web_server_port", "0"))
+        startup_timeout = float(
+            function_def.experimental_options.get("web_server_startup_timeout", "60")
+        )
+        if not port:
+            raise ExecutionError("@web_server function def carries no port")
+        if inspect.iscoroutinefunction(callable_):
+            await callable_()
+        else:
+            await asyncio.to_thread(callable_)
+        await wait_for_port(port, startup_timeout)
+        asgi = proxy_to_port(port)
     else:
         raise ExecutionError(f"unsupported webhook type {webhook_type}")
 

@@ -172,7 +172,12 @@ class _Sandbox(_Object, type_prefix="sb"):
         for port in unencrypted_ports:
             definition.open_ports.append(api_pb2.PortSpec(port=port, unencrypted=True))
         if readiness_probe:
-            definition.readiness_probe.exec_command.extend(readiness_probe)
+            if isinstance(readiness_probe, Probe):
+                definition.readiness_probe.exec_command.extend(readiness_probe.exec_command)
+                definition.readiness_probe.period_secs = readiness_probe.period_secs
+                definition.readiness_probe.timeout_secs = readiness_probe.timeout_secs
+            else:
+                definition.readiness_probe.exec_command.extend(readiness_probe)
         if region is not None or scheduler_placement is not None:
             from .schedule import SchedulerPlacement
 
@@ -416,6 +421,33 @@ class _Sandbox(_Object, type_prefix="sb"):
             client.stub.SandboxList, api_pb2.SandboxListRequest(app_id=app_id)
         )
         return list(resp.sandboxes)
+
+
+class Probe:
+    """Sandbox readiness probe (reference sandbox.py:256): `wait_until_ready`
+    blocks until the probe command exits 0 inside the sandbox."""
+
+    def __init__(self, exec_command: Sequence[str], period_secs: float = 0.0, timeout_secs: float = 0.0):
+        if not exec_command:
+            raise InvalidError("probe needs a command")
+        self.exec_command = list(exec_command)
+        self.period_secs = period_secs
+        self.timeout_secs = timeout_secs
+
+    @staticmethod
+    def with_exec(*args: str, period_secs: float = 0.0, timeout_secs: float = 0.0) -> "Probe":
+        return Probe(list(args), period_secs, timeout_secs)
+
+    @staticmethod
+    def with_tcp(port: int, period_secs: float = 0.0, timeout_secs: float = 0.0) -> "Probe":
+        """Ready when the sandbox-local TCP port accepts connections."""
+        check = (
+            "import socket; s=socket.socket(); s.settimeout(1); "
+            f"s.connect(('127.0.0.1', {int(port)})); s.close()"
+        )
+        # "python3", not sys.executable: the probe runs on the WORKER host,
+        # where the client's interpreter path may not exist
+        return Probe(["python3", "-c", check], period_secs, timeout_secs)
 
 
 class _SidecarContainer:

@@ -1,0 +1,173 @@
+"""Export-parity batch (reference modal/__init__.py __all__ diff):
+parameter(), Probe, Environment, FilePatternMatcher, fastapi_endpoint,
+@web_server — each exercised through its real surface."""
+
+import threading
+import time
+
+import pytest
+
+
+def test_parameter_synthesized_constructor(supervisor):
+    """modal_tpu.parameter() fields synthesize a keyword-only constructor
+    (reference cls.py:947); init=False fields are annotations only."""
+    import modal_tpu
+
+    app = modal_tpu.App("parity-param")
+
+    @app.cls(serialized=True)
+    class Greeter:
+        greeting: str = modal_tpu.parameter(default="hello")
+        name: str = modal_tpu.parameter()
+        cache: dict = modal_tpu.parameter(init=False)
+
+        @modal_tpu.method()
+        def greet(self):
+            return f"{self.greeting}, {self.name}"
+
+    with app.run():
+        assert Greeter(name="ada").greet.remote() == "hello, ada"
+        assert Greeter(name="bob", greeting="yo").greet.remote() == "yo, bob"
+        with pytest.raises(Exception):  # missing required parameter
+            Greeter().greet.remote()
+        with pytest.raises(Exception):  # unknown parameter
+            Greeter(name="x", nope=1).greet.remote()
+
+
+def test_parameter_init_false_default_applies():
+    import modal_tpu
+    from modal_tpu.cls import _apply_parameter_constructor
+
+    class M:
+        x: int = modal_tpu.parameter(default=1)
+        cache: dict = modal_tpu.parameter(init=False, default=None)
+        unset: int = modal_tpu.parameter(init=False)
+
+    _apply_parameter_constructor(M)
+    m = M()
+    assert m.x == 1 and m.cache is None
+    with pytest.raises(TypeError):
+        M(cache={})  # init=False fields are not constructor params
+    with pytest.raises(AttributeError):
+        m.unset  # defaultless init=False stays unset until a hook assigns it
+
+
+def test_parameter_rejects_mixed_init():
+    import modal_tpu
+    from modal_tpu.cls import _apply_parameter_constructor
+    from modal_tpu.exception import InvalidError
+
+    class Mixed:
+        x: int = modal_tpu.parameter(default=1)
+
+        def __init__(self):
+            pass
+
+    with pytest.raises(InvalidError, match="mixes"):
+        _apply_parameter_constructor(Mixed)
+
+
+def test_probe_objects(supervisor):
+    """Probe.with_exec / with_tcp gate wait_until_ready (reference
+    sandbox.py:256)."""
+    import modal_tpu
+
+    sb = modal_tpu.Sandbox.create(
+        "sh", "-c", "sleep 1 && touch ready.marker && sleep 60",
+        readiness_probe=modal_tpu.Probe.with_exec("test", "-f", "ready.marker"),
+    )
+    try:
+        sb.wait_until_ready(timeout=30)
+        p = sb.exec("test", "-f", "ready.marker")
+        assert p.wait() == 0
+    finally:
+        sb.terminate()
+
+
+def test_environment_object(supervisor):
+    import modal_tpu
+    from modal_tpu.exception import NotFoundError
+
+    env = modal_tpu.Environment.create("parity-env")
+    names = [e.name for e in modal_tpu.Environment.list()]
+    assert "parity-env" in names
+    env.rename("parity-env-2")
+    assert "parity-env-2" in [e.name for e in modal_tpu.Environment.list()]
+    env.delete()
+    assert "parity-env-2" not in [e.name for e in modal_tpu.Environment.list()]
+    with pytest.raises(NotFoundError):
+        modal_tpu.Environment.from_name("ghost-env")
+
+
+def test_file_pattern_matcher():
+    from modal_tpu import FilePatternMatcher
+
+    m = FilePatternMatcher("**/*.pyc", "node_modules", "!keep/**")
+    assert m("a/b/c.pyc")
+    assert m("x.pyc")
+    assert not m("a/b/c.py")
+    assert m("node_modules/pkg/index.js")  # parent-dir rule applies
+    assert not m("keep/a.pyc")  # re-included
+    inv = ~m
+    assert inv("a/b/c.py") and not inv("x.pyc")
+
+
+def test_mount_ignore_patterns(tmp_path):
+    from modal_tpu.mount import _Mount
+
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "app.py").write_text("x")
+    (tmp_path / "src" / "junk.pyc").write_text("x")
+    (tmp_path / "src" / "__pycache__").mkdir()
+    (tmp_path / "src" / "__pycache__" / "c.pyc").write_text("x")
+    mount = _Mount.from_local_dir(tmp_path / "src", ignore=["**/*.pyc", "__pycache__"])
+    kept = [e.local_path.name for e in mount._entries]
+    assert kept == ["app.py"]
+    # a bare string must mean ONE pattern, not be splatted char-by-char
+    mount2 = _Mount.from_local_dir(tmp_path / "src", ignore="**/*.pyc")
+    assert [e.local_path.name for e in mount2._entries] == ["app.py"]
+
+
+def test_fastapi_endpoint_alias_and_web_server(supervisor):
+    """@fastapi_endpoint serves like web_endpoint; @web_server reverse-
+    proxies the platform URL to the server the function starts itself."""
+    import json
+    import urllib.request
+
+    import modal_tpu
+
+    app = modal_tpu.App("parity-web")
+
+    @app.function(serialized=True)
+    @modal_tpu.fastapi_endpoint(method="GET")
+    def ping(x=1):
+        return int(x) + 1
+
+    @app.function(serialized=True)
+    @modal_tpu.web_server(port=8099)
+    def own_server():
+        import http.server
+        import threading as _t
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps({"path": self.path, "who": "own-server"}).encode()
+                self.send_response(200)
+                self.send_header("content-type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 8099), H)
+        _t.Thread(target=srv.serve_forever, daemon=True).start()
+
+    with app.run():
+        url = ping.get_web_url()
+        body = json.loads(urllib.request.urlopen(url + "?x=41", timeout=10).read())
+        assert body == {"result": 42}
+        ws_url = own_server.get_web_url()
+        body = json.loads(urllib.request.urlopen(ws_url + "/anything?q=1", timeout=20).read())
+        assert body["who"] == "own-server"
+        assert body["path"] == "/anything?q=1"
