@@ -48,7 +48,13 @@ from .partial_function import (
 )
 from .retries import Retries
 from .runtime.clustered import ClusterInfo, get_cluster_info, get_fabric_peers
-from .runtime.execution_context import current_function_call_id, current_input_id, is_local
+from .runtime.execution_context import (
+    current_function_call_id,
+    current_input_id,
+    is_local,
+    resume_token,
+    set_resume_token,
+)
 from .schedule import Cron, Period, SchedulerPlacement
 from .mount import Mount, _Mount
 from .network_file_system import NetworkFileSystem
@@ -104,6 +110,8 @@ __all__ = [
     "get_cluster_info",
     "get_fabric_peers",
     "is_local",
+    "resume_token",
+    "set_resume_token",
     "method",
     "parameter",
     "parse_tpu_config",

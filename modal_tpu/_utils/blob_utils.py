@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import io
 import os
+import random
 from contextlib import asynccontextmanager
 from typing import AsyncIterator, BinaryIO, Optional, Union
 
@@ -126,6 +127,18 @@ def _transient_http_errors() -> tuple:
     return (OSError, asyncio.TimeoutError, aiohttp.ClientError)
 
 
+# HTTP statuses worth retrying: overload/unavailable (503, chaos-injected
+# included), throttling (429), and transient gateway errors (500/502/504) —
+# the store analogue of RETRYABLE_GRPC_STATUS_CODES
+RETRYABLE_HTTP_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+async def _retry_sleep(attempt: int) -> None:
+    # equal jitter, same rationale as retry_transient_errors: blob clients
+    # recovering from one outage must not retry in lockstep
+    await asyncio.sleep(0.2 * 2**attempt * (0.5 + random.random() * 0.5))
+
+
 async def _put_url(url: str, data: bytes) -> None:
     session = _get_http_session()
     for attempt in range(4):
@@ -134,11 +147,14 @@ async def _put_url(url: str, data: bytes) -> None:
                 if resp.status in (200, 204):
                     return
                 body = await resp.text()
+                if resp.status in RETRYABLE_HTTP_STATUSES and attempt < 3:
+                    await _retry_sleep(attempt)
+                    continue
                 raise ExecutionError(f"blob PUT failed: HTTP {resp.status} {body[:200]}")
         except _transient_http_errors() as exc:
             if attempt == 3:
                 raise ExecutionError(f"blob PUT failed after retries: {exc}") from exc
-            await asyncio.sleep(0.2 * 2**attempt)
+            await _retry_sleep(attempt)
 
 
 async def _get_url(url: str) -> bytes:
@@ -149,11 +165,14 @@ async def _get_url(url: str) -> bytes:
                 if resp.status == 200:
                     return await resp.read()
                 body = await resp.text()
+                if resp.status in RETRYABLE_HTTP_STATUSES and attempt < 3:
+                    await _retry_sleep(attempt)
+                    continue
                 raise ExecutionError(f"blob GET failed: HTTP {resp.status} {body[:200]}")
         except _transient_http_errors() as exc:
             if attempt == 3:
                 raise ExecutionError(f"blob GET failed after retries: {exc}") from exc
-            await asyncio.sleep(0.2 * 2**attempt)
+            await _retry_sleep(attempt)
     raise ExecutionError("unreachable")
 
 

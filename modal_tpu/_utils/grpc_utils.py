@@ -8,7 +8,9 @@ channel creation with metadata injection (grpc_utils.py:325).
 from __future__ import annotations
 
 import asyncio
+import os
 import platform
+import random
 import time
 import urllib.parse
 import uuid
@@ -27,6 +29,89 @@ RETRYABLE_GRPC_STATUS_CODES = [
     grpc.StatusCode.INTERNAL,
     grpc.StatusCode.UNKNOWN,
 ]
+
+
+class CircuitBreaker:
+    """Per-method circuit breaker for the transient-retry engine.
+
+    After `threshold` CONSECUTIVE failed attempts (across calls) the circuit
+    opens for `cooldown_s`. While open, attempts WAIT until the cooldown
+    expires instead of hammering a struggling server — the retry contract of
+    callers (including max_retries=None loops) is preserved; only the pacing
+    changes. The first attempt after the cooldown is the half-open probe: its
+    success closes the circuit, its failure re-opens it for another cooldown.
+    """
+
+    def __init__(self, method: str, threshold: int, cooldown_s: float):
+        self.method = method
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.consecutive_failures = 0
+        self.open_until = 0.0
+        self.times_opened = 0  # observability
+
+    @property
+    def state(self) -> str:
+        if time.monotonic() < self.open_until:
+            return "open"
+        if self.consecutive_failures >= self.threshold:
+            return "half_open"
+        return "closed"
+
+    async def before_attempt(self, deadline: Optional[float] = None) -> None:
+        remaining = self.open_until - time.monotonic()
+        if remaining > 0:
+            if deadline is not None:
+                # never pause past the caller's total-timeout budget
+                remaining = min(remaining, max(0.0, deadline - time.monotonic()))
+            logger.debug(f"circuit open for {self.method}; pausing {remaining:.2f}s")
+            await asyncio.sleep(remaining)
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self.open_until = 0.0
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.consecutive_failures >= self.threshold:
+            self.open_until = time.monotonic() + self.cooldown_s
+            self.times_opened += 1
+            logger.warning(
+                f"circuit breaker OPEN for {self.method} after "
+                f"{self.consecutive_failures} consecutive failures ({self.cooldown_s}s cooldown)"
+            )
+
+
+_breakers: dict[str, CircuitBreaker] = {}
+
+
+def _breaker_for(fn: Any) -> Optional[CircuitBreaker]:
+    if os.environ.get("MODAL_TPU_CIRCUIT_BREAKER", "1") in ("0", "false", "no"):
+        return None
+    method = getattr(fn, "_method", None)
+    if method is None:
+        return None
+    if isinstance(method, bytes):
+        method = method.decode("utf-8", "replace")
+    # scope per channel (stamped by _StubBase): a struggling server must not
+    # open the circuit for the same method on every OTHER server the process
+    # talks to (control plane vs input plane, or fresh supervisors in tests)
+    method = f"{getattr(fn, '_breaker_scope', '')}:{method}"
+    breaker = _breakers.get(method)
+    if breaker is None:
+        if len(_breakers) > 4096:
+            # dead channels leave breakers behind (one per channel × method);
+            # drop everything not currently open — a channel that died while
+            # failing parks its breaker in "half_open" forever, so a
+            # closed-only purge would never reclaim anything
+            for key in [k for k, b in _breakers.items() if b.state != "open"]:
+                del _breakers[key]
+        breaker = _breakers[method] = CircuitBreaker(
+            method,
+            threshold=int(os.environ.get("MODAL_TPU_CIRCUIT_BREAKER_THRESHOLD", "10")),
+            cooldown_s=float(os.environ.get("MODAL_TPU_CIRCUIT_BREAKER_COOLDOWN", "1.0")),
+        )
+    return breaker
 
 
 def create_channel(server_url: str, metadata: Optional[dict[str, str]] = None) -> grpc.aio.Channel:
@@ -92,41 +177,60 @@ async def retry_transient_errors(
     attempt_timeout: Optional[float] = None,
     total_timeout: Optional[float] = None,
     metadata: Optional[list[tuple[str, str]]] = None,
+    jitter: bool = True,
 ) -> Any:
     """Call a unary-unary multicallable with retries on transient gRPC errors.
 
     Mirrors reference `retry_transient_errors` (grpc_utils.py:407): idempotency
     key metadata, exponential backoff, optional per-attempt and total deadlines.
+    Hardened: backoff is jittered (equal-jitter, so N clients recovering from
+    one outage don't re-synchronize their retries) and a per-method circuit
+    breaker paces attempts once a method fails many times in a row.
     """
     delay = base_delay
     n_retries = 0
     status_codes = RETRYABLE_GRPC_STATUS_CODES + (additional_status_codes or [])
     idempotency_key = str(uuid.uuid4())
     t0 = time.monotonic()
+    breaker = _breaker_for(fn)
 
     while True:
         md = [
             ("x-idempotency-key", idempotency_key),
             ("x-retry-attempt", str(n_retries)),
         ] + (metadata or [])
+        if breaker is not None:
+            await breaker.before_attempt(
+                deadline=(t0 + total_timeout) if total_timeout is not None else None
+            )
+        # budget AFTER the breaker pause: the pause consumes wall clock, so
+        # computing the attempt timeout first would let the RPC overrun
+        # total_timeout by up to a full cooldown
         timeout = attempt_timeout
         if total_timeout is not None:
-            elapsed = time.monotonic() - t0
-            remaining = total_timeout - elapsed
+            remaining = total_timeout - (time.monotonic() - t0)
             if remaining <= 0:
                 raise asyncio.TimeoutError(f"total timeout {total_timeout}s exceeded")
             timeout = min(timeout, remaining) if timeout is not None else remaining
         try:
-            return await fn(*args, metadata=md, timeout=timeout)
+            result = await fn(*args, metadata=md, timeout=timeout)
+            if breaker is not None:
+                breaker.record_success()
+            return result
         except grpc.aio.AioRpcError as exc:
             code = exc.code()
             if code == grpc.StatusCode.CANCELLED:
                 # grpc.aio surfaces OUR OWN task cancellation as
                 # AioRpcError(CANCELLED); retrying it would swallow e.g. the
-                # container's SIGTERM drain. Server-side cancels (task not
-                # being cancelled) stay retryable.
+                # container's SIGTERM drain, and behind max_retries=None it
+                # makes the task UNCANCELLABLE (teardown hangs forever on
+                # gather). Task.cancelling() is 3.11+ — on 3.10 there is no
+                # reliable way to tell our own cancel from a server-side
+                # one, so treat every CANCELLED as cancellation: aborting a
+                # rare server-side cancel is benign, an immortal task is not.
                 current = asyncio.current_task()
-                if current is not None and getattr(current, "cancelling", lambda: 0)():
+                cancelling = getattr(current, "cancelling", None)
+                if current is None or cancelling is None or cancelling():
                     raise asyncio.CancelledError() from exc
             if code == grpc.StatusCode.UNAUTHENTICATED:
                 raise AuthError(exc.details()) from None
@@ -140,13 +244,17 @@ async def retry_transient_errors(
                 raise AlreadyExistsError(exc.details()) from None
             if code not in status_codes:
                 raise
+            if breaker is not None:
+                breaker.record_failure()
             if max_retries is not None and n_retries >= max_retries:
                 raise
             if total_timeout is not None and (time.monotonic() - t0 + delay) > total_timeout:
                 raise
             n_retries += 1
             logger.debug(f"retrying {getattr(fn, '_method', fn)} after {code} (attempt {n_retries})")
-            await asyncio.sleep(delay)
+            # equal jitter: sleep in [delay/2, delay] so a fleet of clients
+            # recovering from the same outage doesn't retry in lockstep
+            await asyncio.sleep(delay * (0.5 + random.random() * 0.5) if jitter else delay)
             delay = min(delay * delay_factor, max_delay)
 
 

@@ -1,0 +1,294 @@
+"""Chaos-injection subsystem: one seeded policy, every layer.
+
+Grown out of the test-only fault-injecting servicer (tests/conftest.py): that
+covered 3 control-plane RPCs with hand-set counters. ChaosPolicy generalizes
+it into a first-class, deterministic fault model that LocalSupervisor attaches
+to the control-plane servicer, the InputPlaneServer, the BlobServer's HTTP
+routes, and each WorkerAgent — so a single policy object drives faults across
+every plane, reproducibly by seed.
+
+Determinism model: every RPC name gets its own PRNG stream seeded with
+``(seed, rpc_name)``. The k-th call of a given RPC therefore draws the same
+fault decision regardless of how calls to *other* RPCs interleave — asyncio
+scheduling noise cannot change the injected sequence. ``fault_log`` records
+``"RpcName#k"`` entries so two runs with the same seed (and the same per-RPC
+call counts) can be compared directly.
+
+Fault classes:
+- **rate faults**: per-RPC (or default) probability of aborting UNAVAILABLE
+  before the handler runs (transport-retryable; exercises the client's
+  backoff/circuit-breaker loop).
+- **latency injection**: per-call extra delay drawn from the same stream.
+- **budgeted faults** (the old conftest knobs): named counters that fail the
+  next N calls of an RPC *family* across both planes — e.g. ``fail_put_inputs``
+  covers FunctionPutInputs (control plane) and MapStartOrContinue/AttemptStart
+  (input plane).
+- **scheduled events**: one-shot worker-kill / worker-preempt /
+  heartbeat-blackhole events that fire after N outputs have been produced
+  (output count is the deterministic clock of a map run).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .config import logger
+
+# A budgeted knob fails the next N calls of every RPC in its family: the
+# control-plane pump and the input-plane equivalents are one logical fault
+# surface (satellite: the old knobs only covered the control-plane pump).
+KNOB_RPCS: dict[str, frozenset] = {
+    "fail_get_inputs": frozenset({"FunctionGetInputs"}),
+    "fail_put_outputs": frozenset({"FunctionPutOutputs"}),
+    "fail_put_inputs": frozenset({"FunctionPutInputs", "FunctionMap", "MapStartOrContinue", "AttemptStart"}),
+    "fail_get_outputs": frozenset({"FunctionGetOutputs", "MapAwait", "AttemptAwait"}),
+}
+
+HEARTBEAT_RPCS = frozenset({"ContainerHeartbeat", "WorkerHeartbeat"})
+
+# HTTP blob routes are injected under pseudo-RPC names so one policy and one
+# rate table cover the gRPC and HTTP planes alike.
+BLOB_RPCS = frozenset({"BlobPut", "BlobGet", "BlobPutPart", "BlobComplete"})
+
+
+@dataclass
+class ChaosEvent:
+    """One-shot lifecycle fault, fired once `after_outputs` outputs exist.
+
+    kinds: ``worker_preempt`` (graceful drain: SIGTERM + grace window, inputs
+    requeued, checkpoint flush), ``worker_kill`` (SIGKILL the worker's
+    containers, no grace), ``heartbeat_blackhole`` (drop heartbeat RPCs for
+    `duration_s`).
+    """
+
+    kind: str
+    after_outputs: int = 0
+    worker_index: int = 0
+    grace_s: float = 5.0
+    duration_s: float = 10.0
+    fired: bool = False
+
+
+class ChaosPolicy:
+    """Seeded, layer-agnostic fault policy. Thread-compatible for a single
+    event loop (all mutation happens on the supervisor's loop)."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        *,
+        error_rates: Optional[dict[str, float]] = None,
+        default_error_rate: float = 0.0,
+        latency_ms: float = 0.0,
+        latency_jitter_ms: float = 0.0,
+        latency_rate: float = 1.0,
+        events: Optional[list[ChaosEvent]] = None,
+        max_faults: Optional[int] = None,
+    ):
+        self.seed = seed
+        self.error_rates = dict(error_rates or {})
+        self.default_error_rate = default_error_rate
+        self.latency_ms = latency_ms
+        self.latency_jitter_ms = latency_jitter_ms
+        self.latency_rate = latency_rate
+        self.events = list(events or [])
+        self.max_faults = max_faults
+        # budgeted one-shot faults (the conftest knob surface)
+        self.fail_counts: dict[str, int] = {}
+        # observability
+        self.call_counts: dict[str, int] = {}
+        self.injected: dict[str, int] = {}
+        self.fault_log: list[str] = []
+        self.outputs_seen = 0
+        self._blackhole_until = 0.0
+        self._streams: dict[str, random.Random] = {}
+        self._total_injected = 0
+
+    # -- configuration ------------------------------------------------------
+
+    @classmethod
+    def from_env(cls) -> Optional["ChaosPolicy"]:
+        """Env-driven policy (fleet operators flip chaos on without code):
+
+        - MODAL_TPU_CHAOS=1 enables
+        - MODAL_TPU_CHAOS_SEED (int, default 0)
+        - MODAL_TPU_CHAOS_ERROR_RATE (float, default rate for every RPC)
+        - MODAL_TPU_CHAOS_RPCS ("Name=0.05,Other=0.1" or "Name,Other" using
+          the default rate for bare names)
+        - MODAL_TPU_CHAOS_LATENCY_MS / _LATENCY_JITTER_MS / _LATENCY_RATE
+        """
+        if os.environ.get("MODAL_TPU_CHAOS", "") not in ("1", "true", "yes"):
+            return None
+        default_rate = float(os.environ.get("MODAL_TPU_CHAOS_ERROR_RATE", "0") or 0)
+        rates: dict[str, float] = {}
+        spec = os.environ.get("MODAL_TPU_CHAOS_RPCS", "")
+        apply_default = not spec  # bare default rate applies everywhere
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            if "=" in part:
+                name, _, rate = part.partition("=")
+                rates[name.strip()] = float(rate)
+            else:
+                rates[part] = default_rate
+        return cls(
+            seed=int(os.environ.get("MODAL_TPU_CHAOS_SEED", "0") or 0),
+            error_rates=rates,
+            default_error_rate=default_rate if apply_default else 0.0,
+            latency_ms=float(os.environ.get("MODAL_TPU_CHAOS_LATENCY_MS", "0") or 0),
+            latency_jitter_ms=float(os.environ.get("MODAL_TPU_CHAOS_LATENCY_JITTER_MS", "0") or 0),
+            latency_rate=float(os.environ.get("MODAL_TPU_CHAOS_LATENCY_RATE", "1") or 1),
+        )
+
+    # -- deterministic decision engine --------------------------------------
+
+    def _stream(self, rpc: str) -> random.Random:
+        stream = self._streams.get(rpc)
+        if stream is None:
+            stream = self._streams[rpc] = random.Random(f"{self.seed}:{rpc}")
+        return stream
+
+    def decide(self, rpc: str) -> tuple[float, bool]:
+        """(extra_delay_s, inject_fault) for the next call of `rpc`.
+
+        Draw order per call is fixed (latency draw, then fault draw) so the
+        per-RPC stream stays aligned across runs with the same config.
+        """
+        n = self.call_counts.get(rpc, 0)
+        self.call_counts[rpc] = n + 1
+        stream = self._stream(rpc)
+        delay = 0.0
+        if self.latency_ms > 0:
+            roll = stream.random()
+            if roll < self.latency_rate:
+                delay = (self.latency_ms + stream.random() * self.latency_jitter_ms) / 1000.0
+        # budgeted knobs outrank rates and are NOT drawn from the stream
+        # (hand-set counters must not perturb seeded reproducibility)
+        for knob, rpcs in KNOB_RPCS.items():
+            if rpc in rpcs and self.fail_counts.get(knob, 0) > 0:
+                self.fail_counts[knob] -= 1
+                self._note_fault(rpc, n, f"{knob} budget")
+                return delay, True
+        if rpc in HEARTBEAT_RPCS and self.heartbeat_blackholed():
+            self._note_fault(rpc, n, "heartbeat blackhole")
+            return delay, True
+        rate = self.error_rates.get(rpc, self.default_error_rate)
+        if rate > 0 and (self.max_faults is None or self._total_injected < self.max_faults):
+            if stream.random() < rate:
+                self._note_fault(rpc, n, f"rate {rate}")
+                return delay, True
+        return delay, False
+
+    def _note_fault(self, rpc: str, call_index: int, why: str) -> None:
+        self.injected[rpc] = self.injected.get(rpc, 0) + 1
+        self._total_injected += 1
+        self.fault_log.append(f"{rpc}#{call_index}")
+        logger.debug(f"chaos: injecting UNAVAILABLE into {rpc} call {call_index} ({why})")
+
+    # -- injection helpers (one per transport) ------------------------------
+
+    async def inject_grpc(self, rpc: str, context) -> None:
+        """Server-side gRPC hook: sleep the injected latency, then abort
+        UNAVAILABLE if this call drew a fault."""
+        delay, fail = self.decide(rpc)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if fail:
+            import grpc
+
+            await context.abort(grpc.StatusCode.UNAVAILABLE, f"chaos: injected fault in {rpc}")
+
+    async def inject_http(self, route: str):
+        """Blob-server hook: returns an aiohttp 503 Response to send instead
+        of handling the request, or None to proceed."""
+        delay, fail = self.decide(route)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if fail:
+            from aiohttp import web
+
+            return web.Response(status=503, text=f"chaos: injected fault in {route}")
+        return None
+
+    # -- heartbeat blackhole -------------------------------------------------
+
+    def start_heartbeat_blackhole(self, duration_s: float) -> None:
+        self._blackhole_until = time.monotonic() + duration_s
+        logger.warning(f"chaos: heartbeat blackhole for {duration_s}s")
+
+    def heartbeat_blackholed(self) -> bool:
+        return time.monotonic() < self._blackhole_until
+
+    # -- scheduled lifecycle events ------------------------------------------
+
+    def note_outputs(self, n: int) -> None:
+        self.outputs_seen += n
+
+    def pop_due_events(self) -> list[ChaosEvent]:
+        due = []
+        for ev in self.events:
+            if not ev.fired and self.outputs_seen >= ev.after_outputs:
+                ev.fired = True
+                due.append(ev)
+        return due
+
+    # -- conftest knob surface ------------------------------------------------
+
+    def set_knob(self, knob: str, count: int) -> None:
+        if knob not in KNOB_RPCS:
+            raise KeyError(f"unknown chaos knob {knob!r} (have {sorted(KNOB_RPCS)})")
+        self.fail_counts[knob] = count
+
+    def get_knob(self, knob: str) -> int:
+        return self.fail_counts.get(knob, 0)
+
+
+class ChaosServicerProxy:
+    """Wraps a gRPC servicer at the generic-handler boundary: every RPC the
+    servicer defines passes through `policy.inject_grpc` first. Built once
+    per server; the underlying servicer object stays clean (scheduler, tests
+    and the supervisor keep talking to the real one)."""
+
+    def __init__(self, servicer, policy: ChaosPolicy):
+        self._servicer = servicer
+        self._policy = policy
+
+    def __getattr__(self, name: str):
+        import inspect
+
+        impl = getattr(self._servicer, name)
+        if name.startswith("_") or not callable(impl):
+            return impl
+        if inspect.isasyncgenfunction(impl):
+
+            async def stream_wrapped(request, context, _impl=impl, _name=name):
+                await self._policy.inject_grpc(_name, context)
+                async for item in _impl(request, context):
+                    yield item
+
+            return stream_wrapped
+        if inspect.iscoroutinefunction(impl):
+
+            async def unary_wrapped(request, context, _impl=impl, _name=name):
+                await self._policy.inject_grpc(_name, context)
+                resp = await _impl(request, context)
+                if _name == "FunctionPutOutputs":
+                    # outputs are the chaos clock for scheduled events
+                    self._policy.note_outputs(len(request.outputs))
+                return resp
+
+            return unary_wrapped
+        return impl
+
+
+__all__ = [
+    "ChaosPolicy",
+    "ChaosEvent",
+    "ChaosServicerProxy",
+    "KNOB_RPCS",
+    "HEARTBEAT_RPCS",
+    "BLOB_RPCS",
+]

@@ -10,8 +10,12 @@ from __future__ import annotations
 
 import logging
 import os
-import tomllib
 import typing
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # stdlib tomllib is 3.11+; gate for 3.10 hosts
+    import tomli as tomllib  # type: ignore[no-redef]
 from typing import Any, Callable, Optional
 
 user_config_path: str = os.environ.get("MODAL_TPU_CONFIG_PATH") or os.path.expanduser("~/.modal_tpu.toml")

@@ -831,17 +831,24 @@ class _InputPlaneInvocation:
                 continue  # poll window elapsed; keep awaiting
             result = response.output.result
             if result.status == api_pb2.GENERIC_STATUS_INTERNAL_FAILURE:
-                # lost input / worker preemption: retry immediately, not
-                # counted against the user retry policy
+                # lost input / worker preemption: retried without consuming
+                # the user retry budget, but PACED by the policy's delay
+                # schedule (an un-delayed loop hammered the plane when a
+                # whole worker's inputs were requeued at once)
                 internal_failure_count += 1
                 if internal_failure_count < MAX_INTERNAL_FAILURE_COUNT:
+                    await asyncio.sleep(
+                        user_retries.attempt_delay(internal_failure_count, jitter=True)
+                    )
                     await self._retry_input(metadata)
                     continue
             elif result.status not in (api_pb2.GENERIC_STATUS_SUCCESS, api_pb2.GENERIC_STATUS_TIMEOUT):
                 if user_retry_count < self.retry_policy.retries:
                     user_retry_count += 1
-                    # post-increment: first retry backs off initial_delay
-                    await asyncio.sleep(user_retries.attempt_delay(user_retry_count))
+                    # post-increment: first retry draws full jitter in
+                    # [0, initial_delay] (AWS-style — the cap backs off, the
+                    # floor is 0 so synchronized failures spread)
+                    await asyncio.sleep(user_retries.attempt_delay(user_retry_count, jitter=True))
                     await self._retry_input(metadata)
                     continue
             return await _process_result(result, response.output.data_format, self.client.stub, self.client)

@@ -300,7 +300,9 @@ async def _map_invocation(
         nonlocal pending_retries
         pending_retries += 1
         next_count = item.retry_count + 1
-        delay = retry_mgr.attempt_delay(next_count) if retry_mgr is not None else 0.0
+        # jittered: a preempted worker requeues many inputs at once — their
+        # retries must spread instead of re-arriving as one synchronized wave
+        delay = retry_mgr.attempt_delay(next_count, jitter=True) if retry_mgr is not None else 0.0
 
         async def _fire(
             input_id: str = item.input_id, count: int = next_count, idx: int = item.idx

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import itertools
 from typing import TYPE_CHECKING, Any, Optional
 
 from . import api_pb2
@@ -268,9 +269,16 @@ class _StubBase:
     """Client-side stub: one multicallable per RPC on a grpc.aio channel."""
 
     _registry: dict[str, RPCMethod] = {}
+    # monotonically-unique stub ids: id(channel) could alias a GC'd channel's
+    # address and inherit its (possibly open) breaker state
+    _scope_counter = itertools.count()
 
     def __init__(self, channel: "grpc.aio.Channel"):
         self._channel = channel
+        # per-channel circuit-breaker scope (grpc_utils._breaker_for): one
+        # server's failures must not open the circuit for its namesake
+        # method on other servers
+        breaker_scope = f"ch{next(_StubBase._scope_counter)}"
         for method in self._registry.values():
             if method.arity == Arity.UNARY_UNARY:
                 factory = channel.unary_unary
@@ -280,15 +288,13 @@ class _StubBase:
                 factory = channel.stream_unary
             else:
                 factory = channel.stream_stream
-            setattr(
-                self,
-                method.name,
-                factory(
-                    method.path,
-                    request_serializer=method.request_type.SerializeToString,
-                    response_deserializer=method.response_type.FromString,
-                ),
+            multicallable = factory(
+                method.path,
+                request_serializer=method.request_type.SerializeToString,
+                response_deserializer=method.response_type.FromString,
             )
+            multicallable._breaker_scope = breaker_scope
+            setattr(self, method.name, multicallable)
 
 
 class ModalTPUStub(_StubBase):

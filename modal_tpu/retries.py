@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import random
+
 from .exception import InvalidError
 from .proto import api_pb2
 
@@ -29,6 +31,12 @@ class Retries:
             raise InvalidError(f"initial_delay must be between 0 and 60s, got {initial_delay}")
         if not 0.0 <= max_delay <= 60.0:
             raise InvalidError(f"max_delay must be between 0 and 60s, got {max_delay}")
+        if max_delay < initial_delay:
+            # e.g. Retries(max_retries=1, initial_delay=30, max_delay=5)
+            # silently inverted the bound: every delay was clamped to 5s
+            raise InvalidError(
+                f"max_delay ({max_delay}s) must be >= initial_delay ({initial_delay}s)"
+            )
         self.max_retries = max_retries
         self.backoff_coefficient = backoff_coefficient
         self.initial_delay = initial_delay
@@ -50,10 +58,16 @@ class RetryManager:
     def __init__(self, policy: api_pb2.RetryPolicy):
         self._policy = policy
 
-    def attempt_delay(self, retry_count: int) -> float:
+    def attempt_delay(self, retry_count: int, jitter: bool = False) -> float:
+        """Delay before the `retry_count`-th attempt. With `jitter`, draws
+        full jitter in [0, delay] (AWS-style): a burst of inputs failing
+        together then spreads its retries instead of re-arriving as a thundering
+        herd at exactly initial_delay * backoff^n."""
         if retry_count <= 0:
             return 0.0
         delay_ms = self._policy.initial_delay_ms * (self._policy.backoff_coefficient ** (retry_count - 1))
         if self._policy.max_delay_ms:
             delay_ms = min(delay_ms, self._policy.max_delay_ms)
+        if jitter:
+            delay_ms = random.uniform(0.0, delay_ms)
         return delay_ms / 1000.0

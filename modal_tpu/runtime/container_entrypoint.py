@@ -198,8 +198,25 @@ async def call_user_code(service: Service, ctx: IOContext, io: ContainerIOManage
 
 async def run_input_loop(service: Service, io: ContainerIOManager) -> None:
     """Concurrent input execution under slots (reference run_inputs_outputs,
-    container_io_manager.py:845)."""
-    async with asyncio.TaskGroup() as tg:  # structured: all inputs finish before exit
+    container_io_manager.py:845). Structured: all in-flight inputs finish
+    before exit (asyncio.TaskGroup is 3.11+; hand-rolled for 3.10 hosts)."""
+    running: set[asyncio.Task] = set()
+    first_exc: list[BaseException] = []
+    child_failed = asyncio.Event()
+
+    def _on_done(t: asyncio.Task) -> None:
+        # TaskGroup semantics: remember the first real child failure so it
+        # aborts the loop and propagates (a silently dropped exception here
+        # would let the container report SUCCESS with an unpushed output)
+        running.discard(t)
+        if not t.cancelled():
+            exc = t.exception()
+            if exc is not None:
+                if not first_exc:
+                    first_exc.append(exc)
+                child_failed.set()
+
+    try:
 
         async def _run_one(ctx: IOContext) -> None:
             reset = execution_context._set_current_context_ids(
@@ -228,8 +245,64 @@ async def run_input_loop(service: Service, io: ContainerIOManager) -> None:
                     io._running_tasks.pop(iid, None)
                 reset()
 
-        async for ctx in io.generate_inputs():
-            tg.create_task(_run_one(ctx))
+        # the fetch races against child failure: a failed input task must
+        # abort the loop IMMEDIATELY, not after the next input arrives —
+        # generate_inputs can sit in its long poll for seconds while the
+        # container would otherwise keep heartbeating with an unpushed output
+        gen = io.generate_inputs().__aiter__()
+        while True:
+            fetch = asyncio.ensure_future(gen.__anext__())
+            failed = asyncio.ensure_future(child_failed.wait())
+            try:
+                await asyncio.wait({fetch, failed}, return_when=asyncio.FIRST_COMPLETED)
+            except BaseException:
+                # outer cancel (SIGTERM drain) mid-wait: retrieve both racers
+                # so neither logs "exception was never retrieved" at exit
+                fetch.cancel()
+                failed.cancel()
+                await asyncio.gather(fetch, failed, return_exceptions=True)
+                raise
+            failed.cancel()
+            if first_exc:
+                fetch.cancel()
+                fetched = (await asyncio.gather(fetch, return_exceptions=True))[0]
+                if isinstance(fetched, IOContext):
+                    # the fetch and the failure completed in the same wakeup:
+                    # this ctx is already claimed server-side — report it
+                    # TERMINATED (like a cancelled input) instead of dropping
+                    # it to rot until a reaper notices
+                    results = [
+                        api_pb2.GenericResult(
+                            status=api_pb2.GENERIC_STATUS_TERMINATED,
+                            exception="input loop aborted",
+                        )
+                        for _ in fetched.input_ids
+                    ]
+                    try:
+                        await asyncio.shield(io.push_outputs(fetched, results))
+                    except Exception:
+                        pass
+                raise first_exc[0]
+            try:
+                ctx = fetch.result()
+            except StopAsyncIteration:
+                break
+            t = asyncio.create_task(_run_one(ctx))
+            running.add(t)
+            t.add_done_callback(_on_done)
+        if running:
+            await asyncio.gather(*running, return_exceptions=True)
+        if first_exc:
+            raise first_exc[0]
+    except BaseException:
+        # TaskGroup semantics: the fetch loop died or we were cancelled —
+        # in-flight inputs are cancelled (each reports TERMINATED) and
+        # awaited so no result push is abandoned mid-RPC
+        for t in running:
+            t.cancel()
+        if running:
+            await asyncio.shield(asyncio.gather(*running, return_exceptions=True))
+        raise
 
 
 async def run_web_endpoint(
@@ -485,6 +558,28 @@ def main() -> None:
             loop.call_soon_threadsafe(task.cancel)
 
     signal.signal(signal.SIGTERM, _handle_term)
+
+    # SIGUSR2 = preemption notice (worker _signal_preempt): unlike SIGTERM's
+    # immediate cancel, first flush every in-flight input's resume token to
+    # the control plane (ContainerCheckpoint) so the requeued attempts resume
+    # from their checkpoints — THEN cancel into the normal graceful-exit path
+    # (@exit hooks, TaskResult) inside the grace window.
+    async def _preempt_flush() -> None:
+        from .io_manager import ContainerIOManager
+
+        io = ContainerIOManager.singleton()
+        if io is not None:
+            try:
+                await asyncio.wait_for(io.flush_resume_tokens(), timeout=8.0)
+            except Exception:
+                traceback.print_exc()
+        _handle_term(signal.SIGUSR2, None)
+
+    def _handle_preempt(signum, frame):
+        logger.warning("preemption notice received; flushing checkpoints")
+        loop.call_soon_threadsafe(lambda: asyncio.ensure_future(_preempt_flush()))
+
+    signal.signal(signal.SIGUSR2, _handle_preempt)
 
     # Cancellable sync inputs: the asyncio machinery lives on the
     # synchronizer's daemon thread, leaving THIS (main) thread free to host

@@ -44,3 +44,54 @@ def _set_current_context_ids(input_id: Optional[str], function_call_id: Optional
         _current_function_call_id.reset(t2)
 
     return reset
+
+
+def _resolve_input_id() -> Optional[str]:
+    """The current input id, tolerating contexts that don't propagate the
+    ContextVar (sync user code on the main-thread executor): with exactly one
+    input in flight, it's unambiguous."""
+    input_id = _current_input_id.get()
+    if input_id is not None:
+        return input_id
+    from .io_manager import ContainerIOManager
+
+    io = ContainerIOManager.singleton()
+    if io is not None and len(io.current_input_ids) == 1:
+        return next(iter(io.current_input_ids))
+    return None
+
+
+def resume_token() -> Optional[str]:
+    """The resume token a prior preempted attempt of THIS input recorded via
+    `set_resume_token` (redelivered with the input) — None on a fresh attempt.
+    User code restarts from the checkpoint the token names instead of from
+    scratch:
+
+        start = int(modal_tpu.resume_token() or 0)
+        for step in range(start, total_steps):
+            ...
+            modal_tpu.set_resume_token(str(step + 1))
+    """
+    from .io_manager import ContainerIOManager
+
+    io = ContainerIOManager.singleton()
+    input_id = _resolve_input_id()
+    if io is None or input_id is None:
+        return None
+    return io.delivered_resume_tokens.get(input_id) or None
+
+
+def set_resume_token(token: str) -> None:
+    """Record the current input's resume token (e.g. a Volume checkpoint
+    path, or a serialized progress cursor). If the worker is preempted
+    mid-execution, the container flushes the latest token to the control
+    plane (ContainerCheckpoint) inside the grace window, and the requeued
+    attempt is redelivered with it. Cheap: a local dict write — call it at
+    every checkpoint boundary. No-op outside a container."""
+    from .io_manager import ContainerIOManager
+
+    io = ContainerIOManager.singleton()
+    input_id = _resolve_input_id()
+    if io is None or input_id is None:
+        return
+    io.recorded_resume_tokens[input_id] = token

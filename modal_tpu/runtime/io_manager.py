@@ -14,7 +14,7 @@ import asyncio
 import inspect
 import time
 import traceback
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, AsyncGenerator, Callable, Optional
 
 from .._utils.async_utils import ConcurrencySemaphore, TaskContext
@@ -87,6 +87,11 @@ class ContainerIOManager:
         # via SIGUSR1 instead of task.cancel (container_entrypoint._call_sync)
         self._mt_jobs: dict[str, Any] = {}
         self.terminate = False
+        # preemption resume plumbing (execution_context.resume_token /
+        # set_resume_token): tokens redelivered WITH inputs, and tokens user
+        # code recorded for in-flight inputs (flushed on preempt)
+        self.delivered_resume_tokens: dict[str, str] = {}
+        self.recorded_resume_tokens: dict[str, str] = {}
         self._waiting_for_checkpoint = False
         self.heartbeat_condition = asyncio.Condition()
         max_conc = function_def.max_concurrent_inputs or 1
@@ -228,6 +233,9 @@ class ContainerIOManager:
                     method_name=method_name,
                     data_format=ctx_format,
                 )
+                for item in items:
+                    if item.resume_token:
+                        self.delivered_resume_tokens[item.input_id] = item.resume_token
                 self.current_input_ids |= set(ctx.input_ids)
                 slot_held = False  # transferred to the runner
                 yield ctx
@@ -263,7 +271,46 @@ class ContainerIOManager:
                 additional_status_codes=[],
             )
         self.current_input_ids -= set(ctx.input_ids)
+        for iid in ctx.input_ids:
+            self.delivered_resume_tokens.pop(iid, None)
+            self.recorded_resume_tokens.pop(iid, None)
         self.input_slots.release()
+
+    # -- preemption checkpoint flush ----------------------------------------
+
+    async def flush_resume_tokens(self) -> int:
+        """Preempt hook (container_entrypoint): push every in-flight input's
+        recorded resume token to the control plane so the requeued attempt is
+        redelivered with it. Returns the number flushed. Bounded retries —
+        the grace window is ticking."""
+        async def _flush_one(input_id: str, token: str) -> bool:
+            try:
+                await retry_transient_errors(
+                    self.stub.ContainerCheckpoint,
+                    api_pb2.ContainerCheckpointRequest(
+                        task_id=self.task_id, input_id=input_id, resume_token=token
+                    ),
+                    max_retries=2,
+                    attempt_timeout=5.0,
+                )
+                return True
+            except Exception as exc:
+                logger.warning(f"resume-token flush failed for {input_id}: {exc}")
+                return False
+
+        # concurrent: sequential flushes would sum per-input retry time and
+        # blow the caller's grace-window budget, silently dropping the tail
+        pending = [
+            (iid, self.recorded_resume_tokens.get(iid, ""))
+            for iid in list(self.current_input_ids)
+        ]
+        results = await asyncio.gather(
+            *(_flush_one(iid, token) for iid, token in pending if token)
+        )
+        flushed = sum(results)
+        if flushed:
+            logger.warning(f"preempt: flushed {flushed} resume token(s)")
+        return flushed
 
     async def format_result(self, value: Any, data_format: int = api_pb2.DATA_FORMAT_PICKLE) -> api_pb2.GenericResult:
         if data_format == api_pb2.DATA_FORMAT_CBOR:

@@ -15,11 +15,20 @@ from .state import ServerState
 
 
 class BlobServer:
-    def __init__(self, state: ServerState, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, state: ServerState, host: str = "127.0.0.1", port: int = 0, chaos=None):
         self.state = state
         self.host = host
         self.port = port
+        # ChaosPolicy (modal_tpu/chaos.py): blob routes are injected under
+        # pseudo-RPC names (BlobPut/BlobGet/...) so the same seeded policy
+        # covers the HTTP data plane and the gRPC planes alike
+        self.chaos = chaos
         self._runner: Optional[web.AppRunner] = None
+
+    async def _inject(self, route: str) -> Optional[web.Response]:
+        if self.chaos is None:
+            return None
+        return await self.chaos.inject_http(route)
 
     # multipart observability (tests assert genuine part parallelism)
     inflight_parts: int = 0
@@ -64,6 +73,8 @@ class BlobServer:
         )
 
     async def _put(self, request: web.Request) -> web.Response:
+        if (injected := await self._inject("BlobPut")) is not None:
+            return injected
         blob_id = request.match_info["blob_id"]
         path = self.state.blob_path(blob_id)
         tmp = path + ".tmp"
@@ -76,6 +87,8 @@ class BlobServer:
     async def _put_part(self, request: web.Request) -> web.Response:
         """One multipart part (reference: S3 presigned part PUT,
         perform_multipart_upload blob_utils.py:166)."""
+        if (injected := await self._inject("BlobPutPart")) is not None:
+            return injected
         blob_id = request.match_info["blob_id"]
         part = int(request.match_info["part"])
         self.inflight_parts += 1
@@ -93,6 +106,8 @@ class BlobServer:
 
     async def _complete(self, request: web.Request) -> web.Response:
         """Assemble parts into the final blob (reference completion_url)."""
+        if (injected := await self._inject("BlobComplete")) is not None:
+            return injected
         blob_id = request.match_info["blob_id"]
         n_parts = int(request.match_info["n_parts"])
         final = self.state.blob_path(blob_id)
@@ -112,6 +127,8 @@ class BlobServer:
         return web.Response(status=200)
 
     async def _get(self, request: web.Request) -> web.StreamResponse:
+        if (injected := await self._inject("BlobGet")) is not None:
+            return injected
         blob_id = request.match_info["blob_id"]
         path = self.state.blob_path(blob_id)
         if not os.path.exists(path):

@@ -252,10 +252,13 @@ class InputPlaneServer:
     """Owns the gRPC server for the input-plane servicer (own port; in
     production a separate regional deployment)."""
 
-    def __init__(self, state: ServerState, control_servicer, port: int = 0):
+    def __init__(self, state: ServerState, control_servicer, port: int = 0, chaos=None):
         self.servicer = InputPlaneServicer(state, control_servicer)
         self.state = state
         self.port = port
+        # ChaosPolicy (modal_tpu/chaos.py): the same seeded policy the control
+        # plane uses injects here too, so fault knobs cover BOTH planes
+        self.chaos = chaos
         self._server: Optional[grpc.aio.Server] = None
 
     async def start(self) -> None:
@@ -265,7 +268,12 @@ class InputPlaneServer:
                 ("grpc.max_send_message_length", 128 * 1024 * 1024),
             ]
         )
-        self._server.add_generic_rpc_handlers((build_generic_handler(self.servicer),))
+        handler_target = self.servicer
+        if self.chaos is not None:
+            from ..chaos import ChaosServicerProxy
+
+            handler_target = ChaosServicerProxy(self.servicer, self.chaos)
+        self._server.add_generic_rpc_handlers((build_generic_handler(handler_target),))
         self.port = self._server.add_insecure_port(f"127.0.0.1:{self.port}")
         self.state.input_plane_url = f"grpc://127.0.0.1:{self.port}"
         await self._server.start()

@@ -34,6 +34,13 @@ PLACEMENT_UNSAT_GRACE_S = 5.0
 # unhealthy threshold: 50 × heartbeat_interval, container_io_manager.py:605;
 # locally we use a much tighter bound).
 TASK_HEARTBEAT_TIMEOUT = 120.0
+# Tasks assigned to a worker that never said ContainerHello within this window
+# while their worker is gone are stranded: nothing will ever heartbeat, so the
+# heartbeat reaper can't see them — fail them explicitly.
+TASK_LAUNCH_TIMEOUT = 60.0
+# margin past a draining worker's grace window before its unreported tasks
+# are force-reaped (covers a worker that died mid-drain)
+DRAIN_REAP_MARGIN = 10.0
 
 
 class Scheduler:
@@ -302,6 +309,9 @@ class Scheduler:
         for worker in self.s.workers.values():
             if time.time() - worker.last_heartbeat > 60.0:
                 continue
+            if worker.draining:
+                # drain state: a preempting host takes no NEW placements
+                continue
             if not self._placement_ok(worker, placement):
                 continue
             if slice_index is not None and worker.slice_index != slice_index:
@@ -536,6 +546,64 @@ class Scheduler:
         await worker.events.put(api_pb2.WorkerPollResponse(assignment=assignment))
         return task
 
+    # ------------------------------------------------------------------
+    # Preemption drain (TPU slices get preempted: drain = stop placing new
+    # inputs on the host, requeue its claimed inputs, re-place gangs)
+    # ------------------------------------------------------------------
+
+    async def _send_stop(self, task: TaskState_, grace_s: float, preempt: bool) -> None:
+        worker = self.s.workers.get(task.worker_id)
+        if worker is not None:
+            await worker.events.put(
+                api_pb2.WorkerPollResponse(
+                    stop=api_pb2.TaskStopEvent(
+                        task_id=task.task_id, preempt=preempt, grace_s=grace_s
+                    )
+                )
+            )
+
+    async def _preempt_task(self, task: TaskState_, grace_s: float, notify_worker: bool) -> None:
+        """Mark a task preempted (its claimed inputs will REQUEUE without
+        consuming retry budget when it reports) and stop it gracefully.
+        Gangs preempt as a unit: peers on healthy hosts drain too, so the
+        replacement gang is re-placed atomically from the backlog."""
+        task.preempted = True
+        task.terminate = True
+        if task.cluster_id and task.cluster_id in self.s.clusters:
+            for peer_id in self.s.clusters[task.cluster_id].task_ids:
+                peer = self.s.tasks.get(peer_id)
+                if peer is not None and peer_id != task.task_id and not peer.preempted:
+                    peer.preempted = True
+                    peer.terminate = True
+                    await self._send_stop(peer, grace_s, True)
+        if notify_worker:
+            await self._send_stop(task, grace_s, True)
+
+    async def drain_worker(
+        self, worker_id: str, grace_s: float = 10.0, notify_worker: bool = True
+    ) -> None:
+        """Enter drain state for a (pre-)preempted worker: `_pick_worker`
+        stops placing here immediately; every live task gets a graceful
+        preempt-stop (the container's preempt hook flushes a checkpoint
+        inside the grace window); tasks that never report by the drain
+        deadline are force-reaped by `reap_dead_tasks`.
+
+        `notify_worker=False` when the WORKER initiated the drain (it already
+        SIGTERMs its own containers) — gang peers on other hosts are still
+        notified either way."""
+        worker = self.s.workers.get(worker_id)
+        if worker is None:
+            return
+        worker.draining = True
+        worker.drain_deadline = time.time() + grace_s + DRAIN_REAP_MARGIN
+        logger.warning(f"worker {worker_id} draining (grace {grace_s}s)")
+        for task_id in list(worker.active_tasks):
+            task = self.s.tasks.get(task_id)
+            if task is None or task.finished_at:
+                continue
+            await self._preempt_task(task, grace_s, notify_worker)
+        self.s.schedule_event.set()
+
     def _gc_scheduled_calls(self) -> None:
         """Drop completed server-originated (scheduled-fire) calls + their
         inputs: no client will ever read them, and a Period(minutes=1) app
@@ -550,28 +618,87 @@ class Scheduler:
                 del self.s.function_calls[call_id]
 
     async def reap_dead_tasks(self) -> None:
-        """Fail tasks whose containers stopped heartbeating (failure
-        detection; reference surfaces this as TaskState PREEMPTED/FAILED).
-        Claimed inputs of a dead task retry or fail so clients never hang."""
+        """Failure detection (reference surfaces this as TaskState
+        PREEMPTED/FAILED). Three reap classes, so clients never hang:
+
+        1. heartbeat timeout: the container stopped heartbeating — claimed
+           inputs retry (budget consumed) or fail-fast when exhausted;
+        2. drain deadline: a draining (preempted) worker's task never
+           reported — inputs requeue for FREE (system-initiated preemption
+           must not burn the user's retry budget);
+        3. stranded launch: a task assigned to a worker that vanished before
+           the container ever said hello — nothing will ever heartbeat, so
+           the heartbeat reaper alone would leak it forever.
+        """
         now = time.time()
         for task in list(self.s.tasks.values()):
-            if task.state == api_pb2.TASK_STATE_ACTIVE and task.last_heartbeat:
-                if now - task.last_heartbeat > TASK_HEARTBEAT_TIMEOUT:
-                    logger.warning(f"task {task.task_id} heartbeat lost; failing")
-                    task.state = api_pb2.TASK_STATE_FAILED
-                    task.terminate = True
-                    task.finished_at = now
-                    result = api_pb2.GenericResult(
-                        status=api_pb2.GENERIC_STATUS_INTERNAL_FAILURE,
-                        exception=f"container {task.task_id} lost (heartbeat timeout)",
-                    )
-                    if self.servicer is not None:
-                        await self.servicer._fail_claimed_inputs(task, result)
-                        self.servicer._release_task(task)
-                    worker = self.s.workers.get(task.worker_id)
-                    if worker is not None:
-                        await worker.events.put(
-                            api_pb2.WorkerPollResponse(
-                                stop=api_pb2.TaskStopEvent(task_id=task.task_id, force=True)
-                            )
-                        )
+            if task.finished_at:
+                continue
+            worker = self.s.workers.get(task.worker_id)
+            worker_dead = worker is None or now - worker.last_heartbeat > 90.0
+            if (
+                task.state == api_pb2.TASK_STATE_ACTIVE
+                and task.last_heartbeat
+                and now - task.last_heartbeat > TASK_HEARTBEAT_TIMEOUT
+            ):
+                await self._reap_task(task, "heartbeat timeout", free_requeue=task.preempted)
+            elif (
+                worker is not None
+                and worker.draining
+                and worker.drain_deadline
+                and now > worker.drain_deadline
+            ):
+                await self._reap_task(task, "drain deadline expired", free_requeue=True)
+            elif (
+                task.state in (api_pb2.TASK_STATE_WORKER_ASSIGNED, api_pb2.TASK_STATE_CREATED)
+                and worker_dead
+                and now - task.created_at > TASK_LAUNCH_TIMEOUT
+            ):
+                await self._reap_task(task, "worker lost before container start", free_requeue=False)
+        # a fully-drained worker with nothing left running leaves the
+        # registry: placement checks stop counting it, and a replacement
+        # host registering under a fresh id takes over cleanly
+        for worker_id, worker in list(self.s.workers.items()):
+            if (
+                worker.draining
+                and worker.drain_deadline
+                and now > worker.drain_deadline
+                and not worker.active_tasks
+            ):
+                logger.info(f"drained worker {worker_id} deregistered")
+                del self.s.workers[worker_id]
+
+    async def _reap_task(self, task: TaskState_, reason: str, free_requeue: bool) -> None:
+        """Tear down one dead/stuck task. `free_requeue` (preemption): its
+        inputs go back to pending without consuming the retry budget;
+        otherwise inputs retry under the policy or fail-fast when
+        exhausted."""
+        now = time.time()
+        logger.warning(
+            f"task {task.task_id} {reason}; "
+            + ("requeueing its inputs" if free_requeue else "failing/retrying its inputs")
+        )
+        task.terminate = True
+        task.finished_at = now
+        if free_requeue:
+            task.preempted = True
+            task.state = api_pb2.TASK_STATE_PREEMPTED
+            if self.servicer is not None:
+                await self.servicer._requeue_claimed_inputs(task)
+                self.servicer._release_task(task)
+        else:
+            task.state = api_pb2.TASK_STATE_FAILED
+            result = api_pb2.GenericResult(
+                status=api_pb2.GENERIC_STATUS_INTERNAL_FAILURE,
+                exception=f"container {task.task_id} lost ({reason})",
+            )
+            if self.servicer is not None:
+                await self.servicer._fail_claimed_inputs(task, result)
+                self.servicer._release_task(task)
+        worker = self.s.workers.get(task.worker_id)
+        if worker is not None:
+            await worker.events.put(
+                api_pb2.WorkerPollResponse(
+                    stop=api_pb2.TaskStopEvent(task_id=task.task_id, force=True)
+                )
+            )

@@ -52,6 +52,7 @@ class ModalTPUServicer:
     def __init__(self, state: ServerState):
         self.s = state
         self.scheduler = None  # wired by the supervisor (sandbox placement)
+        self.chaos = None  # ChaosPolicy, wired by the supervisor when attached
         # real throttling control surfaced to containers on every GetInputs
         # response (reference rate_limit_sleep_duration)
         self.rate_limit_sleep_duration = 0.0
@@ -987,6 +988,7 @@ class ModalTPUServicer:
                             function_call_id=inp.function_call_id,
                             idx=inp.idx,
                             retry_count=inp.retry_count,
+                            resume_token=inp.resume_token,
                         )
                     )
             else:
@@ -1011,6 +1013,7 @@ class ModalTPUServicer:
                                 function_call_id=inp.function_call_id,
                                 idx=inp.idx,
                                 retry_count=inp.retry_count,
+                                resume_token=inp.resume_token,
                             )
                         )
                     if not items or len(items) >= batch_size or not request.batch_linger_ms:
@@ -1050,16 +1053,15 @@ class ModalTPUServicer:
             call = self.s.function_calls.get(item.function_call_id)
             if call is None:
                 continue
-            if (
-                pushing_task is not None
-                and pushing_task.preempted
-                and item.result.status == api_pb2.GENERIC_STATUS_SUCCESS
-            ):
-                # a gang-preempted task pushes void results: its input was
-                # already re-queued for a replacement gang, and a stale
-                # SUCCESS would complete the call with partial work.
+            if pushing_task is not None and pushing_task.preempted:
+                # a preempted task pushes void results: its inputs are (being)
+                # re-queued — a stale SUCCESS would complete the call with
+                # partial work, and a TERMINATED from the drain cancellation
+                # would surface as a client error instead of the free retry.
                 # (Only .preempted — plain terminate also covers app drain,
-                # where concurrent calls' successes are still valid.)
+                # where concurrent calls' outputs are still valid. Gang
+                # fail-fast is preserved: the CRASHING rank is never marked
+                # preempted, only its torn-down peers are.)
                 continue
             if pushing_task is not None:
                 # stamp before dedup: every rank's first push counts as its
@@ -1101,6 +1103,25 @@ class ModalTPUServicer:
         return api_pb2.FunctionPutOutputsResponse()
 
     async def ContainerCheckpoint(self, request, context):
+        # preemption flush (runtime/preemption.py): the container recorded a
+        # checkpoint for a claimed input — stash the resume token on the
+        # input so the requeued attempt is redelivered with it and restarts
+        # from the checkpoint instead of from scratch
+        if request.input_id and request.resume_token:
+            inp = self.s.inputs.get(request.input_id)
+            # stale-flush guard: a dead attempt's delayed flush must not
+            # clobber the token a NEWER attempt recorded after the requeue —
+            # accept only from the attempt that currently holds the input,
+            # or a first-ever token for an input nobody holds
+            if inp is not None and (
+                inp.claimed_by == request.task_id
+                or request.task_id in inp.delivered_to
+                or (not inp.claimed_by and not inp.resume_token)
+            ):
+                inp.resume_token = request.resume_token
+                logger.debug(
+                    f"resume token recorded for {request.input_id}: {request.resume_token!r}"
+                )
         return api_pb2.ContainerCheckpointResponse()
 
     async def ContainerStop(self, request, context):
@@ -1331,10 +1352,22 @@ class ModalTPUServicer:
             task.result = request.result
             if request.result.status == api_pb2.GENERIC_STATUS_SUCCESS:
                 task.state = api_pb2.TASK_STATE_COMPLETED
+                if task.preempted:
+                    # drain race: outputs pushed after the preempt flag was
+                    # set were dropped by FunctionPutOutputs, yet the
+                    # container drained cleanly and reports SUCCESS — those
+                    # inputs are still claimed and must requeue or the
+                    # client hangs (inputs whose outputs landed before the
+                    # flag are completed and untouched by the requeue)
+                    await self._requeue_claimed_inputs(task)
+            elif task.preempted:
+                # preemption drain: claimed inputs go back to pending WITHOUT
+                # consuming the user retry budget — system-initiated worker
+                # loss is not the input's fault
+                task.state = api_pb2.TASK_STATE_PREEMPTED
+                await self._requeue_claimed_inputs(task)
             else:
-                task.state = (
-                    api_pb2.TASK_STATE_PREEMPTED if task.preempted else api_pb2.TASK_STATE_FAILED
-                )
+                task.state = api_pb2.TASK_STATE_FAILED
                 await self._fail_claimed_inputs(task, request.result)
                 if request.result.status == api_pb2.GENERIC_STATUS_INIT_FAILURE:
                     # containers that die before serving (image build failed,
@@ -1438,6 +1471,42 @@ class ModalTPUServicer:
                 call.num_done += 1
                 async with call.output_condition:
                     call.output_condition.notify_all()
+
+    async def _requeue_claimed_inputs(self, task: TaskState_) -> None:
+        """Preemption path: inputs touched by a preempted task return to
+        pending WITHOUT consuming the retry budget (contrast
+        `_fail_claimed_inputs`, the crash path). The recorded resume_token
+        (ContainerCheckpoint) survives the requeue, so the next attempt is
+        redelivered with it and resumes from the checkpoint. Idempotent: gang
+        peers reporting one after another requeue each input once."""
+        gang_tasks: set[str] = set()
+        if task.cluster_id and task.cluster_id in self.s.clusters:
+            gang_tasks = set(self.s.clusters[task.cluster_id].task_ids)
+        dead_ids = gang_tasks | {task.task_id}
+        fn = self.s.functions.get(task.function_id)
+        if fn is None:
+            return
+        requeued = 0
+        for inp in self.s.inputs.values():
+            touched = bool(
+                inp.delivered_to & dead_ids or (inp.claimed_by and inp.claimed_by in dead_ids)
+            )
+            if not touched or inp.status not in ("pending", "claimed"):
+                continue
+            inp.status = "pending"
+            inp.delivered_to -= dead_ids
+            inp.claimed_by = ""
+            inp.claimed_at = 0.0
+            if inp.input_id not in fn.pending:
+                fn.pending.append(inp.input_id)
+            requeued += 1
+        if requeued:
+            logger.warning(
+                f"requeued {requeued} input(s) from preempted task {task.task_id} (no retry consumed)"
+            )
+            async with fn.input_condition:
+                fn.input_condition.notify_all()
+            self.s.schedule_event.set()
 
     def _release_task(self, task: TaskState_) -> None:
         worker = self.s.workers.get(task.worker_id)
@@ -2149,6 +2218,18 @@ class ModalTPUServicer:
         worker = self.s.workers.get(request.worker_id)
         if worker is not None:
             worker.last_heartbeat = time.time()
+            if request.draining and not worker.draining and self.scheduler is not None:
+                # worker announces an impending preemption (SIGTERM from the
+                # cloud): enter drain state. The worker SIGTERMs its own
+                # containers, so don't double-signal them from here. Honor
+                # the grace the worker promised its containers — reaping on
+                # the env default would SIGKILL them mid-checkpoint-flush.
+                grace = request.drain_grace_s or float(
+                    os.environ.get("MODAL_TPU_PREEMPT_GRACE", "10")
+                )
+                await self.scheduler.drain_worker(
+                    request.worker_id, grace_s=grace, notify_worker=False
+                )
         return api_pb2.WorkerHeartbeatResponse()
 
     # ------------------------------------------------------------------

@@ -58,6 +58,9 @@ class InputState:
     created_at: float = field(default_factory=time.time)
     # gang broadcast: which gang members have received this input
     delivered_to: set = field(default_factory=set)
+    # checkpoint recorded by a preempted attempt (ContainerCheckpoint):
+    # redelivered with the input so the retry resumes instead of restarting
+    resume_token: str = ""
 
 
 @dataclass
@@ -170,6 +173,10 @@ class WorkerState:
     events: asyncio.Queue = field(default_factory=asyncio.Queue)
     active_tasks: set[str] = field(default_factory=set)
     chips_in_use: dict[int, str] = field(default_factory=dict)  # chip_id -> task_id
+    # preemption drain: no NEW placements land here; tasks still running past
+    # drain_deadline are force-reaped (their inputs requeue for free)
+    draining: bool = False
+    drain_deadline: float = 0.0
 
     def free_chips(self) -> list[int]:
         return [c for c in range(self.num_chips) if c not in self.chips_in_use]

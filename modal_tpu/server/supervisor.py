@@ -15,6 +15,7 @@ from typing import Optional
 
 import grpc
 
+from ..chaos import ChaosPolicy, ChaosServicerProxy
 from ..config import config, logger
 from ..proto.rpc import build_generic_handler
 from .blob_server import BlobServer
@@ -35,6 +36,7 @@ class LocalSupervisor:
         worker_tpu_type: Optional[str] = None,
         servicer_cls: type = ModalTPUServicer,  # tests inject fault-wrapping subclasses
         hosts_per_slice: int = 0,  # 0 = all workers share slice 0
+        chaos: Optional[ChaosPolicy] = None,  # one policy object, every layer
     ):
         self.num_workers = num_workers
         self.port = port
@@ -43,13 +45,18 @@ class LocalSupervisor:
         self.worker_tpu_type = worker_tpu_type
         self.hosts_per_slice = hosts_per_slice
         self.state = ServerState(self.state_dir)
+        # chaos: explicit policy, else env-driven (MODAL_TPU_CHAOS=1)
+        self.chaos = chaos if chaos is not None else ChaosPolicy.from_env()
         self.servicer = servicer_cls(self.state)
+        self.servicer.chaos = self.chaos
         self.scheduler = Scheduler(self.state, self.servicer)
         self.servicer.scheduler = self.scheduler
-        self.blob_server = BlobServer(self.state)
-        self.input_plane = InputPlaneServer(self.state, self.servicer)
+        self.blob_server = BlobServer(self.state, chaos=self.chaos)
+        self.input_plane = InputPlaneServer(self.state, self.servicer, chaos=self.chaos)
         self.workers: list[WorkerAgent] = []
         self._grpc_server: Optional[grpc.aio.Server] = None
+        self._chaos_task: Optional[asyncio.Task] = None
+        self._chaos_subtasks: set[asyncio.Task] = set()  # strong refs (GC guard)
 
     @property
     def server_url(self) -> str:
@@ -63,7 +70,12 @@ class LocalSupervisor:
                 ("grpc.max_send_message_length", 128 * 1024 * 1024),
             ]
         )
-        self._grpc_server.add_generic_rpc_handlers((build_generic_handler(self.servicer),))
+        # chaos attaches at the handler boundary so the servicer itself (and
+        # every in-process caller: scheduler, tests) stays clean
+        handler_target = (
+            ChaosServicerProxy(self.servicer, self.chaos) if self.chaos is not None else self.servicer
+        )
+        self._grpc_server.add_generic_rpc_handlers((build_generic_handler(handler_target),))
         self.port = self._grpc_server.add_insecure_port(f"127.0.0.1:{self.port}")
         await self._grpc_server.start()
         await self.blob_server.start()
@@ -76,12 +88,66 @@ class LocalSupervisor:
                 tpu_type=self.worker_tpu_type,
                 state_dir=self.state_dir,
                 slice_index=(i // self.hosts_per_slice) if self.hosts_per_slice else 0,
+                chaos=self.chaos,
             )
             await worker.start()
             self.workers.append(worker)
+        if self.chaos is not None and self.chaos.events:
+            self._chaos_task = asyncio.create_task(self._chaos_event_loop(), name="chaos-events")
         logger.debug(f"local supervisor up at {self.server_url} ({self.num_workers} workers)")
 
+    async def _chaos_event_loop(self) -> None:
+        """Fire scheduled chaos events (worker kill / preempt / heartbeat
+        blackhole) once their output-count threshold passes."""
+        while True:
+            try:
+                for ev in self.chaos.pop_due_events():
+                    idx = min(ev.worker_index, len(self.workers) - 1)
+                    if idx < 0:
+                        continue
+                    if ev.kind == "worker_preempt":
+                        logger.warning(f"chaos: preempting worker {idx} (grace {ev.grace_s}s)")
+                        t = asyncio.create_task(self.workers[idx].preempt(ev.grace_s))
+                        self._chaos_subtasks.add(t)
+                        t.add_done_callback(self._chaos_subtasks.discard)
+                    elif ev.kind == "worker_kill":
+                        logger.warning(f"chaos: killing worker {idx} containers")
+                        self.workers[idx].kill_containers()
+                    elif ev.kind == "heartbeat_blackhole":
+                        self.chaos.start_heartbeat_blackhole(ev.duration_s)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("chaos event loop iteration failed")
+            await asyncio.sleep(0.1)
+
+    async def preempt_worker(self, index: int = 0, grace_s: float = 10.0) -> None:
+        """Simulate a TPU-slice preemption notice for one worker: drain +
+        graceful container stop + checkpoint flush + input requeue."""
+        await self.workers[index].preempt(grace_s)
+
     async def stop(self) -> None:
+        # bounded: a supervisor that cannot shut down must not hang its host
+        # forever — on timeout, log every still-pending task (with its await
+        # site) and abandon the stragglers
+        try:
+            await asyncio.wait_for(asyncio.shield(self._stop_inner()), timeout=30.0)
+        except asyncio.TimeoutError:
+            pending = [t for t in asyncio.all_tasks() if t is not asyncio.current_task()]
+            detail = "\n".join(f"  {t!r}" for t in pending if not t.done())
+            logger.error(f"supervisor stop timed out after 30s; pending tasks:\n{detail}")
+
+    async def _stop_inner(self) -> None:
+        if self._chaos_task is not None:
+            self._chaos_task.cancel()
+            try:
+                await self._chaos_task
+            except asyncio.CancelledError:
+                pass
+        for t in list(self._chaos_subtasks):
+            t.cancel()
+        if self._chaos_subtasks:
+            await asyncio.gather(*self._chaos_subtasks, return_exceptions=True)
         for worker in self.workers:
             await worker.stop()
         await self.scheduler.stop()

@@ -89,6 +89,7 @@ class WorkerAgent:
         spot: Optional[bool] = None,
         instance_type: Optional[str] = None,
         slice_index: int = 0,
+        chaos=None,  # ChaosPolicy: lifecycle faults + heartbeat blackhole
     ):
         self.server_url = server_url
         self.worker_id = worker_id or ""
@@ -122,6 +123,12 @@ class WorkerAgent:
         self._tasks: list[asyncio.Task] = []
         self._escalations: set[asyncio.Task] = set()
         self._stopped = False
+        self.chaos = chaos
+        # preemption drain: announced to the control plane on the next
+        # heartbeat; assignments that race the notice are preempt-signaled
+        # as soon as they spawn (_run_task) instead of running unaware
+        self.draining = False
+        self._drain_grace_s = 10.0
 
     async def start(self) -> None:
         os.makedirs(os.path.join(self.state_dir, "tasks"), exist_ok=True)
@@ -203,13 +210,87 @@ class WorkerAgent:
                 await retry_transient_errors(
                     self._stub.WorkerHeartbeat,
                     api_pb2.WorkerHeartbeatRequest(
-                        worker_id=self.worker_id, active_task_ids=list(self._procs.keys())
+                        worker_id=self.worker_id,
+                        active_task_ids=list(self._procs.keys()),
+                        draining=self.draining,
+                        drain_grace_s=self._drain_grace_s if self.draining else 0.0,
                     ),
                     max_retries=2,
                 )
             except Exception as exc:
                 logger.warning(f"worker heartbeat failed: {exc}")
             await asyncio.sleep(5.0)
+
+    # ------------------------------------------------------------------
+    # Preemption lifecycle (TPU slices get preempted: the cloud sends the
+    # host a termination notice with a grace window)
+    # ------------------------------------------------------------------
+
+    async def preempt(self, grace_s: float = 10.0) -> None:
+        """Simulate/handle a preemption notice for this host.
+
+        Order matters: the control plane must mark this worker's tasks
+        preempted BEFORE any container exits — else an early TaskResult
+        lands while `task.preempted` is False and the inputs burn retry
+        budget instead of requeueing for free. So: (1) announce draining
+        via an immediate heartbeat (the servicer enters scheduler drain
+        state synchronously in the handler), (2) send each container the
+        preempt signal (SIGUSR2 → checkpoint flush, then graceful exit),
+        (3) escalate to SIGTERM/SIGKILL after the grace window."""
+        if self.draining:
+            return
+        self.draining = True
+        self._drain_grace_s = grace_s
+        logger.warning(f"worker {self.worker_id} preempted (grace {grace_s}s); draining")
+        try:
+            await retry_transient_errors(
+                self._stub.WorkerHeartbeat,
+                api_pb2.WorkerHeartbeatRequest(
+                    worker_id=self.worker_id,
+                    active_task_ids=list(self._procs.keys()),
+                    draining=True,
+                    drain_grace_s=grace_s,
+                ),
+                max_retries=3,
+                max_delay=1.0,
+            )
+        except Exception as exc:
+            logger.warning(f"preemption drain announce failed: {exc}")
+        for task_id, proc in list(self._procs.items()):
+            self._signal_preempt(task_id, proc, grace_s)
+
+    def _signal_preempt(self, task_id: str, proc: asyncio.subprocess.Process, grace_s: float) -> None:
+        """SIGUSR2 = preempt notice (the entrypoint's preempt hook flushes a
+        checkpoint + resume token, then exits gracefully); SIGTERM at the
+        grace deadline; SIGKILL 5s later for containers stuck in user code."""
+        if proc.returncode is not None:
+            return
+        try:
+            proc.send_signal(signal.SIGUSR2)
+        except ProcessLookupError:
+            return
+
+        async def _escalate(p=proc, tid=task_id) -> None:
+            try:
+                await asyncio.wait_for(p.wait(), timeout=grace_s)
+                return
+            except asyncio.TimeoutError:
+                logger.warning(f"task {tid} still running at preemption deadline; terminating")
+            await self._kill_proc(p)
+
+        esc = asyncio.create_task(_escalate())
+        self._escalations.add(esc)
+        esc.add_done_callback(self._escalations.discard)
+
+    def kill_containers(self) -> None:
+        """Chaos worker_kill event: SIGKILL every container on this host, no
+        grace — models abrupt host loss (vs. preempt's graceful drain)."""
+        for task_id, proc in list(self._procs.items()):
+            if proc.returncode is None:
+                try:
+                    proc.kill()
+                except ProcessLookupError:
+                    pass
 
     async def _poll_loop(self) -> None:
         while not self._stopped:
@@ -259,6 +340,11 @@ class WorkerAgent:
                 self._early_stops.pop(next(iter(self._early_stops)))
             return
         logger.debug(f"stopping task {stop.task_id}")
+        if stop.preempt and not stop.force:
+            # scheduler-initiated preemption (e.g. a gang peer's host is
+            # draining): give the container its checkpoint-flush window
+            self._signal_preempt(stop.task_id, proc, stop.grace_s or 10.0)
+            return
         if stop.force:
             proc.kill()
         else:
@@ -865,6 +951,12 @@ class WorkerAgent:
         logger.debug(f"task {task_id} started pid={proc.pid}")
         if self._consume_early_stop(task_id):  # stop raced in during spawn
             proc.kill()
+        elif self.draining:
+            # assignment raced the preemption notice: preempt() only signals
+            # procs that existed when it ran, so a late-spawned container
+            # must get its own checkpoint-flush window before the drain
+            # deadline force-reaps it
+            self._signal_preempt(task_id, proc, self._drain_grace_s)
         self.router.register_task(task_id, env, container_cwd or os.getcwd(), token=assignment.router_token)
         tail_task = asyncio.create_task(self._stream_logs(task_id, stdout_path, stderr_path, proc))
         returncode = await proc.wait()

@@ -86,44 +86,28 @@ def tmp_state_dir(tmp_path, monkeypatch):
 
 
 def _make_fault_injecting_servicer():
-    """Test-only servicer subclass with failure-injection knobs (reference
-    MockClientServicer pattern, py/test/conftest.py:715-740): counters of
-    upcoming data-plane calls to fail with UNAVAILABLE. The production
-    servicer stays clean — tests flip `supervisor.servicer.fail_*`."""
-    import grpc as _grpc
-
+    """Test-only servicer subclass whose legacy `fail_*` knob attributes
+    delegate to the supervisor's ChaosPolicy (modal_tpu/chaos.py) — the
+    promoted form of the old hand-rolled fault-injecting subclass. Knobs now
+    cover BOTH planes: e.g. `fail_put_inputs` fails FunctionPutInputs on the
+    control plane AND MapStartOrContinue/AttemptStart on the input plane."""
+    from modal_tpu.chaos import KNOB_RPCS
     from modal_tpu.server.services import ModalTPUServicer
 
-    class FaultInjectingServicer(ModalTPUServicer):
-        def __init__(self, state):
-            super().__init__(state)
-            self.fail_get_inputs = 0
-            self.fail_put_outputs = 0
-            self.fail_put_inputs = 0
-            self.fail_get_outputs = 0
+    def _knob_property(knob: str) -> property:
+        def _get(self):
+            return self.chaos.get_knob(knob)
 
-        async def _maybe_fail(self, context, knob: str) -> None:
-            if getattr(self, knob) > 0:
-                setattr(self, knob, getattr(self, knob) - 1)
-                await context.abort(_grpc.StatusCode.UNAVAILABLE, f"injected fault: {knob}")
+        def _set(self, count: int) -> None:
+            self.chaos.set_knob(knob, count)
 
-        async def FunctionGetInputs(self, request, context):
-            await self._maybe_fail(context, "fail_get_inputs")
-            return await super().FunctionGetInputs(request, context)
+        return property(_get, _set)
 
-        async def FunctionPutOutputs(self, request, context):
-            await self._maybe_fail(context, "fail_put_outputs")
-            return await super().FunctionPutOutputs(request, context)
-
-        async def FunctionPutInputs(self, request, context):
-            await self._maybe_fail(context, "fail_put_inputs")
-            return await super().FunctionPutInputs(request, context)
-
-        async def FunctionGetOutputs(self, request, context):
-            await self._maybe_fail(context, "fail_get_outputs")
-            return await super().FunctionGetOutputs(request, context)
-
-    return FaultInjectingServicer
+    return type(
+        "ChaosKnobServicer",
+        (ModalTPUServicer,),
+        {knob: _knob_property(knob) for knob in KNOB_RPCS},
+    )
 
 
 @pytest.fixture
@@ -131,8 +115,13 @@ def supervisor(tmp_path, monkeypatch):
     """An in-process control plane + 1 worker (real gRPC on localhost),
     running on the synchronizer loop thread so both sync and async tests can
     talk to it. Async fixtures aren't possible without pytest-asyncio, so the
-    supervisor is driven through the blocking bridge."""
+    supervisor is driven through the blocking bridge.
+
+    Carries a zero-rate ChaosPolicy: no faults unless a test flips the
+    `servicer.fail_*` knobs (or mutates `sup.chaos` directly), but the chaos
+    injection path itself is exercised by every test that uses this fixture."""
     from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.chaos import ChaosPolicy
     from modal_tpu.client import _Client
     from modal_tpu.server.supervisor import LocalSupervisor
 
@@ -145,6 +134,7 @@ def supervisor(tmp_path, monkeypatch):
         worker_chips=8,
         worker_tpu_type="local-sim",
         servicer_cls=_make_fault_injecting_servicer(),
+        chaos=ChaosPolicy(seed=0),
     )
     synchronizer.run(sup.start())
     monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{sup.port}")

@@ -1,0 +1,123 @@
+"""Seeded chaos soak: a 50-input map under 5% injected UNAVAILABLE on every
+data-plane RPC plus one mid-run worker preemption must complete with zero
+lost results (ISSUE 1 acceptance run; the standing robustness harness every
+future PR can soak against).
+
+Run explicitly: `pytest -m chaos` (or `-m slow`).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+SOAK_SEED = 42
+
+# 5% UNAVAILABLE on the whole data plane: container pull/push, both map
+# planes, single-call attempts, and the blob store's HTTP routes.
+DATA_PLANE_RPCS = [
+    "FunctionGetInputs",
+    "FunctionPutOutputs",
+    "FunctionPutInputs",
+    "FunctionGetOutputs",
+    "FunctionMap",
+    "MapStartOrContinue",
+    "MapAwait",
+    "AttemptStart",
+    "AttemptAwait",
+    "BlobPut",
+    "BlobGet",
+]
+
+
+def _soak_policy():
+    from modal_tpu.chaos import ChaosEvent, ChaosPolicy
+
+    return ChaosPolicy(
+        seed=SOAK_SEED,
+        error_rates={rpc: 0.05 for rpc in DATA_PLANE_RPCS},
+        events=[
+            # preempt worker 0 once the map is ~1/5 done (outputs are the
+            # deterministic clock of a map run)
+            ChaosEvent(kind="worker_preempt", after_outputs=10, worker_index=0, grace_s=5.0),
+        ],
+    )
+
+
+@pytest.fixture
+def chaotic_supervisor(tmp_path, monkeypatch):
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.server.supervisor import LocalSupervisor
+
+    monkeypatch.setenv("MODAL_TPU_STATE_DIR", str(tmp_path / "state"))
+    sup = LocalSupervisor(
+        num_workers=2,
+        state_dir=str(tmp_path / "state"),
+        worker_chips=8,
+        worker_tpu_type="local-sim",
+        chaos=_soak_policy(),
+    )
+    synchronizer.run(sup.start())
+    monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{sup.port}")
+    _Client.set_env_client(None)
+    try:
+        yield sup
+    finally:
+        env_client = _Client._client_from_env
+        if env_client is not None and not env_client._closed:
+            env_client._close()
+        _Client.set_env_client(None)
+        synchronizer.run(sup.stop())
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_map_survives_faults_and_preemption(chaotic_supervisor):
+    import modal_tpu
+
+    sup = chaotic_supervisor
+    app = modal_tpu.App("chaos-soak")
+
+    def square(x):
+        import time as _t
+
+        _t.sleep(0.05)
+        return x * x
+
+    f = app.function(serialized=True)(square)
+    t0 = time.monotonic()
+    with app.run():
+        results = sorted(f.map(range(50)))
+    elapsed = time.monotonic() - t0
+    assert results == [x * x for x in range(50)], "lost or corrupted results under chaos"
+    # the chaos actually happened: faults were injected and the preemption
+    # event fired (a quiet run would prove nothing)
+    assert sum(sup.chaos.injected.values()) > 0, "no faults injected — soak was a no-op"
+    assert all(ev.fired for ev in sup.chaos.events), "worker preemption never fired"
+    print(
+        f"soak: {elapsed:.1f}s, {sum(sup.chaos.call_counts.values())} RPCs, "
+        f"{sum(sup.chaos.injected.values())} faults injected, "
+        f"fault log head: {sup.chaos.fault_log[:8]}"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_fault_sequence_is_seed_reproducible():
+    """Same seed + same per-RPC call counts ⇒ byte-identical fault decisions.
+    Replays the per-RPC call pattern of a soak policy against a fresh policy
+    with the same seed and checks the injected sequence matches exactly."""
+    a, b = _soak_policy(), _soak_policy()
+    # synthetic but realistic call mix (counts differ per RPC on purpose)
+    pattern = (
+        [("FunctionGetInputs", 120), ("FunctionPutOutputs", 60), ("MapStartOrContinue", 9)]
+        + [("MapAwait", 75), ("BlobPut", 12), ("BlobGet", 12), ("WorkerHeartbeat", 40)]
+    )
+    for policy in (a, b):
+        for rpc, n in pattern:
+            for _ in range(n):
+                policy.decide(rpc)
+    assert a.fault_log == b.fault_log and a.fault_log, "seeded chaos must be reproducible"
+    assert a.injected == b.injected

@@ -52,9 +52,11 @@ def test_map_survives_injected_put_inputs_faults(supervisor):
     with app.run():
         supervisor.servicer.fail_put_inputs = 2
         assert sorted(f.map([1, 2, 3])) == [1, 2, 3]
-        # the knob targets the control-plane pump: with the input plane
-        # routing maps elsewhere it must be pinned (and consumed) there
-        assert supervisor.servicer.fail_put_inputs == 2  # input plane active: untouched
+        # knobs route through ChaosPolicy and cover BOTH planes: with the
+        # input plane carrying the map, the budget is consumed by
+        # MapStartOrContinue instead of silently bypassed
+        assert supervisor.servicer.fail_put_inputs == 0, "faults must have been consumed"
+        assert supervisor.chaos.injected.get("MapStartOrContinue", 0) == 2
 
 
 def test_map_survives_put_inputs_faults_control_plane(supervisor, monkeypatch):

@@ -1,0 +1,414 @@
+"""Chaos subsystem + TPU preemption resilience.
+
+Three tiers:
+1. ChaosPolicy unit tests — seeded determinism (same seed ⇒ same injected
+   fault sequence, independent of RPC interleaving), knob budgets, blackhole.
+2. Scheduler-level reap/drain tests on a hand-built ServerState — heartbeat
+   timeout requeues (retries remaining) or fails fast (retries exhausted)
+   without the client hanging; drain state stops placement and requeues for
+   free.
+3. End-to-end preemption: a live worker is preempted mid-execution; the
+   container flushes a resume token inside the grace window and the retried
+   input resumes from the checkpoint instead of from scratch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from modal_tpu.chaos import ChaosEvent, ChaosPolicy
+
+# ---------------------------------------------------------------------------
+# 1. ChaosPolicy determinism
+# ---------------------------------------------------------------------------
+
+
+def _drive(policy: ChaosPolicy, calls: list[str]) -> list[tuple[float, bool]]:
+    return [policy.decide(rpc) for rpc in calls]
+
+
+def test_same_seed_same_fault_sequence():
+    calls = ["FunctionGetInputs", "FunctionPutOutputs", "FunctionGetInputs"] * 40
+    a = ChaosPolicy(seed=7, default_error_rate=0.2)
+    b = ChaosPolicy(seed=7, default_error_rate=0.2)
+    assert _drive(a, calls) == _drive(b, calls)
+    assert a.fault_log == b.fault_log
+    assert a.fault_log, "0.2 over 120 calls must inject at least once"
+
+
+def test_interleaving_does_not_change_per_rpc_decisions():
+    """Each RPC draws from its own (seed, rpc) stream: the k-th call of an RPC
+    gets the same decision regardless of how OTHER RPCs interleave — asyncio
+    scheduling noise can't change the injected sequence."""
+    a = ChaosPolicy(seed=3, default_error_rate=0.3)
+    b = ChaosPolicy(seed=3, default_error_rate=0.3)
+    seq_a = _drive(a, ["RpcX"] * 30 + ["RpcY"] * 30)
+    interleaved = ["RpcX", "RpcY"] * 30
+    seq_b = _drive(b, interleaved)
+    assert seq_a[:30] == [seq_b[i] for i in range(0, 60, 2)]  # RpcX decisions
+    assert seq_a[30:] == [seq_b[i] for i in range(1, 60, 2)]  # RpcY decisions
+
+
+def test_different_seed_different_sequence():
+    calls = ["Rpc"] * 200
+    a = ChaosPolicy(seed=1, default_error_rate=0.3)
+    b = ChaosPolicy(seed=2, default_error_rate=0.3)
+    assert _drive(a, calls) != _drive(b, calls)
+
+
+def test_knob_budget_outranks_rates_and_covers_family():
+    policy = ChaosPolicy(seed=0)  # zero rates: only the budget fires
+    policy.set_knob("fail_put_inputs", 2)
+    # family spans both planes: control-plane pump + input-plane equivalents
+    assert policy.decide("MapStartOrContinue")[1] is True
+    assert policy.decide("FunctionPutInputs")[1] is True
+    assert policy.decide("AttemptStart")[1] is False  # budget exhausted
+    assert policy.get_knob("fail_put_inputs") == 0
+    with pytest.raises(KeyError):
+        policy.set_knob("fail_everything", 1)
+
+
+def test_heartbeat_blackhole_drops_heartbeats_only():
+    policy = ChaosPolicy(seed=0)
+    assert policy.decide("ContainerHeartbeat")[1] is False
+    policy.start_heartbeat_blackhole(30.0)
+    assert policy.decide("ContainerHeartbeat")[1] is True
+    assert policy.decide("WorkerHeartbeat")[1] is True
+    assert policy.decide("FunctionGetInputs")[1] is False  # non-heartbeat unaffected
+    policy._blackhole_until = 0.0  # expire
+    assert policy.decide("ContainerHeartbeat")[1] is False
+
+
+def test_scheduled_events_fire_once_on_output_clock():
+    ev = ChaosEvent(kind="worker_preempt", after_outputs=10)
+    policy = ChaosPolicy(seed=0, events=[ev])
+    policy.note_outputs(9)
+    assert policy.pop_due_events() == []
+    policy.note_outputs(1)
+    assert policy.pop_due_events() == [ev]
+    assert policy.pop_due_events() == []  # one-shot
+
+
+def test_from_env_parses_rates(monkeypatch):
+    monkeypatch.setenv("MODAL_TPU_CHAOS", "1")
+    monkeypatch.setenv("MODAL_TPU_CHAOS_SEED", "42")
+    monkeypatch.setenv("MODAL_TPU_CHAOS_ERROR_RATE", "0.05")
+    monkeypatch.setenv("MODAL_TPU_CHAOS_RPCS", "FunctionGetInputs,BlobPut=0.2")
+    policy = ChaosPolicy.from_env()
+    assert policy is not None and policy.seed == 42
+    assert policy.error_rates == {"FunctionGetInputs": 0.05, "BlobPut": 0.2}
+    assert policy.default_error_rate == 0.0  # explicit RPC list: no global rate
+    monkeypatch.delenv("MODAL_TPU_CHAOS")
+    assert ChaosPolicy.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# retries: bound validation + full jitter (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_retries_rejects_inverted_delay_bounds():
+    from modal_tpu.exception import InvalidError
+    from modal_tpu.retries import Retries
+
+    with pytest.raises(InvalidError, match="max_delay.*initial_delay"):
+        Retries(max_retries=1, initial_delay=30, max_delay=5)
+    Retries(max_retries=1, initial_delay=5, max_delay=30)  # sane bounds fine
+
+
+def test_attempt_delay_full_jitter_stays_in_bounds():
+    import random
+
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.retries import RetryManager
+
+    mgr = RetryManager(
+        api_pb2.RetryPolicy(retries=5, backoff_coefficient=2.0, initial_delay_ms=1000, max_delay_ms=4000)
+    )
+    assert mgr.attempt_delay(0) == 0.0
+    assert mgr.attempt_delay(1) == 1.0
+    assert mgr.attempt_delay(3) == 4.0  # capped at max_delay
+    random.seed(0)
+    draws = [mgr.attempt_delay(3, jitter=True) for _ in range(200)]
+    assert all(0.0 <= d <= 4.0 for d in draws)
+    assert len({round(d, 6) for d in draws}) > 100, "full jitter must actually spread"
+
+
+# ---------------------------------------------------------------------------
+# 2. Scheduler reap / drain (hand-built state, no live containers)
+# ---------------------------------------------------------------------------
+
+
+def _mini_plane(tmp_path, retries: int = 1):
+    """ServerState + servicer + scheduler with one worker, one function, one
+    ACTIVE task that claimed one input."""
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.scheduler import Scheduler
+    from modal_tpu.server.services import ModalTPUServicer
+    from modal_tpu.server.state import (
+        FunctionCallState,
+        FunctionState,
+        InputState,
+        ServerState,
+        TaskState_,
+        WorkerState,
+    )
+
+    s = ServerState(str(tmp_path / "state"))
+    servicer = ModalTPUServicer(s)
+    scheduler = Scheduler(s, servicer)
+    servicer.scheduler = scheduler
+    definition = api_pb2.Function(retry_policy=api_pb2.RetryPolicy(retries=retries))
+    fn = FunctionState(function_id="fn-1", app_id="ap-1", tag="f", definition=definition)
+    s.functions["fn-1"] = fn
+    worker = WorkerState(worker_id="wk-1", num_chips=0)
+    s.workers["wk-1"] = worker
+    task = TaskState_(
+        task_id="ta-1", function_id="fn-1", app_id="ap-1",
+        state=api_pb2.TASK_STATE_ACTIVE, worker_id="wk-1", last_heartbeat=time.time(),
+    )
+    s.tasks["ta-1"] = task
+    worker.active_tasks.add("ta-1")
+    call = FunctionCallState(function_call_id="fc-1", function_id="fn-1")
+    call.num_inputs = 1
+    s.function_calls["fc-1"] = call
+    inp = InputState(
+        input_id="in-1", function_call_id="fc-1", idx=0,
+        input=api_pb2.FunctionInput(), status="claimed", claimed_by="ta-1",
+    )
+    s.inputs["in-1"] = inp
+    return s, servicer, scheduler, fn, task, inp, call
+
+
+async def test_reap_heartbeat_timeout_requeues_with_retries_remaining(tmp_path):
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server import scheduler as sched_mod
+
+    s, servicer, scheduler, fn, task, inp, call = _mini_plane(tmp_path, retries=1)
+    task.last_heartbeat = time.time() - sched_mod.TASK_HEARTBEAT_TIMEOUT - 1
+    await scheduler.reap_dead_tasks()
+    assert task.state == api_pb2.TASK_STATE_FAILED
+    assert task.finished_at
+    # retries remaining: the input goes back to pending with budget consumed
+    assert inp.status == "pending" and inp.retry_count == 1
+    assert inp.claimed_by == "" and "in-1" in fn.pending
+    assert not call.outputs, "no failure output while a retry is owed"
+
+
+async def test_reap_heartbeat_timeout_fails_fast_when_retries_exhausted(tmp_path):
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server import scheduler as sched_mod
+
+    s, servicer, scheduler, fn, task, inp, call = _mini_plane(tmp_path, retries=0)
+    task.last_heartbeat = time.time() - sched_mod.TASK_HEARTBEAT_TIMEOUT - 1
+    await scheduler.reap_dead_tasks()
+    # retries exhausted: the client gets a terminal INTERNAL_FAILURE output
+    # instead of hanging on a heartbeat-dead container
+    assert inp.status == "done"
+    assert len(call.outputs) == 1
+    out = call.outputs[0]
+    assert out.result.status == api_pb2.GENERIC_STATUS_INTERNAL_FAILURE
+    assert "heartbeat timeout" in out.result.exception
+
+
+async def test_reap_is_idempotent(tmp_path):
+    from modal_tpu.server import scheduler as sched_mod
+
+    s, servicer, scheduler, fn, task, inp, call = _mini_plane(tmp_path, retries=0)
+    task.last_heartbeat = time.time() - sched_mod.TASK_HEARTBEAT_TIMEOUT - 1
+    await scheduler.reap_dead_tasks()
+    await scheduler.reap_dead_tasks()  # finished task must not double-fail
+    assert len(call.outputs) == 1
+
+
+async def test_drain_worker_blocks_placement_and_requeues_for_free(tmp_path):
+    from modal_tpu.proto import api_pb2
+
+    s, servicer, scheduler, fn, task, inp, call = _mini_plane(tmp_path, retries=0)
+    worker = s.workers["wk-1"]
+    await scheduler.drain_worker("wk-1", grace_s=5.0)
+    assert worker.draining and worker.drain_deadline > time.time()
+    assert task.preempted and task.terminate
+    # a draining host takes no new placements
+    placement = api_pb2.SchedulerPlacement()
+    assert scheduler._pick_worker(0, placement, None) is None
+    # the worker got the graceful preempt-stop event
+    ev = worker.events.get_nowait()
+    assert ev.stop.task_id == "ta-1" and ev.stop.preempt and ev.stop.grace_s == 5.0
+    # container reports in (TERMINATED after drain): inputs requeue WITHOUT
+    # consuming the retry budget even though retries=0
+    ctx = type("Ctx", (), {"abort": None})()
+    await servicer.TaskResult(
+        api_pb2.TaskResultRequest(
+            task_id="ta-1",
+            result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_TERMINATED),
+        ),
+        ctx,
+    )
+    assert task.state == api_pb2.TASK_STATE_PREEMPTED
+    assert inp.status == "pending" and inp.retry_count == 0
+    assert "in-1" in fn.pending and not call.outputs
+
+
+async def test_drain_deadline_force_reaps_unreported_tasks(tmp_path):
+    from modal_tpu.proto import api_pb2
+
+    s, servicer, scheduler, fn, task, inp, call = _mini_plane(tmp_path, retries=0)
+    worker = s.workers["wk-1"]
+    await scheduler.drain_worker("wk-1", grace_s=0.0)
+    worker.drain_deadline = time.time() - 1  # deadline passed, task never reported
+    await scheduler.reap_dead_tasks()
+    assert task.state == api_pb2.TASK_STATE_PREEMPTED and task.finished_at
+    assert inp.status == "pending" and inp.retry_count == 0, "preemption requeue is free"
+    # fully-drained worker leaves the registry so a replacement registers clean
+    assert "wk-1" not in s.workers
+
+
+async def test_resume_token_survives_requeue_and_redelivery(tmp_path):
+    """ContainerCheckpoint records the token; the requeued input is
+    redelivered with it (FunctionGetInputs item.resume_token)."""
+    from modal_tpu.proto import api_pb2
+
+    s, servicer, scheduler, fn, task, inp, call = _mini_plane(tmp_path, retries=0)
+    ctx = type("Ctx", (), {"abort": None})()
+    await servicer.ContainerCheckpoint(
+        api_pb2.ContainerCheckpointRequest(
+            task_id="ta-1", input_id="in-1", resume_token="step:37"
+        ),
+        ctx,
+    )
+    assert inp.resume_token == "step:37"
+    await scheduler.drain_worker("wk-1", grace_s=5.0)
+    await servicer.TaskResult(
+        api_pb2.TaskResultRequest(
+            task_id="ta-1",
+            result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_TERMINATED),
+        ),
+        ctx,
+    )
+    assert inp.status == "pending" and inp.resume_token == "step:37"
+
+
+# ---------------------------------------------------------------------------
+# 3. End-to-end preemption: drain + checkpoint flush + resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def two_worker_supervisor(tmp_path, monkeypatch):
+    """Like the `supervisor` fixture but with a second host, so a preempted
+    worker's inputs have somewhere to resume."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.chaos import ChaosPolicy
+    from modal_tpu.client import _Client
+    from modal_tpu.server.supervisor import LocalSupervisor
+
+    monkeypatch.setenv("MODAL_TPU_STATE_DIR", str(tmp_path / "state"))
+    sup = LocalSupervisor(
+        num_workers=2,
+        state_dir=str(tmp_path / "state"),
+        worker_chips=8,
+        worker_tpu_type="local-sim",
+        chaos=ChaosPolicy(seed=0),
+    )
+    synchronizer.run(sup.start())
+    monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{sup.port}")
+    _Client.set_env_client(None)
+    try:
+        yield sup
+    finally:
+        env_client = _Client._client_from_env
+        if env_client is not None and not env_client._closed:
+            env_client._close()
+        _Client.set_env_client(None)
+        synchronizer.run(sup.stop())
+
+
+def _counting_work(marker_path, total_steps):
+    """Progress loop that records its resume point: a preempted attempt must
+    NOT restart from zero."""
+    import time as _t
+
+    import modal_tpu
+
+    start = int(modal_tpu.resume_token() or 0)
+    with open(marker_path, "a") as fh:
+        fh.write(f"start={start}\n")
+    for step in range(start, total_steps):
+        modal_tpu.set_resume_token(str(step))
+        _t.sleep(0.25)
+    return start
+
+
+def test_preempted_function_resumes_from_checkpoint(two_worker_supervisor, tmp_path):
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+
+    sup = two_worker_supervisor
+    marker = str(tmp_path / "progress.txt")
+    app = modal_tpu.App("preempt-resume")
+    f = app.function(serialized=True)(_counting_work)
+    with app.run():
+        call = f.spawn(marker, 120)  # ~30s of work: plenty to preempt into
+        deadline = time.time() + 30
+        # wait until the container has made real progress (>= 8 steps)
+        while time.time() < deadline:
+            tokens = [
+                inp.resume_token for inp in sup.state.inputs.values() if inp.resume_token
+            ]
+            started = os.path.exists(marker)
+            if started and time.time() > deadline - 24:
+                break
+            time.sleep(0.25)
+        assert os.path.exists(marker), "function never started"
+        time.sleep(3.0)  # let the progress counter advance
+        synchronizer.run(sup.preempt_worker(0, grace_s=8.0))
+        # the retried attempt must resume: second start line > 0
+        deadline = time.time() + 60
+        starts = []
+        while time.time() < deadline:
+            with open(marker) as fh:
+                starts = [int(line.split("=")[1]) for line in fh if line.startswith("start=")]
+            if len(starts) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(starts) >= 2, f"retried attempt never started (starts={starts})"
+        assert starts[0] == 0
+        assert starts[1] > 0, "resume token lost: retry restarted from zero"
+        call.cancel()
+
+
+def test_preempt_requeue_does_not_consume_user_retries(two_worker_supervisor, tmp_path):
+    """A worker preemption is system-initiated: the input must complete even
+    with retries=0 (the free-requeue path, not the user retry budget)."""
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+
+    sup = two_worker_supervisor
+    marker = str(tmp_path / "attempts.txt")
+    app = modal_tpu.App("preempt-free-retry")
+
+    def slow_echo(path, x):
+        import time as _t
+
+        with open(path, "a") as fh:
+            fh.write("attempt\n")
+        _t.sleep(4.0)
+        return x * 2
+
+    f = app.function(serialized=True, retries=0)(slow_echo)
+    with app.run():
+        call = f.spawn(marker, 21)
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(marker):
+            time.sleep(0.25)
+        assert os.path.exists(marker), "function never started"
+        synchronizer.run(sup.preempt_worker(0, grace_s=5.0))
+        assert call.get(timeout=90) == 42
+    with open(marker) as fh:
+        attempts = fh.read().count("attempt")
+    assert attempts >= 2, "the preempted attempt should have been retried"
