@@ -585,6 +585,16 @@ def child_main(mode: str) -> None:
         except Exception as exc:  # noqa: BLE001 — A/B is additive, never fatal
             result["snap_bench_error"] = repr(exc)[:200]
 
+    # observability roll-up: the supervisor ran in-process, so the registry
+    # holds the whole run's control-plane picture (RPC volume + latency
+    # percentiles, placements, blob bytes, retries) — snapshotted into the
+    # one-line result so perf regressions come with their metrics attached
+    from modal_tpu.observability.metrics import REGISTRY as _METRICS_REGISTRY
+
+    metrics_summary = _METRICS_REGISTRY.bench_summary()
+    if metrics_summary:
+        result["metrics"] = metrics_summary
+
     synchronizer.run(sup.stop())
     result["bench_total_s"] = round(time.perf_counter() - t_child0, 2)
     print("BENCH_RESULT " + json.dumps(result), flush=True)

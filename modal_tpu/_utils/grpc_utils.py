@@ -80,6 +80,13 @@ class CircuitBreaker:
                 f"circuit breaker OPEN for {self.method} after "
                 f"{self.consecutive_failures} consecutive failures ({self.cooldown_s}s cooldown)"
             )
+            from ..observability import tracing
+            from ..observability.catalog import CIRCUIT_BREAKER_OPENS
+
+            CIRCUIT_BREAKER_OPENS.inc(method=self.method.rsplit("/", 1)[-1])
+            tracing.add_event(
+                "circuit_breaker.open", method=self.method, cooldown_s=self.cooldown_s
+            )
 
 
 _breakers: dict[str, CircuitBreaker] = {}
@@ -124,7 +131,12 @@ def create_channel(server_url: str, metadata: Optional[dict[str, str]] = None) -
         ("grpc.keepalive_time_ms", 30_000),
         ("grpc.keepalive_timeout_ms", 10_000),
     ]
-    interceptors = [_MetadataInterceptorUnary(metadata or {}), _MetadataInterceptorStream(metadata or {})]
+    interceptors = [
+        _MetadataInterceptorUnary(metadata or {}),
+        _MetadataInterceptorStream(metadata or {}),
+        _TracingInterceptorUnary(),
+        _TracingInterceptorStream(),
+    ]
     if o.scheme in ("grpc", "http", ""):
         target = o.netloc or server_url
         return grpc.aio.insecure_channel(target, options=options, interceptors=interceptors)
@@ -153,6 +165,54 @@ class _MetadataInterceptorStream(grpc.aio.UnaryStreamClientInterceptor):
     async def intercept_unary_stream(self, continuation, client_call_details, request):
         details = _with_metadata(client_call_details, self._metadata)
         return await continuation(details, request)
+
+
+class _TracingInterceptorUnary(grpc.aio.UnaryUnaryClientInterceptor):
+    """Distributed-tracing client interceptor: when the calling task is inside
+    a span (e.g. the `function.call` root a `.remote()` opens), propagate its
+    context as gRPC metadata, record a client RPC span, and observe
+    client-side latency. Untraced calls still feed the latency metric."""
+
+    async def intercept_unary_unary(self, continuation, client_call_details, request):
+        from ..observability import tracing
+        from ..observability.catalog import CLIENT_RPC_LATENCY
+
+        method = client_call_details.method
+        if isinstance(method, bytes):
+            method = method.decode("utf-8", "replace")
+        short = method.rsplit("/", 1)[-1]
+        ctx = tracing.current_context()
+        t0 = time.perf_counter()
+        try:
+            # `await continuation(...)` only CONSTRUCTS the call — awaiting
+            # the call is what runs the RPC, so the response must be awaited
+            # in here or the latency metric/span would measure ~0 for every
+            # call. Returning the response (not the call) is supported: the
+            # interceptor framework wraps it in UnaryUnaryCallResponse.
+            if ctx is not None:
+                details = _with_metadata(client_call_details, tracing.context_metadata(ctx))
+                with tracing.span(f"rpc.client.{short}", parent=ctx):
+                    call = await continuation(details, request)
+                    return await call
+            call = await continuation(client_call_details, request)
+            return await call
+        finally:
+            CLIENT_RPC_LATENCY.observe(time.perf_counter() - t0, method=short)
+
+
+class _TracingInterceptorStream(grpc.aio.UnaryStreamClientInterceptor):
+    """Stream RPCs only propagate context (no span: streams outlive the call
+    site, and a poll's duration measures patience, not performance)."""
+
+    async def intercept_unary_stream(self, continuation, client_call_details, request):
+        from ..observability import tracing
+
+        ctx = tracing.current_context()
+        if ctx is not None:
+            client_call_details = _with_metadata(
+                client_call_details, tracing.context_metadata(ctx)
+            )
+        return await continuation(client_call_details, request)
 
 
 def _with_metadata(details: grpc.aio.ClientCallDetails, extra: list[tuple[str, str]]) -> grpc.aio.ClientCallDetails:
@@ -252,6 +312,17 @@ async def retry_transient_errors(
                 raise
             n_retries += 1
             logger.debug(f"retrying {getattr(fn, '_method', fn)} after {code} (attempt {n_retries})")
+            # retries become span events + a counter: a chaos soak's tail
+            # latency is then attributable to specific injected faults
+            from ..observability import tracing
+            from ..observability.catalog import CLIENT_RPC_RETRIES
+
+            _method_label = getattr(fn, "_method", "")
+            if isinstance(_method_label, bytes):
+                _method_label = _method_label.decode("utf-8", "replace")
+            _method_label = str(_method_label).rsplit("/", 1)[-1]
+            CLIENT_RPC_RETRIES.inc(method=_method_label)
+            tracing.add_event("rpc.retry", method=_method_label, code=code.name, attempt=n_retries)
             # equal jitter: sleep in [delay/2, delay] so a fleet of clients
             # recovering from the same outage doesn't retry in lockstep
             await asyncio.sleep(delay * (0.5 + random.random() * 0.5) if jitter else delay)

@@ -165,6 +165,9 @@ class ChaosPolicy:
             roll = stream.random()
             if roll < self.latency_rate:
                 delay = (self.latency_ms + stream.random() * self.latency_jitter_ms) / 1000.0
+                from .observability.catalog import CHAOS_INJECTIONS
+
+                CHAOS_INJECTIONS.inc(rpc=rpc, kind="latency")
         # budgeted knobs outrank rates and are NOT drawn from the stream
         # (hand-set counters must not perturb seeded reproducibility)
         for knob, rpcs in KNOB_RPCS.items():
@@ -186,6 +189,14 @@ class ChaosPolicy:
         self.injected[rpc] = self.injected.get(rpc, 0) + 1
         self._total_injected += 1
         self.fault_log.append(f"{rpc}#{call_index}")
+        # soak failures must be attributable to the exact injected fault:
+        # every injection is a per-RPC counter sample AND (for traced calls)
+        # an event on the current server span (observability satellite)
+        from .observability import tracing
+        from .observability.catalog import CHAOS_INJECTIONS
+
+        CHAOS_INJECTIONS.inc(rpc=rpc, kind="error")
+        tracing.add_event("chaos.injected", rpc=rpc, call_index=call_index, why=why, seed=self.seed)
         logger.debug(f"chaos: injecting UNAVAILABLE into {rpc} call {call_index} ({why})")
 
     # -- injection helpers (one per transport) ------------------------------
@@ -233,6 +244,11 @@ class ChaosPolicy:
             if not ev.fired and self.outputs_seen >= ev.after_outputs:
                 ev.fired = True
                 due.append(ev)
+        if due:
+            from .observability.catalog import CHAOS_EVENTS
+
+            for ev in due:
+                CHAOS_EVENTS.inc(kind=ev.kind)
         return due
 
     # -- conftest knob surface ------------------------------------------------

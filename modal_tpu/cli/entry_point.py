@@ -352,6 +352,138 @@ def app_imports(task_id: str, top: int, state_dir: Optional[str]) -> None:
         click.echo(f"{event['duration_s']*1000:10.1f} ms  {event['module']}")
 
 
+@app_group.command("trace")
+@click.argument("needle")
+@click.option(
+    "--state-dir",
+    default=None,
+    help="Supervisor state dir (same meaning as `app imports --state-dir`): "
+    "spans are read from <state-dir>/traces, import details from "
+    "<state-dir>/tasks/<task-id>/imports.jsonl.",
+)
+@click.option("--last", default=1, help="Render only the N most recent matching traces.")
+def app_trace(needle: str, state_dir: Optional[str], last: int) -> None:
+    """Render the distributed-trace waterfall for an app / call / input /
+    task / trace id: where every input spent its time — client RPC, queue
+    wait, placement, worker launch, container boot + imports, user code.
+
+    NEEDLE matches a trace-id prefix or any span's app_id /
+    function_call_id / input_id / task_id attribute.
+    """
+    from ..config import config as _config
+    from ..observability import tracing
+
+    root = state_dir or _config["state_dir"]
+    if state_dir is not None:
+        store = os.path.join(state_dir, "traces")
+    else:
+        store = _config.get("trace_dir") or os.path.join(root, "traces")
+    traces = tracing.find_traces(store, needle)
+    if not traces:
+        raise click.ClickException(
+            f"no trace matching {needle!r} under {store} (is tracing on? MODAL_TPU_TRACE=1; "
+            "pass --state-dir if the supervisor uses a different state dir)"
+        )
+    ordered = sorted(traces.items(), key=lambda kv: min(s["start"] for s in kv[1]))
+    for trace_id, spans in ordered[-max(1, last):]:
+        _render_waterfall(trace_id, spans, root)
+
+
+def _render_waterfall(trace_id: str, spans: list, state_dir: str) -> None:
+    """One trace as an indented waterfall: offset from trace start, duration,
+    and a proportional bar. Boot spans with an import trace on disk expand
+    into their slowest modules (the existing `app imports` data)."""
+    from ..runtime.telemetry import summarize
+
+    spans = sorted(spans, key=lambda s: (s["start"], s.get("end", 0.0)))
+    t0 = min(s["start"] for s in spans)
+    t_end = max((s.get("end") or s["start"]) for s in spans)
+    total = max(t_end - t0, 1e-9)
+    by_id = {s["span_id"]: s for s in spans}
+
+    def _depth(s: dict) -> int:
+        d, seen = 0, set()
+        while s.get("parent_id") and s["parent_id"] in by_id and s["parent_id"] not in seen:
+            seen.add(s["parent_id"])
+            s = by_id[s["parent_id"]]
+            d += 1
+        return d
+
+    width = 28
+    click.echo(f"trace {trace_id}  ({total*1000:.1f} ms, {len(spans)} spans)")
+    for s in spans:
+        start_ms = (s["start"] - t0) * 1000
+        dur_ms = max(0.0, ((s.get("end") or s["start"]) - s["start"]) * 1000)
+        lo = int(width * (s["start"] - t0) / total)
+        hi = max(lo + 1, int(width * ((s.get("end") or s["start"]) - t0) / total))
+        bar = " " * lo + "▇" * (hi - lo) + " " * (width - hi)
+        indent = "  " * _depth(s)
+        flag = " !" if s.get("status") == "error" else ""
+        name = f"{indent}{s['name']}"
+        click.echo(f"  {name:<42.42} {start_ms:>9.1f}ms +{dur_ms:>9.1f}ms |{bar}|{flag}")
+        for ev in s.get("events") or []:
+            click.echo(f"  {indent}  · {ev.get('name')} {_fmt_event_attrs(ev)}")
+        attrs = s.get("attrs") or {}
+        if s["name"] == "container.imports" and attrs.get("task_id") and attrs.get("import_trace"):
+            imports_path = os.path.join(state_dir, "tasks", attrs["task_id"], "imports.jsonl")
+            if os.path.exists(imports_path):
+                for event in summarize(imports_path, top=5):
+                    click.echo(
+                        f"  {indent}    {event['duration_s']*1000:8.1f} ms  import {event['module']}"
+                    )
+
+
+def _fmt_event_attrs(ev: dict) -> str:
+    parts = [f"{k}={v}" for k, v in ev.items() if k not in ("name", "t")]
+    return " ".join(parts)
+
+
+@cli.command("metrics")
+@click.option("--url", default=None, help="Scrape URL (default: the local supervisor's).")
+@click.option("--state-dir", default=None, help="Supervisor state dir (metrics_url discovery).")
+@click.option("--json", "as_json", is_flag=True, help="Dump the registry snapshot as JSON.")
+def metrics_cmd(url: Optional[str], state_dir: Optional[str], as_json: bool) -> None:
+    """Dump the metrics registry of the running supervisor (Prometheus text
+    from its GET /metrics endpoint; --json for a structured snapshot)."""
+    import urllib.error
+    import urllib.request
+
+    from ..config import config as _config
+
+    if url is None:
+        root = state_dir or _config["state_dir"]
+        url_file = os.path.join(root, "observability", "metrics_url")
+        if not os.path.exists(url_file):
+            raise click.ClickException(
+                f"no supervisor metrics endpoint recorded at {url_file} "
+                "(is a supervisor running? pass --url to scrape one directly)"
+            )
+        with open(url_file) as f:
+            url = f.read().strip()
+    try:
+        text = urllib.request.urlopen(url, timeout=5).read().decode()
+    except (urllib.error.URLError, OSError) as exc:
+        raise click.ClickException(f"scrape of {url} failed: {exc}")
+    if as_json:
+        click.echo(json.dumps(_parse_prometheus(text), indent=2, sort_keys=True))
+    else:
+        click.echo(text, nl=False)
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Minimal exposition-format parse for --json (sample name+labels → value)."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_labels, _, value = line.rpartition(" ")
+        try:
+            out[name_labels] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
 @app_group.command("history")
 @click.argument("app_id")
 def app_history(app_id: str) -> None:

@@ -134,6 +134,12 @@ _SETTINGS: dict[str, _Setting] = {
     # Per-module import tracing in containers (cold-start attribution;
     # events land in <task_dir>/imports.jsonl — runtime/telemetry.py).
     "import_trace": _Setting(False, _to_boolean),
+    # Distributed tracing (observability/tracing.py): span JSONL sink under
+    # <state_dir>/traces (or trace_dir when set); rendered by
+    # `modal_tpu app trace`. On by default — spans are cheap and the sink
+    # only exists where a supervisor runs.
+    "trace": _Setting(True, _to_boolean),
+    "trace_dir": _Setting(""),
 }
 
 

@@ -447,15 +447,24 @@ class _Function(_Object, type_prefix="fu"):
 
     @live_method
     async def _call_function(self, args: tuple, kwargs: dict) -> Any:
-        if self._use_input_plane():
-            # region-local data plane: AttemptStart/Await/Retry with JWT
-            # auth (reference _functions.py:394)
-            ip_invocation = await _InputPlaneInvocation.create(self, args, kwargs, client=self.client)
-            return await ip_invocation.run_function()
-        invocation = await _Invocation.create(
-            self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
-        )
-        return await invocation.run_function()
+        # root span of the distributed trace: everything this call touches —
+        # client RPCs, queue wait, placement, container boot, user execution —
+        # stitches under this trace id (observability/tracing.py)
+        from .observability import tracing
+
+        with tracing.span(
+            "function.call",
+            attrs={"function_id": self.object_id or "", "function": self.tag},
+        ):
+            if self._use_input_plane():
+                # region-local data plane: AttemptStart/Await/Retry with JWT
+                # auth (reference _functions.py:394)
+                ip_invocation = await _InputPlaneInvocation.create(self, args, kwargs, client=self.client)
+                return await ip_invocation.run_function()
+            invocation = await _Invocation.create(
+                self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
+            )
+            return await invocation.run_function()
 
     @live_method_gen
     async def _call_function_generator(self, args: tuple, kwargs: dict) -> AsyncGenerator[Any, None]:

@@ -1,0 +1,27 @@
+"""Observability: distributed tracing + process-wide metrics registry.
+
+Two dependency-free pillars (ISSUE 2):
+
+- ``tracing``: a lightweight span model (trace_id/span_id/parent, name,
+  start/end, attrs, events) with a JSONL sink under the supervisor's state
+  dir. Context propagates client→server via gRPC metadata (interceptors in
+  `_utils/grpc_utils.py` / `proto/rpc.py`), server→container via
+  `FunctionGetInputsItem.trace_context` and `MODAL_TPU_TRACE_*` env, so one
+  `.remote()` call yields ONE stitched trace: client RPC → scheduler
+  placement → worker launch → container boot/imports → user execution.
+
+- ``metrics``: counters/gauges/histograms with bounded label sets,
+  instrumented across RPC latency, scheduler queue depth/placement, worker
+  lifecycle, blob bytes, and chaos injections; exported as Prometheus text
+  at ``GET /metrics`` on the supervisor's blob server.
+
+``catalog`` is the single declarative list of every metric family — the
+instrumentation-parity test (tests/test_api_parity.py) checks it against the
+RPCs `server/services.py` actually implements.
+"""
+
+from . import metrics, tracing
+from .catalog import METRIC_CATALOG, instrumented_rpc_names
+from .metrics import REGISTRY
+
+__all__ = ["tracing", "metrics", "REGISTRY", "METRIC_CATALOG", "instrumented_rpc_names"]

@@ -1,0 +1,140 @@
+"""The metric catalog: every metric family the stack emits, in one place.
+
+Importing this module registers every family on the process-wide REGISTRY,
+so ``GET /metrics`` exposes the full catalog (HELP/TYPE headers) from the
+first scrape, before any samples land. Instrumentation sites import their
+instruments from here — a metric that isn't in the catalog doesn't exist.
+
+``instrumented_rpc_names()`` backs the instrumentation-parity check in
+tests/test_api_parity.py: every RPC `server/services.py` implements must be
+covered by the RPC latency/count instruments. Coverage comes from
+`proto/rpc.py` wrapping every *registered* RPC handler at build time, so the
+set of instrumented RPCs is exactly the RPC registry — an RPC implemented on
+the servicer but missing from the registry would be silently unreachable AND
+uninstrumented, and the parity test fails it loudly.
+"""
+
+from __future__ import annotations
+
+from .metrics import REGISTRY
+
+# -- RPC plane (server side; instrumented in proto/rpc.py) --------------------
+
+RPC_LATENCY = REGISTRY.histogram(
+    "modal_tpu_rpc_latency_seconds",
+    "Server-side RPC handler latency (unary methods; every gRPC plane).",
+    ("method",),
+)
+RPC_TOTAL = REGISTRY.counter(
+    "modal_tpu_rpc_total",
+    "Server-side RPC calls by method and outcome (ok|error); streams included.",
+    ("method", "code"),
+)
+
+# -- RPC plane (client side; instrumented in _utils/grpc_utils.py) ------------
+
+CLIENT_RPC_LATENCY = REGISTRY.histogram(
+    "modal_tpu_client_rpc_latency_seconds",
+    "Client-observed unary RPC latency (includes transport + server).",
+    ("method",),
+)
+CLIENT_RPC_RETRIES = REGISTRY.counter(
+    "modal_tpu_client_rpc_retries_total",
+    "Transient-error retries performed by retry_transient_errors.",
+    ("method",),
+)
+CIRCUIT_BREAKER_OPENS = REGISTRY.counter(
+    "modal_tpu_circuit_breaker_opens_total",
+    "Times a per-method client circuit breaker opened.",
+    ("method",),
+)
+
+# -- scheduler ----------------------------------------------------------------
+
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "modal_tpu_scheduler_queue_depth",
+    "Pending (unclaimed) inputs across all functions, sampled per tick.",
+)
+SCHED_PLACEMENT_LATENCY = REGISTRY.histogram(
+    "modal_tpu_scheduler_placement_latency_seconds",
+    "Wall time to place one task/gang (worker pick + chip pin + assignment).",
+    ("kind",),
+)
+SCHED_TASKS_LAUNCHED = REGISTRY.counter(
+    "modal_tpu_scheduler_tasks_launched_total",
+    "Tasks handed to workers, by kind (task|gang_member|sandbox).",
+    ("kind",),
+)
+SCHED_TASKS_REAPED = REGISTRY.counter(
+    "modal_tpu_scheduler_tasks_reaped_total",
+    "Dead/stuck tasks force-reaped, by reason.",
+    ("reason",),
+)
+INPUT_QUEUE_WAIT = REGISTRY.histogram(
+    "modal_tpu_input_queue_wait_seconds",
+    "Enqueue-to-claim wait per input (the queue segment of E2E latency).",
+)
+
+# -- workers / tasks ----------------------------------------------------------
+
+WORKER_HEARTBEATS = REGISTRY.counter(
+    "modal_tpu_worker_heartbeats_total",
+    "Worker heartbeats received by the control plane.",
+)
+WORKER_PREEMPTIONS = REGISTRY.counter(
+    "modal_tpu_worker_preemptions_total",
+    "Worker drains entered (preemption notices honored by the scheduler).",
+)
+TASK_RESULTS = REGISTRY.counter(
+    "modal_tpu_task_results_total",
+    "Container final results, by GenericResult status name.",
+    ("status",),
+)
+IMAGE_BUILD_SECONDS = REGISTRY.histogram(
+    "modal_tpu_image_build_seconds",
+    "Image materialization wall time on the worker (cache hits are fast).",
+    buckets=(0.01, 0.1, 0.5, 1, 5, 15, 30, 60, 120, 300, 600),
+)
+
+# -- blob data plane ----------------------------------------------------------
+
+BLOB_BYTES = REGISTRY.counter(
+    "modal_tpu_blob_bytes_total",
+    "Blob HTTP payload bytes by direction (in=uploads, out=downloads).",
+    ("direction",),
+)
+BLOB_REQUESTS = REGISTRY.counter(
+    "modal_tpu_blob_requests_total",
+    "Blob HTTP requests by route and status class.",
+    ("route", "code"),
+)
+
+# -- chaos --------------------------------------------------------------------
+
+CHAOS_SEED = REGISTRY.gauge(
+    "modal_tpu_chaos_seed",
+    "Active chaos policy seed (soak failures attribute to the exact run).",
+)
+CHAOS_INJECTIONS = REGISTRY.counter(
+    "modal_tpu_chaos_injections_total",
+    "Chaos faults injected, by RPC/route and kind (error|latency).",
+    ("rpc", "kind"),
+)
+CHAOS_EVENTS = REGISTRY.counter(
+    "modal_tpu_chaos_events_total",
+    "Scheduled chaos lifecycle events fired (worker_kill|worker_preempt|heartbeat_blackhole).",
+    ("kind",),
+)
+
+
+METRIC_CATALOG: dict[str, str] = {m: REGISTRY.get(m).help for m in REGISTRY.names()}
+
+
+def instrumented_rpc_names() -> frozenset:
+    """Every RPC name covered by the server-side latency/count instruments:
+    proto/rpc.py wraps each registered handler, so coverage == the registry
+    (both the control/input planes' ModalTPU service and the worker's
+    TaskCommandRouter)."""
+    from ..proto.rpc import ROUTER_RPCS, RPCS
+
+    return frozenset(RPCS) | frozenset(ROUTER_RPCS)

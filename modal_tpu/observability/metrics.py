@@ -1,0 +1,294 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Dependency-free (no prometheus_client in the image); renders the Prometheus
+text exposition format for ``GET /metrics`` on the supervisor's blob server
+and a dict snapshot for ``bench.py`` / ``modal_tpu metrics --json``.
+
+Label discipline: every metric declares its label names up front, and the
+number of distinct label-value combinations per metric is bounded
+(MAX_SERIES); past the cap, samples collapse into a single ``__overflow__``
+series instead of growing without bound (a runaway label like input_id must
+not OOM the control plane). Values are plain floats guarded by one lock —
+all producers run on the supervisor's event loop or the client's synchronizer
+thread, so contention is negligible.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Optional
+
+MAX_SERIES = 256
+OVERFLOW = "__overflow__"
+
+# latency-oriented default buckets (seconds)
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, ...], object] = {}
+
+    def _key(self, labels: dict) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} expects labels {self.labelnames}, got {sorted(labels)}"
+            )
+        key = tuple(str(labels[n]) for n in self.labelnames)
+        if key not in self._series and len(self._series) >= MAX_SERIES:
+            return tuple(OVERFLOW for _ in self.labelnames)
+        return key
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+    def _fmt_labels(self, key: tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{n}="{_escape(v)}"' for n, v in zip(self.labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> list[str]:
+        raise NotImplementedError
+
+    def snapshot(self) -> dict:
+        raise NotImplementedError
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return float(self._series.get(tuple(str(labels[n]) for n in self.labelnames), 0.0))
+
+    def total(self) -> float:
+        with self._lock:
+            return float(sum(self._series.values()))
+
+    def render(self) -> list[str]:
+        with self._lock:
+            return [
+                f"{self.name}{self._fmt_labels(key)} {value}"
+                for key, value in sorted(self._series.items())
+            ]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {",".join(k) if k else "": v for k, v in self._series.items()}
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return float(self._series.get(tuple(str(labels[n]) for n in self.labelnames), 0.0))
+
+    render = Counter.render
+    snapshot = Counter.snapshot
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: tuple[str, ...],
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = _HistSeries(len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    series.counts[i] += 1
+                    break
+            series.sum += value
+            series.count += 1
+
+    def count_total(self) -> int:
+        with self._lock:
+            return sum(s.count for s in self._series.values())
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile across ALL series (bench summary)."""
+        with self._lock:
+            total = sum(s.count for s in self._series.values())
+            if total == 0:
+                return None
+            merged = [0] * len(self.buckets)
+            for s in self._series.values():
+                for i, c in enumerate(s.counts):
+                    merged[i] += c
+            target = q * total
+            seen = 0.0
+            for i, c in enumerate(merged):
+                seen += c
+                if seen >= target:
+                    return self.buckets[i]
+            return self.buckets[-1]
+
+    def render(self) -> list[str]:
+        lines = []
+        with self._lock:
+            for key, series in sorted(self._series.items()):
+                cumulative = 0
+                for bound, c in zip(self.buckets, series.counts):
+                    cumulative += c
+                    le = 'le="%s"' % bound
+                    lines.append(f"{self.name}_bucket{self._fmt_labels(key, le)} {cumulative}")
+                inf = 'le="+Inf"'
+                lines.append(f"{self.name}_bucket{self._fmt_labels(key, inf)} {series.count}")
+                lines.append(f"{self.name}_sum{self._fmt_labels(key)} {round(series.sum, 6)}")
+                lines.append(f"{self.name}_count{self._fmt_labels(key)} {series.count}")
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                ",".join(k) if k else "": {"count": s.count, "sum": round(s.sum, 6)}
+                for k, s in self._series.items()
+            }
+
+
+class MetricsRegistry:
+    """Homes every metric family; definition is idempotent by name so modules
+    can declare their instruments at import time in any order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self.started_at = time.time()
+
+    def _define(self, cls, name: str, help: str, labelnames: tuple[str, ...], **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.labelnames != tuple(labelnames):
+                    raise ValueError(f"metric {name} redefined with a different shape")
+                return existing
+            metric = cls(name, help, tuple(labelnames), **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "", labelnames: tuple[str, ...] = ()) -> Counter:
+        return self._define(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: tuple[str, ...] = ()) -> Gauge:
+        return self._define(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: tuple[str, ...] = (),
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._define(Histogram, name, help, labelnames, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self) -> None:
+        """Zero every series (tests); families stay registered."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()
+
+    def render_prometheus(self) -> str:
+        """The full exposition: every registered family renders its HELP/TYPE
+        header even with no samples yet, so scrapers (and the parity test)
+        see the complete catalog from the first scrape."""
+        with self._lock:
+            metrics = [self._metrics[name] for name in sorted(self._metrics)]
+        out: list[str] = []
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            metrics = dict(self._metrics)
+        return {
+            name: {"type": m.kind, "help": m.help, "series": m.snapshot()}
+            for name, m in sorted(metrics.items())
+        }
+
+    def bench_summary(self) -> dict:
+        """Compact roll-up stitched into bench.py's one-line JSON result."""
+        summary: dict = {}
+
+        def _tot(name: str, key: str) -> None:
+            m = self.get(name)
+            if isinstance(m, (Counter, Gauge)) and m.total():
+                summary[key] = round(m.total(), 2)
+
+        lat = self.get("modal_tpu_rpc_latency_seconds")
+        if isinstance(lat, Histogram) and lat.count_total():
+            summary["rpc_count"] = lat.count_total()
+            summary["rpc_latency_p50_s"] = lat.quantile(0.5)
+            summary["rpc_latency_p99_s"] = lat.quantile(0.99)
+        _tot("modal_tpu_scheduler_tasks_launched_total", "tasks_launched")
+        _tot("modal_tpu_blob_bytes_total", "blob_bytes")
+        _tot("modal_tpu_client_rpc_retries_total", "client_rpc_retries")
+        _tot("modal_tpu_chaos_injections_total", "chaos_injections")
+        _tot("modal_tpu_worker_preemptions_total", "worker_preemptions")
+        return summary
+
+
+REGISTRY = MetricsRegistry()

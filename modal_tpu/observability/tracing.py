@@ -1,0 +1,401 @@
+"""Distributed tracing: span model, context propagation, JSONL sink.
+
+No third-party deps (no opentelemetry in the image) — the span model is the
+minimal subset every tracing UI understands: trace_id/span_id/parent_id,
+name, start/end wall-clock seconds, string attrs, timestamped events.
+
+Propagation path for one `.remote()` call:
+
+    client `function.call` root span
+      → x-modal-tpu-trace-id / x-modal-tpu-span-id gRPC metadata
+        (client interceptor, _utils/grpc_utils.py)
+      → server handler span (proto/rpc.py instrumented handler)
+      → InputState.trace_context (services._enqueue_input)
+      → FunctionGetInputsItem.trace_context → container io_manager
+      → MODAL_TPU_TRACE_CONTEXT / MODAL_TPU_TRACE_T0 env (scheduler →
+        worker → container boot spans)
+
+Sink: one ``spans-<pid>.jsonl`` per process under the trace dir (the
+supervisor's ``<state_dir>/traces``; containers inherit it via
+``MODAL_TPU_TRACE_DIR``). Appends are line-atomic, so many processes can
+share the directory; `modal_tpu app trace` globs all of them.
+
+When no sink is configured, spans still *propagate* (ids are generated and
+carried on the wire — a remote process with a sink can record its half) but
+nothing is written locally: the hot path stays allocation-cheap.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+TRACE_ID_METADATA_KEY = "x-modal-tpu-trace-id"
+SPAN_ID_METADATA_KEY = "x-modal-tpu-span-id"
+TRACE_DIR_ENV = "MODAL_TPU_TRACE_DIR"
+TRACE_CONTEXT_ENV = "MODAL_TPU_TRACE_CONTEXT"
+TRACE_T0_ENV = "MODAL_TPU_TRACE_T0"
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str
+    span_id: str
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str
+    name: str
+    start: float
+    end: float = 0.0
+    attrs: dict[str, Any] = field(default_factory=dict)
+    events: list[dict] = field(default_factory=list)
+    status: str = "ok"
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        self.events.append({"name": name, "t": time.time(), **attrs})
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "status": self.status,
+            "attrs": self.attrs,
+            "events": self.events,
+        }
+
+
+# -- sink ---------------------------------------------------------------------
+
+_sink_lock = threading.Lock()
+_sink_file = None
+_sink_dir: Optional[str] = None
+
+
+def configure(trace_dir: str) -> None:
+    """Point the process-wide sink at `trace_dir` (created if missing).
+    Deliberately does NOT touch os.environ: MODAL_TPU_TRACE_DIR doubles as
+    the operator's config override (config.py `trace_dir`), so exporting it
+    here would pin every later supervisor in this process to the first
+    sink. The worker passes the dir to container processes explicitly."""
+    global _sink_file, _sink_dir
+    with _sink_lock:
+        if _sink_dir == trace_dir and _sink_file is not None:
+            return
+        if _sink_file is not None:
+            try:
+                _sink_file.close()
+            except OSError:
+                pass
+            _sink_file = None
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(trace_dir, f"spans-{os.getpid()}.jsonl")
+        _sink_file = open(path, "a", buffering=1)
+        _sink_dir = trace_dir
+
+
+def maybe_configure_from_env() -> None:
+    """Container-side hook: adopt the trace dir the worker exported."""
+    trace_dir = os.environ.get(TRACE_DIR_ENV)
+    if trace_dir:
+        try:
+            configure(trace_dir)
+        except OSError:
+            pass
+
+
+def enabled() -> bool:
+    return _sink_file is not None
+
+
+def trace_dir() -> Optional[str]:
+    return _sink_dir
+
+
+def _shutdown() -> None:
+    global _sink_file
+    with _sink_lock:
+        if _sink_file is not None:
+            try:
+                _sink_file.flush()
+                _sink_file.close()
+            except OSError:
+                pass
+            _sink_file = None
+
+
+atexit.register(_shutdown)
+
+
+def _write(span: Span) -> None:
+    if _sink_file is None:
+        return
+    try:
+        line = json.dumps(span.to_dict(), default=str)
+    except (TypeError, ValueError):
+        return
+    with _sink_lock:
+        if _sink_file is not None:
+            try:
+                _sink_file.write(line + "\n")
+            except (OSError, ValueError):
+                pass
+
+
+# -- context ------------------------------------------------------------------
+
+_current_span: ContextVar[Optional[Span]] = ContextVar("modal_tpu_span", default=None)
+# context extracted from the wire (server side) with no local span open yet
+_remote_context: ContextVar[Optional[SpanContext]] = ContextVar(
+    "modal_tpu_remote_span_ctx", default=None
+)
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def current_context() -> Optional[SpanContext]:
+    span = _current_span.get()
+    if span is not None:
+        return span.context
+    return _remote_context.get()
+
+
+def add_event(name: str, **attrs: Any) -> None:
+    """Attach an event to the current span, if any (retries, circuit-breaker
+    opens, chaos injections). No-op outside a span — callers never gate."""
+    span = _current_span.get()
+    if span is not None:
+        span.add_event(name, **attrs)
+
+
+def set_attr(key: str, value: Any) -> None:
+    span = _current_span.get()
+    if span is not None:
+        span.set_attr(key, value)
+
+
+@contextmanager
+def span(
+    name: str,
+    attrs: Optional[dict] = None,
+    parent: Optional[SpanContext] = None,
+    start: Optional[float] = None,
+) -> Iterator[Span]:
+    """Open a span as the current one; written to the sink on exit. Parent
+    resolution: explicit `parent` → current span → wire-extracted remote
+    context → new root trace."""
+    ctx = parent or current_context()
+    sp = Span(
+        trace_id=ctx.trace_id if ctx else new_trace_id(),
+        span_id=new_span_id(),
+        parent_id=ctx.span_id if ctx else "",
+        name=name,
+        start=start if start is not None else time.time(),
+        attrs=dict(attrs or {}),
+    )
+    token = _current_span.set(sp)
+    try:
+        yield sp
+    except BaseException as exc:
+        sp.status = "error"
+        sp.attrs.setdefault("error", f"{type(exc).__name__}: {exc}"[:300])
+        raise
+    finally:
+        _current_span.reset(token)
+        sp.end = time.time()
+        _write(sp)
+
+
+def open_span(
+    name: str,
+    parent: Optional[SpanContext] = None,
+    start: Optional[float] = None,
+    attrs: Optional[dict] = None,
+) -> Span:
+    """Manually managed span (close with `close_span`) for long sections that
+    don't nest cleanly in a `with` block — e.g. container boot, whose children
+    (imports, enter hooks) need its span id before it ends."""
+    ctx = parent or current_context()
+    return Span(
+        trace_id=ctx.trace_id if ctx else new_trace_id(),
+        span_id=new_span_id(),
+        parent_id=ctx.span_id if ctx else "",
+        name=name,
+        start=start if start is not None else time.time(),
+        attrs=dict(attrs or {}),
+    )
+
+
+def close_span(span: Span, status: str = "ok") -> None:
+    span.end = time.time()
+    span.status = status
+    _write(span)
+
+
+def record_span(
+    name: str,
+    start: float,
+    end: float,
+    parent: Optional[SpanContext] = None,
+    attrs: Optional[dict] = None,
+) -> None:
+    """Record a retroactive span (e.g. queue wait, measured at claim time
+    from the input's enqueue timestamp)."""
+    ctx = parent or current_context()
+    if ctx is None:
+        return
+    _write(
+        Span(
+            trace_id=ctx.trace_id,
+            span_id=new_span_id(),
+            parent_id=ctx.span_id,
+            name=name,
+            start=start,
+            end=end,
+            attrs=dict(attrs or {}),
+        )
+    )
+
+
+@contextmanager
+def remote_context(ctx: Optional[SpanContext]) -> Iterator[None]:
+    """Server-side: make a wire-extracted context the ambient parent for the
+    duration of a handler (used when no local span is opened)."""
+    if ctx is None:
+        yield
+        return
+    token = _remote_context.set(ctx)
+    try:
+        yield
+    finally:
+        _remote_context.reset(token)
+
+
+# -- wire formats -------------------------------------------------------------
+
+
+def context_metadata(ctx: Optional[SpanContext] = None) -> list[tuple[str, str]]:
+    ctx = ctx or current_context()
+    if ctx is None:
+        return []
+    return [(TRACE_ID_METADATA_KEY, ctx.trace_id), (SPAN_ID_METADATA_KEY, ctx.span_id)]
+
+
+def extract_metadata(metadata: Any) -> Optional[SpanContext]:
+    """SpanContext from gRPC invocation metadata (iterable of kv pairs)."""
+    if not metadata:
+        return None
+    md = dict(metadata) if not isinstance(metadata, dict) else metadata
+    trace_id = md.get(TRACE_ID_METADATA_KEY, "")
+    if not trace_id:
+        return None
+    return SpanContext(str(trace_id), str(md.get(SPAN_ID_METADATA_KEY, "")))
+
+
+def format_context(ctx: Optional[SpanContext]) -> str:
+    """`"trace_id:span_id"` — the one-string form carried on
+    FunctionGetInputsItem.trace_context and MODAL_TPU_TRACE_CONTEXT."""
+    if ctx is None:
+        return ""
+    return f"{ctx.trace_id}:{ctx.span_id}"
+
+
+def parse_context(value: Optional[str]) -> Optional[SpanContext]:
+    if not value or ":" not in value:
+        return None
+    trace_id, _, span_id = value.partition(":")
+    if not trace_id:
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+def context_from_env() -> Optional[SpanContext]:
+    return parse_context(os.environ.get(TRACE_CONTEXT_ENV, ""))
+
+
+# -- trace store reader (CLI waterfall / tests) -------------------------------
+
+
+def read_spans(trace_dir_path: str) -> list[dict]:
+    """Every span recorded under a trace dir, across all process files.
+    Malformed lines (torn writes at crash) are skipped."""
+    spans: list[dict] = []
+    try:
+        names = sorted(os.listdir(trace_dir_path))
+    except OSError:
+        return spans
+    for fname in names:
+        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(trace_dir_path, fname)) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("trace_id"):
+                        spans.append(rec)
+        except OSError:
+            continue
+    return spans
+
+
+def find_traces(trace_dir_path: str, needle: str) -> dict[str, list[dict]]:
+    """Traces matching `needle`: a trace-id prefix, or an app_id /
+    function_call_id / input_id / task_id attr of any span. Returns
+    {trace_id: spans}."""
+    by_trace: dict[str, list[dict]] = {}
+    for rec in read_spans(trace_dir_path):
+        by_trace.setdefault(rec["trace_id"], []).append(rec)
+    if not needle:
+        return by_trace
+    matched: dict[str, list[dict]] = {}
+    for trace_id, spans in by_trace.items():
+        if trace_id.startswith(needle):
+            matched[trace_id] = spans
+            continue
+        for rec in spans:
+            attrs = rec.get("attrs") or {}
+            if needle in (
+                attrs.get("app_id"),
+                attrs.get("function_call_id"),
+                attrs.get("input_id"),
+                attrs.get("task_id"),
+                attrs.get("function_id"),
+            ):
+                matched[trace_id] = spans
+                break
+    return matched

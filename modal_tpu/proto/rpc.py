@@ -305,6 +305,61 @@ class TaskRouterStub(_StubBase):
     _registry = ROUTER_RPCS
 
 
+def _instrument_unary(name: str, impl: Any) -> Any:
+    """Server-side interceptor (handler-boundary form, like the chaos proxy):
+    extracts the caller's trace context from gRPC metadata, opens a server
+    span when the caller is tracing, and records RPC latency/outcome metrics
+    for every call. One wrapper at build time = every plane (control plane,
+    input plane, task router) is instrumented uniformly — no per-servicer
+    opt-in to forget."""
+    import time as _time
+
+    from ..observability import tracing
+    from ..observability.catalog import RPC_LATENCY, RPC_TOTAL
+
+    async def instrumented(request, context, _impl=impl, _name=name):
+        ctx = tracing.extract_metadata(context.invocation_metadata())
+        t0 = _time.perf_counter()
+        code = "ok"
+        try:
+            if ctx is not None:
+                # traced caller: record a server span stitched under theirs
+                with tracing.span(f"rpc.server.{_name}", parent=ctx):
+                    return await _impl(request, context)
+            return await _impl(request, context)
+        except BaseException:
+            code = "error"
+            raise
+        finally:
+            RPC_LATENCY.observe(_time.perf_counter() - t0, method=_name)
+            RPC_TOTAL.inc(method=_name, code=code)
+
+    return instrumented
+
+
+def _instrument_stream(name: str, impl: Any) -> Any:
+    """Streams (log tails, worker polls) are long-lived: count calls and make
+    the caller's trace context ambient, but skip the latency histogram — a
+    poll's duration measures patience, not performance."""
+    from ..observability import tracing
+    from ..observability.catalog import RPC_TOTAL
+
+    async def instrumented(request, context, _impl=impl, _name=name):
+        ctx = tracing.extract_metadata(context.invocation_metadata())
+        code = "ok"
+        try:
+            with tracing.remote_context(ctx):
+                async for item in _impl(request, context):
+                    yield item
+        except BaseException:
+            code = "error"
+            raise
+        finally:
+            RPC_TOTAL.inc(method=_name, code=code)
+
+    return instrumented
+
+
 def _build_handler(
     servicer: Any, registry: dict[str, RPCMethod], service_name: str
 ) -> "grpc.GenericRpcHandler":
@@ -320,9 +375,13 @@ def _build_handler(
             response_serializer=method.response_type.SerializeToString,
         )
         if method.arity == Arity.UNARY_UNARY:
-            handlers[method.name] = grpc.unary_unary_rpc_method_handler(impl, **kwargs)
+            handlers[method.name] = grpc.unary_unary_rpc_method_handler(
+                _instrument_unary(method.name, impl), **kwargs
+            )
         elif method.arity == Arity.UNARY_STREAM:
-            handlers[method.name] = grpc.unary_stream_rpc_method_handler(impl, **kwargs)
+            handlers[method.name] = grpc.unary_stream_rpc_method_handler(
+                _instrument_stream(method.name, impl), **kwargs
+            )
         elif method.arity == Arity.STREAM_UNARY:
             handlers[method.name] = grpc.stream_unary_rpc_method_handler(impl, **kwargs)
         else:

@@ -28,6 +28,12 @@ from .telemetry import maybe_instrument_from_env
 
 maybe_instrument_from_env()
 
+# distributed tracing: adopt the worker-exported span sink before anything
+# else runs, so boot/import spans land in the supervisor's trace store
+from ..observability import tracing
+
+tracing.maybe_configure_from_env()
+
 from ..client import _Client
 from ..config import config, logger
 from ..exception import ExecutionError
@@ -226,8 +232,28 @@ async def run_input_loop(service: Service, io: ContainerIOManager) -> None:
                 task = asyncio.current_task()
                 for iid in ctx.input_ids:
                     io._running_tasks[iid] = task
-                results = await call_user_code(service, ctx, io)
-                await io.push_outputs(ctx, results)
+                # user-execution span, stitched under the input's delivered
+                # trace (falling back to the boot trace). cold_call marks the
+                # container's first input — where first-call jit compilation
+                # lands (compile time = cold user.execute minus warm ones).
+                cold_call = not getattr(io, "_executed_an_input", False)
+                io._executed_an_input = True
+                parent = tracing.parse_context(
+                    io.input_trace_contexts.get(ctx.input_ids[0], "")
+                ) or tracing.context_from_env()
+                with tracing.span(
+                    "user.execute",
+                    parent=parent,
+                    attrs={
+                        "input_id": ctx.input_ids[0],
+                        "function_call_id": ctx.function_call_ids[0],
+                        "task_id": io.task_id,
+                        "batch_size": len(ctx.input_ids),
+                        "cold_call": cold_call,
+                    },
+                ):
+                    results = await call_user_code(service, ctx, io)
+                    await io.push_outputs(ctx, results)
             except asyncio.CancelledError:
                 # input cancelled mid-flight: report TERMINATED
                 results = [
@@ -392,6 +418,18 @@ async def main_async() -> int:
     io._function_id = container_args.function_id
     heartbeat_task = asyncio.create_task(io.heartbeat_loop(), name="heartbeat")
 
+    # Container boot span: starts at the worker's spawn decision
+    # (MODAL_TPU_TRACE_T0) and ends when the container is ready for inputs —
+    # the cold-start segment of the launching input's trace. Children
+    # (imports, enter hooks) parent under it.
+    boot_start = float(os.environ.get(tracing.TRACE_T0_ENV, "0") or 0) or None
+    boot_span = tracing.open_span(
+        "container.boot",
+        parent=tracing.context_from_env(),
+        start=boot_start,
+        attrs={"task_id": task_id, "function_id": container_args.function_id},
+    )
+
     exit_status = api_pb2.GENERIC_STATUS_SUCCESS
     exit_exception = ""
     service: Optional[Service] = None
@@ -413,10 +451,23 @@ async def main_async() -> int:
         bound_params = None
         if os.environ.get("MODAL_TPU_BOUND_PARAMS"):
             bound_params = deserialize(bytes.fromhex(os.environ["MODAL_TPU_BOUND_PARAMS"]), client)
+        t_imports = time.time()
         if function_def.is_class:
             service = import_class_service(function_def, client, bound_params)
         else:
             service = import_single_function_service(function_def, client)
+        tracing.record_span(
+            "container.imports",
+            start=t_imports,
+            end=time.time(),
+            parent=boot_span.context,
+            attrs={
+                "task_id": task_id,
+                # per-module detail: `modal_tpu app imports <task_id>`
+                # (runtime/telemetry.py, on when MODAL_TPU_IMPORT_TRACE=1)
+                "import_trace": bool(os.environ.get("MODAL_TPU_TELEMETRY_PATH")),
+            },
+        )
 
         # lifecycle: enter hooks (pre-snapshot = warm weight load). With
         # memory snapshots enabled, later cold boots SKIP the snap-enter
@@ -445,13 +496,27 @@ async def main_async() -> int:
                 api_pb2.ContainerCheckpointRequest(task_id=task_id, checkpoint_id=""),
                 max_retries=2,
             )
+        t_enter = time.time()
         await run_lifecycle_hooks(service.enter_post_snapshot, "enter")
+        if service.enter_post_snapshot:
+            tracing.record_span(
+                "container.enter_hooks",
+                start=t_enter,
+                end=time.time(),
+                parent=boot_span.context,
+                attrs={"task_id": task_id},
+            )
+
+        # boot is complete: the container is about to serve
+        tracing.close_span(boot_span)
 
         if function_def.webhook_type != api_pb2.WEB_ENDPOINT_TYPE_UNSPECIFIED:
             await run_web_endpoint(service, io, client, container_args)
         else:
             await run_input_loop(service, io)
     except BaseException as exc:
+        if not boot_span.end:
+            tracing.close_span(boot_span, status="error")
         if isinstance(exc, (KeyboardInterrupt, asyncio.CancelledError)):
             # SIGTERM from the worker (app stop / drain): graceful shutdown —
             # fall through so @exit hooks + TaskResult still run before the

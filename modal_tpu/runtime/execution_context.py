@@ -31,6 +31,28 @@ def current_input_id() -> Optional[str]:
     return _current_input_id.get()
 
 
+def current_trace_context() -> Optional[str]:
+    """The distributed-trace context ("trace_id:span_id") of the current
+    execution, for correlating user logs/metrics with the platform trace
+    (`modal_tpu app trace <id>`). Resolution: the active span (inside a
+    container, the user.execute span of the current input; on the client,
+    the function.call root) → the input's delivered context → the container
+    boot context from MODAL_TPU_TRACE_CONTEXT → None."""
+    from ..observability import tracing
+
+    ctx = tracing.current_context()
+    if ctx is not None:
+        return tracing.format_context(ctx)
+    input_id = _resolve_input_id()
+    if input_id is not None:
+        from .io_manager import ContainerIOManager
+
+        io = ContainerIOManager.singleton()
+        if io is not None and io.input_trace_contexts.get(input_id):
+            return io.input_trace_contexts[input_id]
+    return tracing.format_context(tracing.context_from_env()) or None
+
+
 def current_function_call_id() -> Optional[str]:
     return _current_function_call_id.get()
 

@@ -92,6 +92,10 @@ class ContainerIOManager:
         # code recorded for in-flight inputs (flushed on preempt)
         self.delivered_resume_tokens: dict[str, str] = {}
         self.recorded_resume_tokens: dict[str, str] = {}
+        # distributed tracing: per-input trace context delivered on
+        # FunctionGetInputsItem.trace_context — the container's user.execute
+        # span parents there so the input stitches into the caller's trace
+        self.input_trace_contexts: dict[str, str] = {}
         self._waiting_for_checkpoint = False
         self.heartbeat_condition = asyncio.Condition()
         max_conc = function_def.max_concurrent_inputs or 1
@@ -236,6 +240,8 @@ class ContainerIOManager:
                 for item in items:
                     if item.resume_token:
                         self.delivered_resume_tokens[item.input_id] = item.resume_token
+                    if item.trace_context:
+                        self.input_trace_contexts[item.input_id] = item.trace_context
                 self.current_input_ids |= set(ctx.input_ids)
                 slot_held = False  # transferred to the runner
                 yield ctx
@@ -274,6 +280,7 @@ class ContainerIOManager:
         for iid in ctx.input_ids:
             self.delivered_resume_tokens.pop(iid, None)
             self.recorded_resume_tokens.pop(iid, None)
+            self.input_trace_contexts.pop(iid, None)
         self.input_slots.release()
 
     # -- preemption checkpoint flush ----------------------------------------

@@ -16,6 +16,7 @@ self-time.
 
 from __future__ import annotations
 
+import atexit
 import importlib.abc
 import importlib.machinery
 import json
@@ -93,17 +94,39 @@ class _TimedLoader(importlib.abc.Loader):
 
 
 _installed: Optional[ImportInterceptor] = None
+_telemetry_file = None
+
+
+def _close_telemetry_file() -> None:
+    """Flush and close the JSONL sink. Registered atexit: the handle was
+    previously opened in instrument_imports and never closed, so events
+    buffered at interpreter teardown could be lost and the fd leaked for the
+    container's whole life."""
+    global _telemetry_file
+    if _telemetry_file is not None:
+        try:
+            _telemetry_file.flush()
+            _telemetry_file.close()
+        except (OSError, ValueError):
+            pass
+        _telemetry_file = None
 
 
 def instrument_imports(output_path: str) -> None:
     """Install the interceptor writing JSONL events to `output_path`."""
-    global _installed
+    global _installed, _telemetry_file
     if _installed is not None:
         return
-    f = open(output_path, "a", buffering=1)
+    f = _telemetry_file = open(output_path, "a", buffering=1)
+    atexit.register(_close_telemetry_file)
 
     def emit(event: dict) -> None:
-        f.write(json.dumps(event) + "\n")
+        if _telemetry_file is None:
+            return  # sink already closed at exit; drop late events
+        try:
+            f.write(json.dumps(event) + "\n")
+        except (OSError, ValueError):
+            pass
 
     _installed = ImportInterceptor(emit)
     sys.meta_path.insert(0, _installed)
@@ -132,6 +155,14 @@ def summarize(path: str, top: int = 15) -> list[dict]:
                 events.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
-    roots = [e for e in events if e.get("depth") == 1]
+    # malformed events (torn writes at kill, foreign lines) must not raise:
+    # a viewer skips them instead of dying on a KeyError
+    roots = [
+        e
+        for e in events
+        if isinstance(e, dict)
+        and e.get("depth") == 1
+        and isinstance(e.get("duration_s"), (int, float))
+    ]
     roots.sort(key=lambda e: -e["duration_s"])
     return roots[:top]

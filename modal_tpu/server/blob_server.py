@@ -11,6 +11,8 @@ from typing import Optional
 from aiohttp import web
 
 from ..config import logger
+from ..observability.catalog import BLOB_BYTES, BLOB_REQUESTS
+from ..observability.metrics import REGISTRY
 from .state import ServerState
 
 
@@ -44,6 +46,10 @@ class BlobServer:
         # the control plane's "dashboard page" — visiting it with the
         # verification code approves the pending flow
         app.router.add_get("/auth/token-flow/{flow_id}", self._token_flow_approve)
+        # Prometheus scrape endpoint for the whole supervisor process: the
+        # blob server is the one HTTP listener the stack already runs, so the
+        # metrics plane rides it instead of opening another port.
+        app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -51,7 +57,23 @@ class BlobServer:
         self.port = site._server.sockets[0].getsockname()[1]
         url = f"http://{self.host}:{self.port}"
         self.state.blob_url_base = url
+        # discovery breadcrumb for `modal_tpu metrics` (a separate process):
+        # the scrape URL of the supervisor that owns this state dir
+        try:
+            obs_dir = os.path.join(self.state.state_dir, "observability")
+            os.makedirs(obs_dir, exist_ok=True)
+            with open(os.path.join(obs_dir, "metrics_url"), "w") as f:
+                f.write(f"{url}/metrics\n")
+        except OSError:
+            pass
         return url
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=REGISTRY.render_prometheus(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
 
     async def stop(self) -> None:
         if self._runner is not None:
@@ -74,20 +96,26 @@ class BlobServer:
 
     async def _put(self, request: web.Request) -> web.Response:
         if (injected := await self._inject("BlobPut")) is not None:
+            BLOB_REQUESTS.inc(route="put", code=str(injected.status))
             return injected
         blob_id = request.match_info["blob_id"]
         path = self.state.blob_path(blob_id)
         tmp = path + ".tmp"
+        received = 0
         with open(tmp, "wb") as f:
             async for chunk in request.content.iter_chunked(1024 * 1024):
                 f.write(chunk)
+                received += len(chunk)
         os.replace(tmp, path)
+        BLOB_BYTES.inc(received, direction="in")
+        BLOB_REQUESTS.inc(route="put", code="200")
         return web.Response(status=200)
 
     async def _put_part(self, request: web.Request) -> web.Response:
         """One multipart part (reference: S3 presigned part PUT,
         perform_multipart_upload blob_utils.py:166)."""
         if (injected := await self._inject("BlobPutPart")) is not None:
+            BLOB_REQUESTS.inc(route="put_part", code=str(injected.status))
             return injected
         blob_id = request.match_info["blob_id"]
         part = int(request.match_info["part"])
@@ -96,10 +124,14 @@ class BlobServer:
         try:
             path = self.state.blob_path(blob_id) + f".part{part}"
             tmp = path + ".tmp"
+            received = 0
             with open(tmp, "wb") as f:
                 async for chunk in request.content.iter_chunked(1024 * 1024):
                     f.write(chunk)
+                    received += len(chunk)
             os.replace(tmp, path)
+            BLOB_BYTES.inc(received, direction="in")
+            BLOB_REQUESTS.inc(route="put_part", code="200")
             return web.Response(status=200)
         finally:
             self.inflight_parts -= 1
@@ -128,9 +160,16 @@ class BlobServer:
 
     async def _get(self, request: web.Request) -> web.StreamResponse:
         if (injected := await self._inject("BlobGet")) is not None:
+            BLOB_REQUESTS.inc(route="get", code=str(injected.status))
             return injected
         blob_id = request.match_info["blob_id"]
         path = self.state.blob_path(blob_id)
         if not os.path.exists(path):
+            BLOB_REQUESTS.inc(route="get", code="404")
             return web.Response(status=404, text="blob not found")
+        try:
+            BLOB_BYTES.inc(os.path.getsize(path), direction="out")
+        except OSError:
+            pass
+        BLOB_REQUESTS.inc(route="get", code="200")
         return web.FileResponse(path)

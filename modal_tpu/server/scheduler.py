@@ -22,6 +22,14 @@ import time
 from typing import Optional
 
 from ..config import logger
+from ..observability import tracing
+from ..observability.catalog import (
+    SCHED_PLACEMENT_LATENCY,
+    SCHED_QUEUE_DEPTH,
+    SCHED_TASKS_LAUNCHED,
+    SCHED_TASKS_REAPED,
+    WORKER_PREEMPTIONS,
+)
 from ..proto import api_pb2
 from ..tpu_config import parse_tpu_config, slice_info_proto
 from .state import ClusterState, FunctionState, ServerState, TaskState_, WorkerState, make_id
@@ -81,6 +89,16 @@ class Scheduler:
             await asyncio.sleep(SCHEDULE_INTERVAL)
 
     async def _schedule_once(self) -> None:
+        # queue depth from the per-function pending lists (bounded by
+        # OUTSTANDING work) — scanning self.s.inputs would walk every input
+        # ever enqueued (completed ones are retained) on every 50ms tick
+        depth = 0
+        for fn in self.s.functions.values():
+            for iid in fn.pending:
+                inp = self.s.inputs.get(iid)
+                if inp is not None and inp.status == "pending":
+                    depth += 1
+        SCHED_QUEUE_DEPTH.set(depth)
         for fn in list(self.s.functions.values()):
             app = self.s.apps.get(fn.app_id)
             if app is not None and app.done:
@@ -324,6 +342,16 @@ class Scheduler:
                 best, best_score = worker, score
         return best
 
+    def _launch_trace_context(self, fn: FunctionState) -> str:
+        """Trace context of the oldest traced pending input: the launch this
+        backlog caused parents its placement/boot spans there, so the cold
+        start shows up inside the call that paid for it."""
+        for iid in fn.pending:
+            inp = self.s.inputs.get(iid)
+            if inp is not None and inp.status == "pending" and inp.trace_context:
+                return inp.trace_context
+        return ""
+
     async def _launch_task(
         self,
         fn: FunctionState,
@@ -331,6 +359,7 @@ class Scheduler:
         rank: int = 0,
         worker: Optional[WorkerState] = None,
     ) -> Optional[TaskState_]:
+        t_place0 = time.time()
         chips_needed = self._chips_needed(fn)
         if worker is None:
             worker = self._pick_worker(chips_needed, placement=self._fn_placement(fn))
@@ -354,6 +383,7 @@ class Scheduler:
             cluster_id=cluster.cluster_id if cluster else "",
             tpu_chip_ids=chip_ids,
             router_token=secrets.token_urlsafe(24),
+            trace_context=self._launch_trace_context(fn),
         )
         self.s.tasks[task_id] = task
         fn.task_ids.add(task_id)
@@ -366,6 +396,23 @@ class Scheduler:
             router_token=task.router_token,
         )
         await worker.events.put(api_pb2.WorkerPollResponse(assignment=assignment))
+        kind = "gang_member" if cluster is not None else "task"
+        SCHED_TASKS_LAUNCHED.inc(kind=kind)
+        SCHED_PLACEMENT_LATENCY.observe(time.time() - t_place0, kind=kind)
+        tracing.record_span(
+            "scheduler.place",
+            start=t_place0,
+            end=time.time(),
+            parent=tracing.parse_context(task.trace_context),
+            attrs={
+                "task_id": task_id,
+                "worker_id": worker.worker_id,
+                "app_id": fn.app_id,
+                "function_id": fn.function_id,
+                "chips": len(chip_ids),
+                "rank": rank,
+            },
+        )
         logger.debug(f"scheduled task {task_id} for {fn.tag} on {worker.worker_id} chips={chip_ids}")
         return task
 
@@ -479,6 +526,10 @@ class Scheduler:
                     args.env[k] = v
         if fn.serialized_params:
             args.env["MODAL_TPU_BOUND_PARAMS"] = fn.serialized_params.hex()
+        if task.trace_context:
+            # the container parents its boot/import spans under the launching
+            # input's trace (worker → container env; observability/tracing.py)
+            args.env[tracing.TRACE_CONTEXT_ENV] = task.trace_context
         if fn.definition.proxy_id:
             proxy = self.s.proxies.get(fn.definition.proxy_id)
             if proxy is not None:
@@ -509,6 +560,7 @@ class Scheduler:
             spec = parse_tpu_config(tpu.tpu_type)
             chips_needed = min(spec.chips, spec.chips_per_host) if spec else 0
         sb_placement = self._placement_or_none(sandbox.definition.scheduler_placement)
+        t_place0 = time.time()
         worker = self._pick_worker(chips_needed, placement=sb_placement)
         if worker is None:
             return None
@@ -544,6 +596,8 @@ class Scheduler:
                 for k, v in secret.env_dict.items():
                     assignment.container_arguments.env[k] = v
         await worker.events.put(api_pb2.WorkerPollResponse(assignment=assignment))
+        SCHED_TASKS_LAUNCHED.inc(kind="sandbox")
+        SCHED_PLACEMENT_LATENCY.observe(time.time() - t_place0, kind="sandbox")
         return task
 
     # ------------------------------------------------------------------
@@ -596,6 +650,7 @@ class Scheduler:
             return
         worker.draining = True
         worker.drain_deadline = time.time() + grace_s + DRAIN_REAP_MARGIN
+        WORKER_PREEMPTIONS.inc()
         logger.warning(f"worker {worker_id} draining (grace {grace_s}s)")
         for task_id in list(worker.active_tasks):
             task = self.s.tasks.get(task_id)
@@ -674,6 +729,7 @@ class Scheduler:
         otherwise inputs retry under the policy or fail-fast when
         exhausted."""
         now = time.time()
+        SCHED_TASKS_REAPED.inc(reason=reason.replace(" ", "_"))
         logger.warning(
             f"task {task.task_id} {reason}; "
             + ("requeueing its inputs" if free_requeue else "failing/retrying its inputs")

@@ -20,6 +20,8 @@ from typing import Any, Optional
 import grpc
 
 from ..config import logger
+from ..observability import tracing
+from ..observability.catalog import INPUT_QUEUE_WAIT, TASK_RESULTS, WORKER_HEARTBEATS
 from ..proto import api_pb2
 from .scheduler import PLACEMENT_UNSAT_GRACE_S
 from .state import (
@@ -630,6 +632,9 @@ class ModalTPUServicer:
             function_call_id=call.function_call_id,
             idx=item.idx,
             input=item.input,
+            # the submitting RPC's trace context (the server-side handler span
+            # set by proto/rpc.py) rides the input to the container
+            trace_context=tracing.format_context(tracing.current_context()),
         )
         self.s.inputs[input_id] = inp
         call.input_ids.append(input_id)
@@ -932,6 +937,27 @@ class ModalTPUServicer:
         )
         return task.task_id in live[:min_containers]
 
+    def _note_input_claimed(self, fn: FunctionState, inp: InputState) -> None:
+        """Queue-segment attribution at the claim transition: the enqueue→
+        claim wait becomes a histogram sample and (for traced inputs) a
+        retroactive `scheduler.queue_wait` span in the caller's trace."""
+        now = time.time()
+        INPUT_QUEUE_WAIT.observe(max(0.0, now - inp.created_at))
+        ctx = tracing.parse_context(inp.trace_context)
+        if ctx is not None:
+            tracing.record_span(
+                "scheduler.queue_wait",
+                start=inp.created_at,
+                end=now,
+                parent=ctx,
+                attrs={
+                    "input_id": inp.input_id,
+                    "function_call_id": inp.function_call_id,
+                    "app_id": fn.app_id,
+                    "function_id": fn.function_id,
+                },
+            )
+
     async def FunctionGetInputs(self, request: api_pb2.FunctionGetInputsRequest, context) -> api_pb2.FunctionGetInputsResponse:
         fn = self.s.functions.get(request.function_id)
         task = self.s.tasks.get(request.task_id)
@@ -980,6 +1006,7 @@ class ModalTPUServicer:
                     if len(inp.delivered_to) >= cluster.size:
                         inp.status = "claimed"
                         fn.pending.remove(input_id)
+                        self._note_input_claimed(fn, inp)
                     task.first_input_at = task.first_input_at or time.time()
                     items.append(
                         api_pb2.FunctionGetInputsItem(
@@ -989,6 +1016,7 @@ class ModalTPUServicer:
                             idx=inp.idx,
                             retry_count=inp.retry_count,
                             resume_token=inp.resume_token,
+                            trace_context=inp.trace_context,
                         )
                     )
             else:
@@ -1005,6 +1033,7 @@ class ModalTPUServicer:
                         inp.status = "claimed"
                         inp.claimed_by = task.task_id
                         inp.claimed_at = time.time()
+                        self._note_input_claimed(fn, inp)
                         task.first_input_at = task.first_input_at or time.time()
                         items.append(
                             api_pb2.FunctionGetInputsItem(
@@ -1014,6 +1043,7 @@ class ModalTPUServicer:
                                 idx=inp.idx,
                                 retry_count=inp.retry_count,
                                 resume_token=inp.resume_token,
+                                trace_context=inp.trace_context,
                             )
                         )
                     if not items or len(items) >= batch_size or not request.batch_linger_ms:
@@ -1344,6 +1374,7 @@ class ModalTPUServicer:
     async def TaskResult(self, request: api_pb2.TaskResultRequest, context) -> api_pb2.TaskResultResponse:
         task = self.s.tasks.get(request.task_id)
         if task is not None:
+            TASK_RESULTS.inc(status=api_pb2.GenericResultStatus.Name(request.result.status))
             if task.result is not None:
                 # first report wins: the container's own result (e.g.
                 # TERMINATED from a graceful drain) must not be overwritten
@@ -2217,6 +2248,7 @@ class ModalTPUServicer:
     async def WorkerHeartbeat(self, request, context) -> api_pb2.WorkerHeartbeatResponse:
         worker = self.s.workers.get(request.worker_id)
         if worker is not None:
+            WORKER_HEARTBEATS.inc()
             worker.last_heartbeat = time.time()
             if request.draining and not worker.draining and self.scheduler is not None:
                 # worker announces an impending preemption (SIGTERM from the

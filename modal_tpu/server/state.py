@@ -61,6 +61,10 @@ class InputState:
     # checkpoint recorded by a preempted attempt (ContainerCheckpoint):
     # redelivered with the input so the retry resumes instead of restarting
     resume_token: str = ""
+    # distributed tracing: "trace_id:span_id" captured at enqueue from the
+    # submitting RPC's metadata; redelivered with the input so container
+    # spans stitch into the caller's trace (observability/tracing.py)
+    trace_context: str = ""
 
 
 @dataclass
@@ -135,6 +139,9 @@ class TaskState_:
     tpu_chip_ids: list[int] = field(default_factory=list)
     container_address: str = ""
     router_token: str = ""  # bearer token for the worker's command router
+    # trace context of the input whose backlog caused this launch: the
+    # container's boot/import spans parent here (cold-start attribution)
+    trace_context: str = ""
 
 
 @dataclass

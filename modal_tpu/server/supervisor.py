@@ -17,6 +17,8 @@ import grpc
 
 from ..chaos import ChaosPolicy, ChaosServicerProxy
 from ..config import config, logger
+from ..observability import tracing
+from ..observability.catalog import CHAOS_SEED
 from ..proto.rpc import build_generic_handler
 from .blob_server import BlobServer
 from .input_plane import InputPlaneServer
@@ -64,6 +66,14 @@ class LocalSupervisor:
 
     async def start(self) -> None:
         os.makedirs(self.state_dir, exist_ok=True)
+        if config["trace"]:
+            # span sink under the supervisor dir; exported to containers via
+            # MODAL_TPU_TRACE_DIR (observability/tracing.py)
+            tracing.configure(config.get("trace_dir") or os.path.join(self.state_dir, "traces"))
+        if self.chaos is not None:
+            # /metrics echoes the active chaos seed so a soak failure is
+            # attributable to the exact injected fault sequence
+            CHAOS_SEED.set(float(self.chaos.seed))
         self._grpc_server = grpc.aio.server(
             options=[
                 ("grpc.max_receive_message_length", 128 * 1024 * 1024),

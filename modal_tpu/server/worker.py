@@ -22,6 +22,8 @@ import time
 from typing import Optional
 
 from ..config import config, logger
+from ..observability import tracing
+from ..observability.catalog import IMAGE_BUILD_SECONDS
 from ..proto import api_pb2
 from .._utils.grpc_utils import create_channel, retry_transient_errors
 from ..proto.rpc import ModalTPUStub
@@ -382,14 +384,23 @@ class WorkerAgent:
             self._image_builder = get_image_builder(self.state_dir)
         return await self._image_builder.materialize(self._stub, image_id)
 
-    async def _prepare_image(self, task_id: str, image_id: str, env: dict):
+    async def _prepare_image(self, task_id: str, image_id: str, env: dict, trace_context: str = ""):
         """Materialize the image and fold its env/PATH/rootfs into `env`.
         Returns (ok, built): on build failure reports INIT_FAILURE and
         returns (False, None) — shared by the function and sandbox paths."""
         if not image_id:
             return True, None
+        t_build0 = time.time()
         try:
             built = await self._materialize_image(image_id)
+            IMAGE_BUILD_SECONDS.observe(time.time() - t_build0)
+            tracing.record_span(
+                "image.build",
+                start=t_build0,
+                end=time.time(),
+                parent=tracing.parse_context(trace_context),
+                attrs={"task_id": task_id, "image_id": image_id},
+            )
         except Exception as exc:
             logger.warning(f"image build failed for task {task_id}: {exc}")
             try:
@@ -868,6 +879,7 @@ class WorkerAgent:
 
     async def _run_task(self, assignment: api_pb2.TaskAssignment) -> None:
         task_id = assignment.task_id
+        t_launch0 = time.time()
         if self._consume_early_stop(task_id):
             logger.debug(f"task {task_id} stopped before start; not launching")
             await self._report_never_started(task_id)
@@ -884,11 +896,20 @@ class WorkerAgent:
         # Failures are loud: the task reports INIT_FAILURE with the build log
         # tail instead of silently running the host venv (round-1 behavior).
         env = dict(os.environ)
-        ok, built_image = await self._prepare_image(task_id, args.function_def.image_id, env)
+        task_trace_ctx = args.env.get(tracing.TRACE_CONTEXT_ENV, "")
+        ok, built_image = await self._prepare_image(
+            task_id, args.function_def.image_id, env, trace_context=task_trace_ctx
+        )
         if not ok:
             return
         env.update(dict(args.env))
         env["MODAL_TPU_CONTAINER_ARGS_PATH"] = args_path
+        # container boot spans start the clock at the worker's spawn decision,
+        # and the container adopts this supervisor's span sink explicitly
+        # (observability/tracing.py)
+        env[tracing.TRACE_T0_ENV] = str(t_launch0)
+        if tracing.trace_dir():
+            env[tracing.TRACE_DIR_ENV] = tracing.trace_dir()
         env["MODAL_TPU_SERVER_URL"] = self.server_url
         env["MODAL_TPU_TASK_ID"] = task_id
         env["MODAL_TPU_TASK_DIR"] = task_dir
@@ -948,6 +969,13 @@ class WorkerAgent:
                 cwd=container_cwd,
             )
         self._procs[task_id] = proc
+        tracing.record_span(
+            "worker.launch_task",
+            start=t_launch0,
+            end=time.time(),
+            parent=tracing.parse_context(task_trace_ctx),
+            attrs={"task_id": task_id, "worker_id": self.worker_id, "pid": proc.pid},
+        )
         logger.debug(f"task {task_id} started pid={proc.pid}")
         if self._consume_early_stop(task_id):  # stop raced in during spawn
             proc.kill()

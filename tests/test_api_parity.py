@@ -171,3 +171,37 @@ def test_fastapi_endpoint_alias_and_web_server(supervisor):
         body = json.loads(urllib.request.urlopen(ws_url + "/anything?q=1", timeout=20).read())
         assert body["who"] == "own-server"
         assert body["path"] == "/anything?q=1"
+
+
+@pytest.mark.observability
+def test_every_implemented_rpc_is_instrumented():
+    """Instrumentation parity: every RPC a servicer implements must be
+    covered by the metrics catalog's RPC instruments. Coverage comes from
+    proto/rpc.py wrapping each *registered* handler at build time, so an RPC
+    implemented on a servicer but absent from the registry would be both
+    unreachable and silently uninstrumented — fail it loudly here."""
+    import inspect
+
+    from modal_tpu.observability import METRIC_CATALOG, instrumented_rpc_names
+    from modal_tpu.server.input_plane import InputPlaneServicer
+    from modal_tpu.server.services import ModalTPUServicer
+    from modal_tpu.server.task_router import TaskRouterServicer
+
+    instrumented = instrumented_rpc_names()
+    for servicer in (ModalTPUServicer, InputPlaneServicer, TaskRouterServicer):
+        implemented = {
+            name
+            for name, fn in vars(servicer).items()
+            if name[:1].isupper()
+            and (inspect.iscoroutinefunction(fn) or inspect.isasyncgenfunction(fn))
+        }
+        assert implemented, f"{servicer.__name__} implements no RPCs?"
+        missing = implemented - instrumented
+        assert not missing, (
+            f"{servicer.__name__} implements RPCs with no instrumentation "
+            f"(not in proto/rpc.py registry → no latency/count metrics): {sorted(missing)}"
+        )
+    # the instruments those wrappers feed must exist in the catalog
+    assert "modal_tpu_rpc_latency_seconds" in METRIC_CATALOG
+    assert "modal_tpu_rpc_total" in METRIC_CATALOG
+    assert "modal_tpu_client_rpc_latency_seconds" in METRIC_CATALOG

@@ -3,6 +3,7 @@ manager (VERDICT r1 item 10 — schedules were accepted and silently never
 fired; only a live tail existed; output was plain prints)."""
 
 import io
+import os
 import time
 
 import pytest
@@ -318,3 +319,376 @@ def test_windowed_log_fetch_tolerates_out_of_order_entries(supervisor):
     resp = synchronizer.run(fetch())
     got = [e.data for e in resp.entries]
     assert got == [f"in-window-{i}\n" for i in range(5)], got
+
+
+# ---------------------------------------------------------------------------
+# metrics registry primitives (observability/metrics.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.observability
+def test_metrics_primitives_render_prometheus():
+    from modal_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", ("method", "code"))
+    g = reg.gauge("t_depth", "queue depth")
+    h = reg.histogram("t_latency_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    c.inc(method="Foo", code="ok")
+    c.inc(2, method="Foo", code="ok")
+    c.inc(method="Bar", code="error")
+    g.set(7)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99.0)
+    text = reg.render_prometheus()
+    assert '# TYPE t_requests_total counter' in text
+    assert 't_requests_total{method="Foo",code="ok"} 3.0' in text
+    assert 't_requests_total{method="Bar",code="error"} 1.0' in text
+    assert "t_depth 7.0" in text
+    assert 't_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 't_latency_seconds_bucket{le="1.0"} 2' in text
+    assert 't_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "t_latency_seconds_count 3" in text
+    # idempotent re-definition returns the same instrument
+    assert reg.counter("t_requests_total", "requests", ("method", "code")) is c
+    with pytest.raises(ValueError):
+        reg.counter("t_requests_total", "requests", ("other",))
+    # unknown labels are rejected
+    with pytest.raises(ValueError):
+        c.inc(method="Foo")
+
+
+@pytest.mark.observability
+def test_metrics_label_sets_are_bounded():
+    from modal_tpu.observability.metrics import MAX_SERIES, OVERFLOW, MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("t_unbounded_total", "bounded", ("key",))
+    for i in range(MAX_SERIES + 50):
+        c.inc(key=f"k{i}")
+    snap = c.snapshot()
+    assert len(snap) <= MAX_SERIES + 1
+    assert snap[OVERFLOW] == 50.0  # the tail collapsed instead of growing
+
+
+@pytest.mark.observability
+def test_histogram_quantile_and_bench_summary():
+    from modal_tpu.observability.catalog import RPC_LATENCY
+    from modal_tpu.observability.metrics import REGISTRY
+
+    RPC_LATENCY.observe(0.004, method="QuantileProbe")
+    q = REGISTRY.get("modal_tpu_rpc_latency_seconds").quantile(0.5)
+    assert q is not None and q > 0
+    summary = REGISTRY.bench_summary()
+    assert summary["rpc_count"] >= 1
+    assert "rpc_latency_p50_s" in summary
+
+
+# ---------------------------------------------------------------------------
+# tracing primitives (observability/tracing.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.observability
+def test_span_model_and_propagation(tmp_path):
+    from modal_tpu.observability import tracing
+
+    tracing.configure(str(tmp_path / "tr"))
+    with tracing.span("root", attrs={"app_id": "ap-1"}) as root:
+        assert tracing.current_context() == root.context
+        md = dict(tracing.context_metadata())
+        assert md[tracing.TRACE_ID_METADATA_KEY] == root.trace_id
+        # wire round-trip: metadata → context → "trace:span" string → context
+        ctx = tracing.extract_metadata(list(md.items()))
+        assert ctx == root.context
+        assert tracing.parse_context(tracing.format_context(ctx)) == ctx
+        with tracing.span("child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            tracing.add_event("chaos.injected", rpc="Foo")
+    spans = tracing.read_spans(str(tmp_path / "tr"))
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["child"]["parent_id"] == root.span_id
+    assert by_name["child"]["events"][0]["name"] == "chaos.injected"
+    assert by_name["root"]["attrs"]["app_id"] == "ap-1"
+    assert by_name["root"]["end"] >= by_name["root"]["start"]
+
+
+@pytest.mark.observability
+def test_span_error_status_and_retroactive_record(tmp_path):
+    from modal_tpu.observability import tracing
+
+    tracing.configure(str(tmp_path / "tr2"))
+    with pytest.raises(RuntimeError):
+        with tracing.span("boom"):
+            raise RuntimeError("nope")
+    ctx = tracing.SpanContext("t" * 32, "s" * 16)
+    tracing.record_span("retro", start=1.0, end=2.0, parent=ctx)
+    spans = {s["name"]: s for s in tracing.read_spans(str(tmp_path / "tr2"))}
+    assert spans["boom"]["status"] == "error"
+    assert spans["retro"]["trace_id"] == "t" * 32
+    assert spans["retro"]["start"] == 1.0 and spans["retro"]["end"] == 2.0
+    # malformed lines in the store are skipped, not fatal
+    store = tmp_path / "tr2"
+    files = [p for p in store.iterdir() if p.name.startswith("spans-")]
+    with open(files[0], "a") as f:
+        f.write("{torn json\n")
+    assert len(tracing.read_spans(str(store))) == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one stitched trace + Prometheus /metrics (ISSUE 2 tentpole)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.observability
+def test_remote_call_yields_stitched_trace_and_metrics(supervisor, tmp_path):
+    import json as _json
+    import urllib.request
+
+    import modal_tpu
+    from modal_tpu.observability import tracing
+
+    app = modal_tpu.App("obs-e2e")
+
+    @app.function(serialized=True)
+    def double(x):
+        return x * 2
+
+    with app.run():
+        assert double.remote(21) == 42
+
+    # ONE stitched trace: client RPC → queue wait → placement → worker
+    # launch → container boot/imports → user execution
+    trace_dir = str(tmp_path / "state" / "traces")
+    traces = {}
+    for rec in tracing.read_spans(trace_dir):
+        traces.setdefault(rec["trace_id"], set()).add(rec["name"])
+    stitched = [
+        names
+        for names in traces.values()
+        if "function.call" in names and "user.execute" in names
+    ]
+    assert stitched, f"no stitched trace found in {list(traces.values())}"
+    names = stitched[0]
+    assert any(n.startswith("rpc.client.") for n in names)
+    assert "scheduler.queue_wait" in names
+    assert "scheduler.place" in names
+    assert "worker.launch_task" in names
+    assert "container.boot" in names
+    assert "container.imports" in names
+
+    # Prometheus text on the supervisor's existing HTTP server
+    url = f"http://127.0.0.1:{supervisor.blob_server.port}/metrics"
+    text = urllib.request.urlopen(url, timeout=10).read().decode()
+    assert "# TYPE modal_tpu_rpc_latency_seconds histogram" in text
+    assert "modal_tpu_rpc_latency_seconds_bucket" in text
+    assert "# TYPE modal_tpu_scheduler_queue_depth gauge" in text
+    assert "# TYPE modal_tpu_chaos_injections_total counter" in text
+    assert "modal_tpu_scheduler_tasks_launched_total" in text
+    # the breadcrumb the CLI uses to find this endpoint
+    url_file = tmp_path / "state" / "observability" / "metrics_url"
+    assert url_file.read_text().strip() == url
+
+    # CLI waterfall renders the stitched trace
+    from click.testing import CliRunner
+
+    from modal_tpu.cli.entry_point import cli as cli_root
+
+    trace_id = next(
+        tid for tid, ns in traces.items() if "function.call" in ns and "user.execute" in ns
+    )
+    result = CliRunner().invoke(
+        cli_root, ["app", "trace", trace_id[:12], "--state-dir", str(tmp_path / "state")]
+    )
+    assert result.exit_code == 0, result.output
+    assert "user.execute" in result.output and "container.boot" in result.output
+
+    # CLI metrics dump (scrapes the discovered endpoint)
+    result = CliRunner().invoke(
+        cli_root, ["metrics", "--state-dir", str(tmp_path / "state")]
+    )
+    assert result.exit_code == 0, result.output
+    assert "modal_tpu_rpc_latency_seconds" in result.output
+    result = CliRunner().invoke(cli_root, ["metrics", "--url", url, "--json"])
+    assert result.exit_code == 0, result.output
+    assert _json.loads(result.output)
+
+
+@pytest.mark.observability
+def test_chaos_injections_are_counted_and_attributable(supervisor):
+    import urllib.request
+
+    import modal_tpu
+    from modal_tpu.observability.catalog import CHAOS_INJECTIONS, CHAOS_SEED
+
+    assert CHAOS_SEED.value() == float(supervisor.chaos.seed)
+    before = CHAOS_INJECTIONS.total()
+    supervisor.servicer.fail_put_inputs = 1  # budgeted knob → ChaosPolicy
+    app = modal_tpu.App("obs-chaos")
+
+    @app.function(serialized=True)
+    def ident(x):
+        return x
+
+    with app.run():
+        assert ident.remote(7) == 7  # client retries through the fault
+    assert supervisor.chaos.fault_log, "chaos injected nothing"
+    assert CHAOS_INJECTIONS.total() > before
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{supervisor.blob_server.port}/metrics", timeout=10
+    ).read().decode()
+    assert "modal_tpu_chaos_injections_total{" in text
+    assert 'kind="error"' in text
+    assert "modal_tpu_chaos_seed 0.0" in text  # the fixture's seed, echoed
+
+
+# ---------------------------------------------------------------------------
+# FunctionGetCurrentStats (services.py:611) — backlog/runner counts move
+# ---------------------------------------------------------------------------
+
+
+def _get_stats(sup, fn_id):
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.proto import api_pb2
+
+    return synchronizer.run(
+        sup.servicer.FunctionGetCurrentStats(
+            api_pb2.FunctionGetCurrentStatsRequest(function_id=fn_id), None
+        )
+    )
+
+
+@pytest.mark.observability
+def test_function_stats_move_through_lifecycle(supervisor):
+    import modal_tpu
+
+    app = modal_tpu.App("obs-stats")
+
+    @app.function(serialized=True, max_containers=1)
+    def slowly(x):
+        import time as _t
+
+        _t.sleep(1.5)
+        return x
+
+    with app.run():
+        fn_id = next(
+            fid for fid, f in supervisor.state.functions.items() if f.tag.endswith("slowly")
+        )
+        stats = _get_stats(supervisor, fn_id)
+        assert stats.backlog == 0 and stats.num_total_tasks == 0
+        calls = [slowly.spawn(i) for i in range(4)]
+        # enqueue: backlog appears (max_containers=1 keeps a queue)
+        deadline = time.time() + 30
+        saw_backlog = saw_active = False
+        while time.time() < deadline:
+            stats = _get_stats(supervisor, fn_id)
+            if stats.backlog > 0:
+                saw_backlog = True
+            if stats.num_active_tasks > 0:
+                saw_active = True
+                assert stats.num_total_tasks >= stats.num_active_tasks
+            if saw_backlog and saw_active:
+                break
+            time.sleep(0.1)
+        assert saw_backlog, "backlog never observed while inputs queued"
+        assert saw_active, "no runner ever became active"
+        for c in calls:
+            assert c.get(timeout=60) in range(4)
+        # drained: no pending inputs remain
+        stats = _get_stats(supervisor, fn_id)
+        assert stats.backlog == 0
+        assert stats.num_total_tasks >= 1
+
+
+@pytest.mark.observability
+def test_function_stats_under_preempted_worker(supervisor):
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+
+    app = modal_tpu.App("obs-stats-preempt")
+
+    @app.function(serialized=True, max_containers=1)
+    def linger(x):
+        import time as _t
+
+        _t.sleep(30)
+        return x
+
+    with app.run():
+        fn_id = next(
+            fid for fid, f in supervisor.state.functions.items() if f.tag.endswith("linger")
+        )
+        linger.spawn(0)
+        linger.spawn(1)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if _get_stats(supervisor, fn_id).num_active_tasks > 0:
+                break
+            time.sleep(0.1)
+        assert _get_stats(supervisor, fn_id).num_active_tasks > 0
+        # preempt the only worker: its claimed input requeues for free, so
+        # the backlog must RISE while the active runner count falls to zero
+        synchronizer.run(supervisor.preempt_worker(0, grace_s=2.0))
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            stats = _get_stats(supervisor, fn_id)
+            if stats.backlog >= 2 and stats.num_active_tasks == 0:
+                ok = True
+                break
+            time.sleep(0.2)
+        assert ok, f"stats never reflected preemption: backlog={stats.backlog} active={stats.num_active_tasks}"
+
+
+# ---------------------------------------------------------------------------
+# telemetry satellite: file-handle hygiene + malformed-event tolerance
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.observability
+def test_telemetry_summarize_skips_malformed_events(tmp_path):
+    import json as _json
+
+    from modal_tpu.runtime.telemetry import summarize
+
+    path = tmp_path / "imports.jsonl"
+    events = [
+        {"event": "module_load_end", "module": "ok", "duration_s": 0.5, "depth": 1},
+        {"event": "module_load_end", "module": "no_duration", "depth": 1},  # malformed
+        {"event": "module_load_end", "module": "no_depth", "duration_s": 0.1},
+        {"event": "module_load_end", "module": "bad_duration", "duration_s": "x", "depth": 1},
+        "not even a dict",
+    ]
+    with open(path, "w") as f:
+        for e in events:
+            f.write(_json.dumps(e) + "\n")
+        f.write("{torn\n")
+    top = summarize(str(path))
+    assert [e["module"] for e in top] == ["ok"]
+
+
+@pytest.mark.observability
+def test_telemetry_file_closed_on_exit(tmp_path):
+    import subprocess
+    import sys as _sys
+
+    # a fresh interpreter: instrument, import something, exit WITHOUT an
+    # explicit close — the atexit hook must flush the sink
+    out = tmp_path / "imports.jsonl"
+    code = (
+        "from modal_tpu.runtime import telemetry\n"
+        f"telemetry.instrument_imports({str(out)!r})\n"
+        "import email.mime.text\n"
+        "assert telemetry._telemetry_file is not None\n"
+    )
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([_sys.executable, "-c", code], env=env, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    lines = out.read_text().strip().splitlines()
+    assert lines, "no telemetry events were flushed"
+    assert any("email" in line for line in lines)
