@@ -296,6 +296,7 @@ def _make_snap_app(tpu_type: str, timeout_s: int, model_name: str, use_volume_we
             from modal_tpu.models.llama import get_config, init_params
 
             cfg = get_config(model_name)
+            rss_before_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
             t0 = _time.perf_counter()
             if use_volume_weights:
                 from modal_tpu import Volume
@@ -309,9 +310,16 @@ def _make_snap_app(tpu_type: str, timeout_s: int, model_name: str, use_volume_we
             from modal_tpu.models.sampling import host_sync
 
             host_sync(self.params)
+            weights_bytes = sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(self.params)
+                if hasattr(leaf, "dtype")
+            )
             self.load_stats = {
                 "weights_load_s": _time.perf_counter() - t0,
                 "peak_rss_gb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6,
+                "rss_before_gb": rss_before_gb,
+                "weights_gb": weights_bytes / 1e9,
                 "from_volume": use_volume_weights,
             }
 
@@ -577,9 +585,19 @@ def child_main(mode: str) -> None:
             if fresh_stats:
                 result["weights_from_volume"] = fresh_stats.get("from_volume", False)
                 result["weights_load_peak_rss_gb"] = round(fresh_stats["peak_rss_gb"], 2)
+                # data-plane health: how much host RSS the load itself added
+                # (streaming loads should add ~PREFETCH tensors, not a model)
+                if "rss_before_gb" in fresh_stats:
+                    result["weights_load_rss_delta_gb"] = round(
+                        fresh_stats["peak_rss_gb"] - fresh_stats["rss_before_gb"], 2
+                    )
                 # only call it a volume load when it actually was one
                 if fresh_stats.get("from_volume"):
                     result["weights_volume_load_s"] = round(fresh_stats["weights_load_s"], 2)
+                    if fresh_stats.get("weights_gb") and fresh_stats["weights_load_s"] > 0:
+                        result["weights_load_gbps"] = round(
+                            fresh_stats["weights_gb"] / fresh_stats["weights_load_s"], 3
+                        )
                 else:
                     result["weights_init_load_s"] = round(fresh_stats["weights_load_s"], 2)
         except Exception as exc:  # noqa: BLE001 — A/B is additive, never fatal

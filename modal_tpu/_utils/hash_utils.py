@@ -17,12 +17,20 @@ HASH_CHUNK_SIZE = 65536
 BLOCK_SIZE = 8 * 1024 * 1024
 
 
-def _update(hashers, data: Union[bytes, BinaryIO]) -> int:
+def _update(hashers, data: Union[bytes, BinaryIO, list, tuple]) -> int:
     total = 0
-    if isinstance(data, bytes):
+    if isinstance(data, (bytes, bytearray, memoryview)):
         for h in hashers:
             h.update(data)
         return len(data)
+    if isinstance(data, (list, tuple)):
+        # payload segments (serialization.Payload.segments): hash in place —
+        # no join, no copy; memoryview segments feed the hasher directly
+        for seg in data:
+            for h in hashers:
+                h.update(seg)
+            total += len(seg)
+        return total
     assert data.seekable()
     pos = data.tell()
     while True:

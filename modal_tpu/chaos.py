@@ -52,8 +52,12 @@ KNOB_RPCS: dict[str, frozenset] = {
 HEARTBEAT_RPCS = frozenset({"ContainerHeartbeat", "WorkerHeartbeat"})
 
 # HTTP blob routes are injected under pseudo-RPC names so one policy and one
-# rate table cover the gRPC and HTTP planes alike.
-BLOB_RPCS = frozenset({"BlobPut", "BlobGet", "BlobPutPart", "BlobComplete"})
+# rate table cover the gRPC and HTTP planes alike. BlockGet is the volume
+# content-block route (GET /block/{sha}, Range-capable) the striped Volume
+# read engine fetches through.
+BLOB_RPCS = frozenset(
+    {"BlobPut", "BlobGet", "BlobPutPart", "BlobComplete", "BlockGet", "VolumeFileGet"}
+)
 
 
 @dataclass

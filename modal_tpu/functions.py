@@ -46,6 +46,7 @@ from .serialization import (
     deserialize_exception,
     serialize,
     serialize_data_format,
+    serialize_payload_data_format,
 )
 from .tpu_config import TPUSliceSpec, parse_tpu_config
 
@@ -607,14 +608,16 @@ async def _create_input(
     the result (reference _serialization.py:359 — CBOR is how non-Python
     SDKs call deployed functions)."""
     if data_format == api_pb2.DATA_FORMAT_CBOR:
-        data = serialize_data_format([list(args), kwargs], data_format)
+        payload = serialize_payload_data_format([list(args), kwargs], data_format)
     else:
-        data = serialize((args, kwargs))
+        # zero-copy: large tensor args ride as out-of-band segments; the blob
+        # upload below streams them without ever joining the payload
+        payload = serialize_payload_data_format((args, kwargs), data_format)
     input_pb = api_pb2.FunctionInput(data_format=data_format, method_name=method_name)
-    if len(data) > MAX_OBJECT_SIZE_BYTES:
-        input_pb.args_blob_id = await blob_upload(data, stub)
+    if payload.nbytes > MAX_OBJECT_SIZE_BYTES:
+        input_pb.args_blob_id = await blob_upload(payload, stub)
     else:
-        input_pb.args = data
+        input_pb.args = payload.join()
     return api_pb2.FunctionPutInputsItem(idx=idx, input=input_pb)
 
 

@@ -80,6 +80,59 @@ def qembed(embed: Any, tokens: jax.Array) -> jax.Array:
     return embed[tokens]
 
 
+# fast_host_init tensors at/above this stream chunk-wise into a donated
+# device buffer instead of staging a full-size numpy copy
+_CHUNKED_INIT_BYTES = 256 * 1024 * 1024
+_CHUNK_BYTES = 64 * 1024 * 1024
+
+
+def _tile_to(host_tile, size: int):
+    """Exactly `size` int8 values by repeating host_tile (no oversized
+    np.tile temp: the remainder slice is cut before concatenation)."""
+    import numpy as np
+
+    full = size // host_tile.size
+    rem = size - full * host_tile.size
+    out = np.empty(size, np.int8)
+    if full:
+        out[: full * host_tile.size].reshape(full, host_tile.size)[:] = host_tile
+    if rem:
+        out[full * host_tile.size :] = host_tile[:rem]
+    return out
+
+
+def _fill_int8_chunked(shape: tuple, host_tile) -> jax.Array:
+    """Stream an int8 buffer of `shape` full of tiled pseudo-random values,
+    one leading-axis chunk at a time, via donated dynamic_update_slice — the
+    same pipeline the checkpoint loader uses for Volume→HBM. Host transient
+    = one chunk; the device buffer updates in place."""
+    import numpy as np
+    from functools import partial
+
+    from jax import lax
+
+    size = int(np.prod(shape))
+    rows = shape[0]
+    row_bytes = size // rows
+    chunk_rows = max(1, min(rows, _CHUNK_BYTES // max(row_bytes, 1)))
+    buf = jnp.zeros(shape, jnp.int8)
+    zeros = (0,) * (len(shape) - 1)
+    upd = jax.jit(
+        partial(lambda b, c, i, z: lax.dynamic_update_slice(b, c, (i, *z)), z=zeros),
+        donate_argnums=(0,),
+    )
+    chunk_np = _tile_to(host_tile, chunk_rows * row_bytes).reshape((chunk_rows, *shape[1:]))
+    chunk_dev = jnp.asarray(chunk_np)
+    del chunk_np
+    i = 0
+    while i < rows:
+        r = min(chunk_rows, rows - i)
+        piece = chunk_dev if r == chunk_rows else chunk_dev[:r]
+        buf = upd(buf, piece, jnp.int32(i))
+        i += r
+    return buf
+
+
 def init_params_quantized(cfg, key: jax.Array, fast_host_init: bool = False) -> dict:
     """Random int8 params created DIRECTLY in quantized form — no bf16
     staging, so an 8B model initializes on a 16 GB chip that could never
@@ -91,7 +144,11 @@ def init_params_quantized(cfg, key: jax.Array, fast_host_init: bool = False) -> 
     takes minutes on a single CPU core, which is exactly where the
     chip-unreachable 8B smoke runs (bench.py smoke8b_main). Values still
     span the int8 range; only their statistical independence is reduced,
-    which throughput/memory smokes don't care about."""
+    which throughput/memory smokes don't care about. Large tensors stream
+    into a donated on-device buffer chunk-by-chunk (the weights-loader
+    pattern, models/weights.py _LoadPlan): host transient = one ~64 MiB
+    slab instead of a full-tensor numpy staging copy — on the 8B smoke that
+    staging copy alone was ~1.9 GB of avoidable peak RSS."""
     from .llama import init_params_abstract
 
     abstract = init_params_abstract(cfg)
@@ -106,8 +163,10 @@ def init_params_quantized(cfg, key: jax.Array, fast_host_init: bool = False) -> 
 
             if fast_host_init:
                 size = int(np.prod(spec.shape))
-                reps = -(-size // host_tile.size)
-                q = jnp.asarray(np.tile(host_tile, reps)[:size].reshape(spec.shape))
+                if size >= _CHUNKED_INIT_BYTES and spec.shape[0] > 1:
+                    q = _fill_int8_chunked(spec.shape, host_tile)
+                else:
+                    q = jnp.asarray(_tile_to(host_tile, size).reshape(spec.shape))
             else:
                 kq = jax.random.fold_in(key, zlib.crc32(path_key.encode()))
                 q = jax.random.randint(kq, spec.shape, -127, 128, dtype=jnp.int8)

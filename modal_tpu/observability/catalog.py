@@ -109,6 +109,35 @@ BLOB_REQUESTS = REGISTRY.counter(
     ("route", "code"),
 )
 
+# -- tensor data plane (zero-copy serialization + streaming loads) ------------
+
+SERIALIZED_BYTES = REGISTRY.counter(
+    "modal_tpu_serialized_bytes_total",
+    "Payload bytes produced by serialize(), by placement (oob=zero-copy raw segment, inband=pickle stream).",
+    ("placement",),
+)
+DATAPLANE_COPY_BYTES = REGISTRY.counter(
+    "modal_tpu_dataplane_copy_bytes_total",
+    "Full-size memcpys the payload path could not avoid, by site (join=inline proto field, legacy=non-framed fallback).",
+    ("site",),
+)
+BLOB_SPILLS = REGISTRY.counter(
+    "modal_tpu_blob_spills_total",
+    "Blob downloads spilled to disk and returned as mmap-backed views instead of bytes.",
+)
+WEIGHTS_LOADED_BYTES = REGISTRY.counter(
+    "modal_tpu_weights_loaded_bytes_total",
+    "Checkpoint bytes streamed source→host→device by the weights loader.",
+)
+WEIGHTS_LOAD_GBPS = REGISTRY.gauge(
+    "modal_tpu_weights_load_gbps",
+    "Most recent checkpoint-load throughput (GB/s, ranged source reads overlapped with device placement).",
+)
+PEAK_RSS_BYTES = REGISTRY.gauge(
+    "modal_tpu_peak_rss_bytes",
+    "Process peak RSS (ru_maxrss), sampled at data-plane checkpoints (weights-load finish, bench roll-up).",
+)
+
 # -- chaos --------------------------------------------------------------------
 
 CHAOS_SEED = REGISTRY.gauge(
@@ -125,6 +154,17 @@ CHAOS_EVENTS = REGISTRY.counter(
     "Scheduled chaos lifecycle events fired (worker_kill|worker_preempt|heartbeat_blackhole).",
     ("kind",),
 )
+
+
+def observe_peak_rss() -> float:
+    """Sample ru_maxrss into the PEAK_RSS_BYTES gauge; returns bytes."""
+    import resource
+    import sys
+
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    rss *= 1 if sys.platform == "darwin" else 1024  # linux reports KiB
+    PEAK_RSS_BYTES.set(rss)
+    return float(rss)
 
 
 METRIC_CATALOG: dict[str, str] = {m: REGISTRY.get(m).help for m in REGISTRY.names()}

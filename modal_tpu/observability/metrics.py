@@ -288,6 +288,17 @@ class MetricsRegistry:
         _tot("modal_tpu_client_rpc_retries_total", "client_rpc_retries")
         _tot("modal_tpu_chaos_injections_total", "chaos_injections")
         _tot("modal_tpu_worker_preemptions_total", "worker_preemptions")
+        # tensor data plane: how many payload bytes rode out-of-band vs were
+        # copied, spills, and the latest streaming-load throughput
+        _tot("modal_tpu_serialized_bytes_total", "serialized_bytes")
+        _tot("modal_tpu_dataplane_copy_bytes_total", "dataplane_copy_bytes")
+        _tot("modal_tpu_blob_spills_total", "blob_spills")
+        _tot("modal_tpu_weights_loaded_bytes_total", "weights_loaded_bytes")
+        gbps = self.get("modal_tpu_weights_load_gbps")
+        if isinstance(gbps, Gauge):
+            v = gbps.value()
+            if v:
+                summary["weights_load_gbps"] = round(v, 3)
         return summary
 
 

@@ -24,7 +24,7 @@ from ..client import _Client
 from ..config import config, logger
 from ..exception import InputCancellation
 from ..proto import api_pb2
-from ..serialization import deserialize, serialize, serialize_exception
+from ..serialization import deserialize, serialize_exception, serialize_payload_data_format
 from . import execution_context
 
 MAX_OUTPUT_BATCH_SIZE = 20  # reference container_io_manager.py:874
@@ -213,6 +213,10 @@ class ContainerIOManager:
                     if item.input.args_blob_id:
                         from .._utils.blob_utils import blob_download
 
+                        # large args spill to disk and arrive as an
+                        # mmap-backed view: the container never holds the
+                        # serialized payload AND its deserialized tensors as
+                        # two anonymous-RSS copies (tensors alias the mmap)
                         raw = await blob_download(item.input.args_blob_id, self.stub)
                     fmt = item.input.data_format or api_pb2.DATA_FORMAT_PICKLE
                     if not raw:
@@ -320,17 +324,14 @@ class ContainerIOManager:
         return flushed
 
     async def format_result(self, value: Any, data_format: int = api_pb2.DATA_FORMAT_PICKLE) -> api_pb2.GenericResult:
-        if data_format == api_pb2.DATA_FORMAT_CBOR:
-            from ..serialization import serialize_data_format
-
-            data = serialize_data_format(value, data_format)
-        else:
-            data = serialize(value)
+        # zero-copy: large tensor results serialize as out-of-band segments
+        # and stream to the blob store without a join (docs/DATAPLANE.md)
+        payload = serialize_payload_data_format(value, data_format)
         result = api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS, data_format=data_format)
-        if len(data) > MAX_OBJECT_SIZE_BYTES:
-            result.data_blob_id = await blob_upload(data, self.stub)
+        if payload.nbytes > MAX_OBJECT_SIZE_BYTES:
+            result.data_blob_id = await blob_upload(payload, self.stub)
         else:
-            result.data = data
+            result.data = payload.join()
         return result
 
     def format_exception(self, exc: BaseException) -> api_pb2.GenericResult:
@@ -349,12 +350,12 @@ class ContainerIOManager:
         )
 
     async def push_generator_data(self, function_call_id: str, value: Any) -> None:
-        data = serialize(value)
+        payload = serialize_payload_data_format(value, api_pb2.DATA_FORMAT_PICKLE)
         chunk = api_pb2.DataChunk(data_format=api_pb2.DATA_FORMAT_PICKLE)
-        if len(data) > MAX_OBJECT_SIZE_BYTES:
-            chunk.data_blob_id = await blob_upload(data, self.stub)
+        if payload.nbytes > MAX_OBJECT_SIZE_BYTES:
+            chunk.data_blob_id = await blob_upload(payload, self.stub)
         else:
-            chunk.data = data
+            chunk.data = payload.join()
         await retry_transient_errors(
             self.stub.FunctionCallPutData,
             api_pb2.FunctionCallPutDataRequest(function_call_id=function_call_id, data_chunks=[chunk]),

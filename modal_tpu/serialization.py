@@ -10,14 +10,26 @@ the container-side unpickler re-binds a hydrated handle against its own
 client. jax arrays are handled natively by cloudpickle via numpy conversion —
 we register a reducer that moves device arrays host-side first so payloads
 never capture live device buffers.
+
+Zero-copy data plane (out-of-band serialization): pickle protocol 5 with a
+``buffer_callback`` moves large contiguous tensor buffers (numpy / jax /
+ml_dtypes arrays) OUT of the pickle stream into raw frame segments, so a
+64 MiB array serializes as a ~1 KiB pickle plus a borrowed memoryview —
+never copied into a BytesIO and never held twice in host RAM. The framed
+wire format (``OOB_MAGIC`` header + buffer table + pickle stream + aligned
+raw segments) is self-describing inside ``DATA_FORMAT_PICKLE``: payloads
+with no large buffers stay plain pickle bytes (old deserializers keep
+working), and ``deserialize`` sniffs the magic so both formats coexist.
+See docs/DATAPLANE.md for the byte layout.
 """
 
 from __future__ import annotations
 
 import io
 import pickle
+import struct
 import traceback as tb_module
-from typing import Any, Optional
+from typing import Any, Optional, Union
 
 import cloudpickle
 
@@ -26,11 +38,58 @@ from .exception import DeserializationError, ExecutionError
 from .proto import api_pb2
 
 PICKLE_PROTOCOL = 4
+# Out-of-band frames pickle with protocol 5 (PickleBuffer support).
+OOB_PICKLE_PROTOCOL = 5
+# Frame magic: first byte can never begin a valid pickle stream (pickle
+# opcodes for PROTO frames start with b"\x80"), so sniffing is unambiguous.
+OOB_MAGIC = b"MTP5"
+OOB_VERSION = 1
+# Buffers below this stay in-band: the frame overhead + extra segment isn't
+# worth it for small arrays, and tiny payloads keep full legacy compat.
+OOB_MIN_BUFFER_BYTES = 64 * 1024
+# Raw segments are aligned so mmap-backed deserialization hands the dtype
+# reconstructors aligned views (friendlier to vectorized loads + device DMA).
+OOB_ALIGN = 64
+# frame header: magic(4) version(1) pad(3) pickle_len(u64) n_buffers(u32)
+_OOB_HEAD = struct.Struct("<4sB3xQI")
+
+
+class Payload:
+    """A serialized payload as a list of buffer segments (bytes/memoryview).
+
+    Large tensor buffers appear as *borrowed* memoryviews over the source
+    arrays — nothing is copied until the payload hits a socket or is
+    ``join()``-ed into contiguous bytes for an inline proto field. Blob
+    uploads stream the segments directly (``blob_utils.blob_upload``), so the
+    only full-size copy on the upload path is the kernel socket write."""
+
+    __slots__ = ("segments", "nbytes")
+
+    def __init__(self, segments: list):
+        self.segments = segments
+        self.nbytes = sum(len(s) for s in segments)
+
+    def join(self) -> bytes:
+        """Materialize as contiguous bytes (one copy — inline-payload path)."""
+        if len(self.segments) == 1:
+            seg = self.segments[0]
+            return seg if isinstance(seg, bytes) else bytes(seg)
+        from .observability.catalog import DATAPLANE_COPY_BYTES
+
+        DATAPLANE_COPY_BYTES.inc(self.nbytes, site="join")
+        return b"".join(self.segments)
+
+    def __len__(self) -> int:
+        return self.nbytes
 
 
 class Pickler(cloudpickle.Pickler):
-    def __init__(self, buf: io.BytesIO):
-        super().__init__(buf, protocol=PICKLE_PROTOCOL)
+    def __init__(self, buf: io.BytesIO, *, protocol: int = PICKLE_PROTOCOL, buffer_callback=None):
+        self._oob = buffer_callback is not None and protocol >= 5
+        if buffer_callback is not None:
+            super().__init__(buf, protocol=protocol, buffer_callback=buffer_callback)
+        else:
+            super().__init__(buf, protocol=protocol)
 
     def persistent_id(self, obj: Any) -> Optional[tuple]:
         from .object import _Object
@@ -53,6 +112,24 @@ class Pickler(cloudpickle.Pickler):
 
             if isinstance(obj, jax.Array):
                 return (_rebuild_numpy, (np.asarray(obj),))
+        if self._oob and "numpy" in sys.modules:
+            import numpy as np
+
+            # numpy's native protocol-5 out-of-band path only covers builtin
+            # dtypes; extension-dtype arrays (ml_dtypes bfloat16/float8) fall
+            # back to an in-band tobytes copy. Reduce them ourselves so bf16
+            # weights ride out-of-band like every other tensor.
+            if (
+                isinstance(obj, np.ndarray)
+                and obj.dtype.isbuiltin != 1  # 0/2: user/registered dtype
+                and not obj.dtype.hasobject
+                and obj.flags.c_contiguous
+                and obj.nbytes >= OOB_MIN_BUFFER_BYTES
+            ):
+                # buffer-protocol export rejects extension dtypes; a flat
+                # uint8 view shares the same memory and exports cleanly
+                raw = obj.reshape(-1).view(np.uint8)
+                return (_rebuild_ndarray, (pickle.PickleBuffer(raw), obj.dtype, obj.shape))
         return super().reducer_override(obj)
 
 
@@ -60,9 +137,15 @@ def _rebuild_numpy(arr):
     return arr
 
 
+def _rebuild_ndarray(buffer, dtype, shape):
+    import numpy as np
+
+    return np.frombuffer(buffer, dtype=dtype).reshape(shape)
+
+
 class Unpickler(pickle.Unpickler):
-    def __init__(self, client, buf: io.BytesIO):
-        super().__init__(buf)
+    def __init__(self, client, buf: io.BytesIO, *, buffers=None):
+        super().__init__(buf, buffers=buffers)
         self.client = client
 
     def persistent_load(self, pid: tuple) -> Any:
@@ -74,15 +157,85 @@ class Unpickler(pickle.Unpickler):
         raise DeserializationError(f"unknown persistent id flag {flag!r}")
 
 
-def serialize(obj: Any) -> bytes:
+def serialize_payload(obj: Any) -> Payload:
+    """Serialize to a segment list, keeping large buffers out-of-band.
+
+    Pickles at protocol 5 with a buffer callback: contiguous buffers ≥
+    ``OOB_MIN_BUFFER_BYTES`` become borrowed memoryview segments in the
+    frame's buffer table; smaller ones are folded back into the pickle
+    stream. When nothing goes out-of-band the result is a single plain
+    protocol-5 pickle segment — no frame, fully legacy-compatible."""
+    oob: list[memoryview] = []
+
+    def _buffer_cb(pb: pickle.PickleBuffer):
+        try:
+            view = pb.raw()
+        except BufferError:  # non-contiguous exotic buffer: keep in-band
+            return True
+        if view.nbytes < OOB_MIN_BUFFER_BYTES:
+            return True  # keep in-band
+        oob.append(view)
+        return False
+
     buf = io.BytesIO()
-    Pickler(buf).dump(obj)
-    return buf.getvalue()
+    Pickler(buf, protocol=OOB_PICKLE_PROTOCOL, buffer_callback=_buffer_cb).dump(obj)
+    stream = buf.getvalue()
+    if not oob:
+        return Payload([stream])
+
+    from .observability.catalog import SERIALIZED_BYTES
+
+    head = _OOB_HEAD.pack(OOB_MAGIC, OOB_VERSION, len(stream), len(oob))
+    table = struct.pack(f"<{len(oob)}Q", *(v.nbytes for v in oob))
+    segments: list = [head + table, stream]
+    offset = len(head) + len(table) + len(stream)
+    for view in oob:
+        pad = -offset % OOB_ALIGN
+        if pad:
+            segments.append(b"\x00" * pad)
+            offset += pad
+        segments.append(view)
+        offset += view.nbytes
+    SERIALIZED_BYTES.inc(sum(v.nbytes for v in oob), placement="oob")
+    SERIALIZED_BYTES.inc(len(stream), placement="inband")
+    return Payload(segments)
 
 
-def deserialize(s: bytes, client: Any = None) -> Any:
+def serialize(obj: Any) -> bytes:
+    """Contiguous-bytes convenience over ``serialize_payload`` (one join).
+    Hot payload paths (_create_input, format_result) use the Payload form
+    directly so large tensors stream to the blob store without this copy."""
+    return serialize_payload(obj).join()
+
+
+def _parse_oob_frame(view: memoryview) -> tuple[memoryview, list[memoryview]]:
+    """(pickle stream view, out-of-band buffer views) — all zero-copy slices
+    of the input buffer (bytes, bytearray, or mmap-backed view alike)."""
+    magic, version, pickle_len, n_buffers = _OOB_HEAD.unpack_from(view, 0)
+    if version != OOB_VERSION:
+        raise DeserializationError(f"unsupported out-of-band frame version {version}")
+    table_off = _OOB_HEAD.size
+    lengths = struct.unpack_from(f"<{n_buffers}Q", view, table_off)
+    offset = table_off + 8 * n_buffers
+    stream = view[offset : offset + pickle_len]
+    offset += pickle_len
+    buffers: list[memoryview] = []
+    for n in lengths:
+        offset += -offset % OOB_ALIGN
+        buffers.append(view[offset : offset + n])
+        offset += n
+    return stream, buffers
+
+
+def deserialize(s: Union[bytes, bytearray, memoryview], client: Any = None) -> Any:
     try:
-        return Unpickler(client, io.BytesIO(s)).load()
+        view = s if isinstance(s, memoryview) else memoryview(s)
+        if view.nbytes >= _OOB_HEAD.size and bytes(view[:4]) == OOB_MAGIC:
+            stream, buffers = _parse_oob_frame(view)
+            # the pickle stream is small (buffers ride out-of-band); the
+            # BytesIO copy here is bytes-of-metadata, not tensor data
+            return Unpickler(client, io.BytesIO(stream), buffers=buffers).load()
+        return Unpickler(client, io.BytesIO(view)).load()
     except DeserializationError:
         raise
     except Exception as exc:
@@ -90,6 +243,15 @@ def deserialize(s: bytes, client: Any = None) -> Any:
             f"Deserialization failed ({type(exc).__name__}: {exc}) — this usually means module versions differ "
             "between the client and the container image."
         ) from exc
+
+
+def serialize_payload_data_format(obj: Any, data_format: int) -> Payload:
+    """Like serialize_data_format but returns a Payload: pickle payloads keep
+    large tensors as zero-copy out-of-band segments; the other formats wrap
+    their contiguous encoding in a single-segment Payload."""
+    if data_format in (api_pb2.DATA_FORMAT_PICKLE, api_pb2.DATA_FORMAT_UNSPECIFIED):
+        return serialize_payload(obj)
+    return Payload([serialize_data_format(obj, data_format)])
 
 
 def serialize_data_format(obj: Any, data_format: int) -> bytes:
@@ -110,10 +272,19 @@ def serialize_data_format(obj: Any, data_format: int) -> bytes:
         raise ExecutionError(f"can't serialize data format {data_format}")
 
 
-def deserialize_data_format(s: bytes, data_format: int, client: Any = None) -> Any:
+def deserialize_data_format(
+    s: Union[bytes, bytearray, memoryview], data_format: int, client: Any = None
+) -> Any:
     if data_format in (api_pb2.DATA_FORMAT_PICKLE, api_pb2.DATA_FORMAT_UNSPECIFIED):
         return deserialize(s, client)
-    elif data_format == api_pb2.DATA_FORMAT_CBOR:
+    # spilled blob downloads arrive as mmap-backed memoryviews; the non-pickle
+    # codecs want contiguous bytes
+    if not isinstance(s, bytes):
+        from .observability.catalog import DATAPLANE_COPY_BYTES
+
+        DATAPLANE_COPY_BYTES.inc(len(s), site="legacy")
+        s = bytes(s)
+    if data_format == api_pb2.DATA_FORMAT_CBOR:
         from ._utils import cbor
 
         return cbor.loads(s)

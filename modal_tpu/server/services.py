@@ -2412,7 +2412,15 @@ class ModalTPUServicer:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"file {request.path!r} not found")
         from .._utils.hash_utils import BLOCK_SIZE
 
-        return api_pb2.VolumeGetFile2Response(file=f, block_size=BLOCK_SIZE)
+        # advertise the HTTP block plane (Range-capable GET /block/{sha}) and
+        # the local block dir: co-located clients pread from page cache,
+        # remote ones stream HTTP without the per-block gRPC proto copy
+        return api_pb2.VolumeGetFile2Response(
+            file=f,
+            block_size=BLOCK_SIZE,
+            block_url_base=self.s.blob_url_base or "",
+            block_local_dir=self.s.block_dir,
+        )
 
     async def VolumeListFiles(self, request, context) -> api_pb2.VolumeListFilesResponse:
         vol = self.s.volumes.get(request.volume_id)

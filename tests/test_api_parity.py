@@ -205,3 +205,75 @@ def test_every_implemented_rpc_is_instrumented():
     assert "modal_tpu_rpc_latency_seconds" in METRIC_CATALOG
     assert "modal_tpu_rpc_total" in METRIC_CATALOG
     assert "modal_tpu_client_rpc_latency_seconds" in METRIC_CATALOG
+
+
+@pytest.mark.observability
+def test_blob_http_routes_chaos_and_metrics_parity(tmp_path):
+    """Instrumentation parity for the HTTP data plane, extended to the
+    Range/streaming routes this repo grew (block GET, volfile GET): every
+    route must (a) pass through the seeded chaos injection under its
+    pseudo-RPC name, and (b) emit the blob bytes/requests counters — for
+    ranged responses, counting the RANGE's bytes, not the file's."""
+    import numpy as np
+
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.blob_utils import _get_range, _put_url
+    from modal_tpu.chaos import BLOB_RPCS, ChaosPolicy
+    from modal_tpu.exception import ExecutionError
+    from modal_tpu.observability.catalog import BLOB_BYTES, BLOB_REQUESTS
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.blob_server import BlobServer
+    from modal_tpu.server.state import ServerState, VolumeState
+
+    # every blob pseudo-RPC chaos knows about, mapped to a request we can fire
+    assert {"BlobPut", "BlobGet", "BlobPutPart", "BlobComplete", "BlockGet", "VolumeFileGet"} <= set(BLOB_RPCS)
+
+    state = ServerState(str(tmp_path / "state"))
+    data = np.random.default_rng(0).integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    # seed a blob, a block, and a volume file pointing at that block
+    with open(state.blob_path("bl-parity"), "wb") as f:
+        f.write(data)
+    sha = "ab" * 32
+    with open(state.block_path(sha), "wb") as f:
+        f.write(data)
+    state.volumes["vo-parity"] = VolumeState(volume_id="vo-parity")
+    state.volumes["vo-parity"].files["ckpt/w.bin"] = api_pb2.VolumeFile(
+        path="ckpt/w.bin", size=len(data), block_sha256_hex=[sha]
+    )
+
+    chaos = ChaosPolicy(seed=7, error_rates={rpc: 1.0 for rpc in BLOB_RPCS})
+    srv = BlobServer(state, chaos=chaos)
+    url = synchronizer.run(srv.start())
+    try:
+        # chaos ON: every GET route 503s under its own pseudo-RPC name
+        for route_url, rpc in [
+            (f"{url}/blob/bl-parity", "BlobGet"),
+            (f"{url}/block/{sha}", "BlockGet"),
+            (f"{url}/volfile/vo-parity/ckpt/w.bin", "VolumeFileGet"),
+        ]:
+            with pytest.raises(ExecutionError):
+                synchronizer.run(_get_range(route_url, 0, 100))
+            assert chaos.injected.get(rpc, 0) > 0, f"{rpc} not injected"
+        with pytest.raises(ExecutionError):
+            synchronizer.run(_put_url(f"{url}/blob/bl-parity2", b"x"))
+        assert chaos.injected.get("BlobPut", 0) > 0
+
+        # chaos OFF: ranged GETs on every route count the range's bytes
+        chaos.error_rates = {}
+        for route_url, route in [
+            (f"{url}/blob/bl-parity", "get"),
+            (f"{url}/block/{sha}", "block_get"),
+            (f"{url}/volfile/vo-parity/ckpt/w.bin", "volfile"),
+        ]:
+            out_before = BLOB_BYTES.value(direction="out")
+            got = synchronizer.run(_get_range(route_url, 1000, 5000))
+            assert got == data[1000:5000]
+            assert BLOB_BYTES.value(direction="out") - out_before == 4000
+            assert BLOB_REQUESTS.value(route=route, code="206") > 0
+
+        # streaming (chunked) PUT counts its bytes in
+        in_before = BLOB_BYTES.value(direction="in")
+        synchronizer.run(_put_url(f"{url}/blob/bl-streamed", [memoryview(data[:100_000])]))
+        assert BLOB_BYTES.value(direction="in") - in_before == 100_000
+    finally:
+        synchronizer.run(srv.stop())

@@ -1,0 +1,437 @@
+"""Zero-copy tensor data plane: out-of-band serialization round-trips,
+wire-format cross-compatibility, allocation guards, blob spill-to-mmap,
+HTTP Range protocol, and the striped Volume read engine.
+
+Covers docs/DATAPLANE.md: the framed OOB wire format must interoperate with
+legacy plain-pickle payloads in BOTH directions, big tensors must never be
+copied into the pickle stream, and downloads past the spill threshold must
+come back mmap-backed instead of as anonymous-RSS bytes.
+"""
+
+import io
+import os
+import tempfile
+import tracemalloc
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# Out-of-band serialization
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip(obj):
+    from modal_tpu.serialization import deserialize, serialize_payload
+
+    return deserialize(serialize_payload(obj).join())
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int8", "bfloat16"])
+def test_oob_roundtrip_dtypes(dtype):
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        arr = np.arange(1 << 18).astype(ml_dtypes.bfloat16)
+    else:
+        arr = np.arange(1 << 18).astype(dtype)
+    out = _roundtrip({"w": arr})["w"]
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    assert np.array_equal(out.astype(np.float64), arr.astype(np.float64))
+
+
+def test_oob_roundtrip_nested_pytree():
+    import ml_dtypes
+
+    tree = {
+        "layers": {
+            "wq": np.random.default_rng(0).standard_normal((256, 512)).astype(np.float32),
+            "scales": [np.arange(1 << 17).astype(ml_dtypes.bfloat16), "meta", 42],
+        },
+        "config": {"n_layers": 2, "names": ("a", "b")},
+        "small": np.arange(10),  # below the OOB threshold: stays in-band
+    }
+    out = _roundtrip(tree)
+    assert np.array_equal(out["layers"]["wq"], tree["layers"]["wq"])
+    got_bf = out["layers"]["scales"][0]
+    assert got_bf.dtype == tree["layers"]["scales"][0].dtype
+    assert np.array_equal(got_bf.astype(np.float32), tree["layers"]["scales"][0].astype(np.float32))
+    assert out["config"] == tree["config"]
+    assert np.array_equal(out["small"], tree["small"])
+
+
+def test_oob_frame_is_detected_and_buffers_borrowed():
+    from modal_tpu.serialization import OOB_MAGIC, serialize_payload
+
+    arr = np.zeros(1 << 20, np.uint8)
+    payload = serialize_payload({"w": arr})
+    assert payload.join()[:4] == OOB_MAGIC
+    # the tensor buffer must be a borrowed view of the source array, not a copy
+    views = [s for s in payload.segments if isinstance(s, memoryview)]
+    assert len(views) == 1 and views[0].nbytes == arr.nbytes
+
+
+def test_legacy_payload_deserializes_with_new_deserializer():
+    """Old payload → new deserializer: pre-PR DATA_FORMAT_PICKLE payloads
+    were plain cloudpickle protocol-4 streams."""
+    import cloudpickle
+
+    from modal_tpu.serialization import deserialize
+
+    tree = {"w": np.arange(1 << 17, dtype=np.float32), "meta": "x"}
+    legacy = cloudpickle.dumps(tree, protocol=4)
+    out = deserialize(legacy)
+    assert np.array_equal(out["w"], tree["w"]) and out["meta"] == "x"
+
+
+def test_new_small_payload_readable_by_legacy_deserializer():
+    """New payload → old deserializer: payloads with no large tensors stay
+    plain pickle (no frame), so a pre-PR peer can still read them."""
+    import pickle
+
+    from modal_tpu.serialization import serialize
+
+    blob = serialize({"a": [1, 2, 3], "b": "x"})
+    assert blob[:1] == b"\x80"  # plain pickle, not a frame
+    assert pickle.loads(blob) == {"a": [1, 2, 3], "b": "x"}
+
+
+def test_oob_deserialize_from_memoryview_zero_copy():
+    """The spill path hands the deserializer an mmap-backed view; tensors
+    must reconstruct as views over it, not copies."""
+    from modal_tpu.serialization import deserialize, serialize_payload
+
+    arr = np.arange(1 << 20, dtype=np.uint8)
+    blob = serialize_payload({"w": arr}).join()
+    out = deserialize(memoryview(blob))["w"]
+    assert np.array_equal(out, arr)
+    assert not out.flags.writeable  # view over read-only payload, not a copy
+    assert out.base is not None
+
+
+def test_serialize_allocation_guard_64mib():
+    """Serializing a 64 MiB array must allocate < 1.1× its size (the old
+    BytesIO pickle path peaked at ~2×: stream copy + getvalue copy)."""
+    from modal_tpu.serialization import serialize_payload
+
+    big = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+    tracemalloc.start()
+    payload = serialize_payload({"w": big})
+    _cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert payload.nbytes >= big.nbytes
+    assert peak < 1.1 * big.nbytes * 0.01 + (1 << 20), (
+        f"serialize allocated {peak} bytes for a borrowed-buffer payload"
+    )
+    # and joining (the inline path) costs exactly one output copy
+    tracemalloc.start()
+    blob = payload.join()
+    _cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert len(blob) == payload.nbytes
+    assert peak < 1.1 * big.nbytes
+
+
+def test_exception_payloads_still_roundtrip():
+    from modal_tpu.serialization import deserialize_exception, serialize_exception
+
+    try:
+        raise ValueError("boom")
+    except ValueError as exc:
+        data, exc_repr, tb_str, ser_tb = serialize_exception(exc)
+    out = deserialize_exception(data, exc_repr, tb_str, None, ser_tb)
+    assert isinstance(out, ValueError) and "boom" in str(out)
+
+
+# ---------------------------------------------------------------------------
+# Blob store: spill-to-mmap downloads, Range protocol, streaming uploads
+# ---------------------------------------------------------------------------
+
+
+def test_blob_download_spills_to_mmap(supervisor, monkeypatch):
+    monkeypatch.setenv("MODAL_TPU_BLOB_SPILL_BYTES", str(1024 * 1024))
+
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.blob_utils import blob_download, blob_upload
+    from modal_tpu.client import _Client
+    from modal_tpu.observability.catalog import BLOB_SPILLS
+
+    payload = np.random.default_rng(5).integers(0, 256, size=3 * 1024 * 1024 + 17, dtype=np.uint8).tobytes()
+    spills_before = BLOB_SPILLS.total()
+
+    async def scenario():
+        client = await _Client.from_env()
+        blob_id = await blob_upload(payload, client.stub)
+        return await blob_download(blob_id, client.stub)
+
+    back = synchronizer.run(scenario())
+    assert isinstance(back, memoryview)  # mmap-backed, not bytes
+    assert bytes(back) == payload
+    assert BLOB_SPILLS.total() == spills_before + 1
+
+
+def test_blob_download_small_stays_bytes(supervisor):
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.blob_utils import blob_download, blob_upload
+    from modal_tpu.client import _Client
+
+    async def scenario():
+        client = await _Client.from_env()
+        blob_id = await blob_upload(b"tiny", client.stub)
+        return await blob_download(blob_id, client.stub)
+
+    assert synchronizer.run(scenario()) == b"tiny"
+
+
+def test_blob_range_protocol(supervisor):
+    """Single ranges, suffix ranges, open ranges, 416 on unsatisfiable —
+    against our own store (docs/DATAPLANE.md Range protocol)."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.blob_utils import (
+        _get_http_session,
+        _get_range,
+        _get_range_into,
+        blob_upload,
+    )
+    from modal_tpu.client import _Client
+    from modal_tpu.exception import ExecutionError
+
+    payload = bytes(range(256)) * 4096  # 1 MiB
+
+    async def scenario():
+        client = await _Client.from_env()
+        blob_id = await blob_upload(payload, client.stub)
+        resp = await client.stub.BlobGet(
+            __import__("modal_tpu.proto.api_pb2", fromlist=["x"]).BlobGetRequest(blob_id=blob_id)
+        )
+        url = resp.download_url
+        async with _get_http_session().head(url) as head_resp:
+            assert int(head_resp.headers["Content-Length"]) == len(payload)
+            assert head_resp.headers.get("Accept-Ranges") == "bytes"
+        assert await _get_range(url, 10, 300) == payload[10:300]
+        assert await _get_range(url, len(payload) - 77, len(payload)) == payload[-77:]
+        # raw recv_into lands the same bytes in a caller buffer
+        buf = bytearray(290)
+        await _get_range_into(url, 10, 300, memoryview(buf))
+        assert bytes(buf) == payload[10:300]
+        with pytest.raises(ExecutionError):
+            await _get_range(url, len(payload) + 5, len(payload) + 10)
+        return True
+
+    assert synchronizer.run(scenario())
+
+
+def test_streaming_segment_upload_roundtrip(supervisor):
+    """A Payload's segments stream to the store without a join; the stored
+    blob is byte-identical to the joined form."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.blob_utils import blob_download, blob_upload
+    from modal_tpu.client import _Client
+    from modal_tpu.serialization import serialize_payload
+
+    tree = {"w": np.random.default_rng(9).standard_normal(1 << 19).astype(np.float32)}
+    payload = serialize_payload(tree)
+    assert len(payload.segments) > 1
+
+    async def scenario():
+        client = await _Client.from_env()
+        blob_id = await blob_upload(payload, client.stub)
+        return await blob_download(blob_id, client.stub)
+
+    back = synchronizer.run(scenario())
+    assert bytes(back) == payload.join()
+
+
+# ---------------------------------------------------------------------------
+# Volume striped reads
+# ---------------------------------------------------------------------------
+
+
+def _put_volume_file(supervisor, data: bytes, path: str = "ckpt/data.bin"):
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.volume import _Volume
+
+    async def scenario():
+        client = await _Client.from_env()
+        vol = await _Volume.ephemeral(client=client)
+        async with vol.batch_upload(force=True) as batch:
+            batch.put_data(data, path)
+        return vol
+
+    return synchronizer.run(scenario())
+
+
+@pytest.fixture
+def multiblock_volume(supervisor):
+    # 2.5 blocks at the 8 MiB block size → exercises striping + EOF clamp
+    data = np.random.default_rng(3).integers(0, 256, size=20 * 1024 * 1024 + 123, dtype=np.uint8).tobytes()
+    vol = _put_volume_file(supervisor, data)
+    return vol, data
+
+
+def test_read_file_into_parallel_file_target(multiblock_volume):
+    vol, data = multiblock_volume
+    with tempfile.NamedTemporaryFile(delete=False) as tmp:
+        tmp_path = tmp.name
+    try:
+        with open(tmp_path, "r+b") as f:
+            got = vol.read_file_into("ckpt/data.bin", f)
+        assert got == len(data)
+        with open(tmp_path, "rb") as f:
+            assert f.read() == data
+    finally:
+        os.unlink(tmp_path)
+
+
+def test_read_file_into_wb_file_target(multiblock_volume):
+    """CLI `volume get` opens the destination "wb" (write-only fd): the
+    striped engine must fall back past mmap and still land every byte."""
+    vol, data = multiblock_volume
+    with tempfile.NamedTemporaryFile(delete=False) as tmp:
+        tmp_path = tmp.name
+    try:
+        with open(tmp_path, "wb") as f:
+            got = vol.read_file_into("ckpt/data.bin", f)
+        assert got == len(data)
+        with open(tmp_path, "rb") as f:
+            assert f.read() == data
+    finally:
+        os.unlink(tmp_path)
+
+
+def test_read_file_into_preserves_trailing_content(multiblock_volume):
+    """Streaming into the middle of an existing larger buffer must not
+    truncate content past the written region."""
+    vol, data = multiblock_volume
+    buf = io.BytesIO(b"x" * (len(data) + 1000))
+    buf.seek(0)
+    got = vol.read_file_into("ckpt/data.bin", buf)
+    assert got == len(data)
+    raw = buf.getvalue()
+    assert raw[: len(data)] == data
+    assert raw[len(data) :] == b"x" * 1000  # trailing content intact
+
+
+def test_read_file_into_bytesio_target(multiblock_volume):
+    vol, data = multiblock_volume
+    buf = io.BytesIO()
+    got = vol.read_file_into("ckpt/data.bin", buf)
+    assert got == len(data)
+    assert buf.getvalue() == data
+
+
+def test_read_file_range_into_all_planes(multiblock_volume):
+    """The three block planes (co-located pread, HTTP recv_into, gRPC) must
+    land identical bytes for a range spanning a block boundary."""
+    vol, data = multiblock_volume
+    offset, length = 8 * 1024 * 1024 - 1000, 2000  # straddles block 0/1
+
+    def read_with():
+        buf = bytearray(length)
+        got = vol.read_file_range_into("ckpt/data.bin", offset, length, buf)
+        assert got == length
+        return bytes(buf)
+
+    expected = data[offset : offset + length]
+    # plane 1: co-located pread (the supervisor's store is on this host)
+    assert read_with() == expected
+    # plane 2: HTTP recv_into (pretend the local dir is not visible)
+    orig = vol._usable_local_block_dir
+    vol._usable_local_block_dir = lambda *a, **k: ""
+    try:
+        assert read_with() == expected
+        # plane 3: gRPC fallback
+        vol._block_http_down = True
+        assert read_with() == expected
+    finally:
+        vol._usable_local_block_dir = orig
+        vol._block_http_down = False
+
+
+def test_read_file_range_eof_clamp(multiblock_volume):
+    vol, data = multiblock_volume
+    # range running past EOF clamps; offset past EOF reads nothing
+    tail = vol.read_file_range("ckpt/data.bin", len(data) - 100, 500)
+    assert tail == data[-100:]
+    assert vol.read_file_range("ckpt/data.bin", len(data) + 50, 10) == b""
+
+
+def test_volfile_route_range(multiblock_volume, supervisor):
+    """GET /volfile/{vol}/{path} stitches blocks server-side with Range."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.blob_utils import _get_range
+
+    vol, data = multiblock_volume
+    base = supervisor.state.blob_url_base
+    url = f"{base}/volfile/{vol.object_id}/ckpt/data.bin"
+    lo, hi = 8 * 1024 * 1024 - 10, 8 * 1024 * 1024 + 10  # across blocks
+
+    got = synchronizer.run(_get_range(url, lo, hi))
+    assert got == data[lo:hi]
+
+
+def test_weights_loader_uses_buffer_fill(multiblock_volume):
+    """VolumeSource.read_into lands tensor bytes straight in a caller buffer."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.models.weights import VolumeSource
+
+    vol, data = multiblock_volume
+    src = VolumeSource(vol, "ckpt")
+    buf = bytearray(4096)
+    got = synchronizer.run(src.read_into("data.bin", 1000, 4096, buf))
+    assert got == 4096
+    assert bytes(buf) == data[1000:5096]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: large tensor args/results ride the zero-copy plane
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_large_tensor_arg_and_result(supervisor, monkeypatch):
+    """A >2 MiB array argument goes out-of-band through the blob store and
+    arrives intact; the result rides the same plane back."""
+    monkeypatch.setenv("MODAL_TPU_BLOB_SPILL_BYTES", str(1024 * 1024))
+    import modal_tpu
+
+    app = modal_tpu.App("dataplane-e2e")
+
+    @app.function(serialized=True)
+    def double(arr):
+        return (np.asarray(arr) * 2).astype(arr.dtype)
+
+    arr = np.random.default_rng(1).integers(-100, 100, size=(3 * 1024 * 1024 // 4,), dtype=np.int32)
+    with app.run():
+        out = double.remote(arr)
+    assert np.array_equal(out, arr * 2)
+
+
+# ---------------------------------------------------------------------------
+# Perf microbench (excluded from tier-1 via `slow`; run with `pytest -m perf`)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_bench_dataplane_tool():
+    """tools/bench_dataplane.py emits one parseable JSON line and the
+    striped Volume engine beats the sequential baseline."""
+    import json
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_dataplane.py", "--size-mb", "128", "--skip-blob"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("DATAPLANE_RESULT ")]
+    assert lines, proc.stdout + proc.stderr
+    result = json.loads(lines[-1].split("DATAPLANE_RESULT ", 1)[1])
+    assert result["serialize_gbps"] > 0
+    assert result["volume_parallel_gbps"] > result["volume_seq_gbps"]
