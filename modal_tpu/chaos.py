@@ -67,7 +67,8 @@ class ChaosEvent:
     kinds: ``worker_preempt`` (graceful drain: SIGTERM + grace window, inputs
     requeued, checkpoint flush), ``worker_kill`` (SIGKILL the worker's
     containers, no grace), ``heartbeat_blackhole`` (drop heartbeat RPCs for
-    `duration_s`).
+    `duration_s`), ``supervisor_crash`` (abandon the control plane's state
+    and rebuild it from the write-ahead journal — server/journal.py).
     """
 
     kind: str
@@ -125,9 +126,24 @@ class ChaosPolicy:
         - MODAL_TPU_CHAOS_RPCS ("Name=0.05,Other=0.1" or "Name,Other" using
           the default rate for bare names)
         - MODAL_TPU_CHAOS_LATENCY_MS / _LATENCY_JITTER_MS / _LATENCY_RATE
+        - MODAL_TPU_CHAOS_SUPERVISOR_CRASH_AFTER (int N: crash + journal-
+          recover the control plane once N outputs have been produced;
+          comma-separate for repeated crashes, e.g. "10,30")
         """
         if os.environ.get("MODAL_TPU_CHAOS", "") not in ("1", "true", "yes"):
             return None
+        events: list[ChaosEvent] = []
+        for part in filter(
+            None,
+            (p.strip() for p in os.environ.get("MODAL_TPU_CHAOS_SUPERVISOR_CRASH_AFTER", "").split(",")),
+        ):
+            try:
+                events.append(ChaosEvent(kind="supervisor_crash", after_outputs=int(part)))
+            except ValueError:
+                # a typo'd knob must not kill the supervisor at boot
+                logger.warning(
+                    f"ignoring malformed MODAL_TPU_CHAOS_SUPERVISOR_CRASH_AFTER token {part!r}"
+                )
         default_rate = float(os.environ.get("MODAL_TPU_CHAOS_ERROR_RATE", "0") or 0)
         rates: dict[str, float] = {}
         spec = os.environ.get("MODAL_TPU_CHAOS_RPCS", "")
@@ -145,6 +161,7 @@ class ChaosPolicy:
             latency_ms=float(os.environ.get("MODAL_TPU_CHAOS_LATENCY_MS", "0") or 0),
             latency_jitter_ms=float(os.environ.get("MODAL_TPU_CHAOS_LATENCY_JITTER_MS", "0") or 0),
             latency_rate=float(os.environ.get("MODAL_TPU_CHAOS_LATENCY_RATE", "1") or 1),
+            events=events,
         )
 
     # -- deterministic decision engine --------------------------------------

@@ -450,6 +450,7 @@ def metrics_cmd(url: Optional[str], state_dir: Optional[str], as_json: bool) -> 
 
     from ..config import config as _config
 
+    url_file = None
     if url is None:
         root = state_dir or _config["state_dir"]
         url_file = os.path.join(root, "observability", "metrics_url")
@@ -463,11 +464,111 @@ def metrics_cmd(url: Optional[str], state_dir: Optional[str], as_json: bool) -> 
     try:
         text = urllib.request.urlopen(url, timeout=5).read().decode()
     except (urllib.error.URLError, OSError) as exc:
+        if url_file is not None:
+            # the breadcrumb exists but nothing answers: the supervisor that
+            # wrote it is gone (crashed, or restarted onto another port and
+            # hasn't rewritten the file yet) — say so instead of a raw
+            # connection error that reads like a CLI bug
+            raise click.ClickException(
+                f"metrics endpoint {url} is not answering — the breadcrumb at {url_file} "
+                f"is stale (supervisor not running, or restarting). Start a supervisor or "
+                f"pass --url to scrape one directly. ({exc})"
+            )
         raise click.ClickException(f"scrape of {url} failed: {exc}")
     if as_json:
         click.echo(json.dumps(_parse_prometheus(text), indent=2, sort_keys=True))
     else:
         click.echo(text, nl=False)
+
+
+# ---------------------------------------------------------------------------
+# journal (durable control plane, server/journal.py)
+# ---------------------------------------------------------------------------
+
+
+@cli.group("journal")
+def journal_group() -> None:
+    """Inspect/compact the control plane's write-ahead journal."""
+
+
+def _open_journal(state_dir: Optional[str]):
+    from ..config import config as _config
+    from ..server.journal import Journal
+
+    root = state_dir or _config["state_dir"]
+    jdir = os.path.join(root, "journal")
+    if not os.path.isdir(jdir):
+        raise click.ClickException(
+            f"no journal at {jdir} (has a supervisor with journaling enabled run against "
+            "this state dir? pass --state-dir to point elsewhere)"
+        )
+    return Journal(root)
+
+
+@journal_group.command("status")
+@click.option("--state-dir", default=None, help="Supervisor state dir (default: configured).")
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable status.")
+def journal_status(state_dir: Optional[str], as_json: bool) -> None:
+    """Journal health: sequence position, snapshot coverage, segment sizes,
+    record counts by type."""
+    j = _open_journal(state_dir)
+    st = j.status()
+    j.close()
+    if as_json:
+        click.echo(json.dumps(st, indent=2, sort_keys=True))
+        return
+    click.echo(f"journal {st['dir']}")
+    click.echo(f"  seq {st['seq']}  (snapshot covers <= {st['snapshot_seq']})")
+    click.echo(f"  {st['segments']} segment(s), {st['tail_records']} tail record(s), {st['bytes']} bytes")
+    click.echo(f"  fsync per append: {'on' if st['fsync'] else 'off (page-cache durable)'}")
+    for t, n in st["records_by_type"].items():
+        click.echo(f"    {t:<20} {n}")
+
+
+@journal_group.command("compact")
+@click.option("--state-dir", default=None, help="Supervisor state dir (default: configured).")
+@click.option("--force", is_flag=True, help="Compact even if a supervisor looks live.")
+def journal_compact(state_dir: Optional[str], force: bool) -> None:
+    """Offline compaction: replay the journal into a fresh state, write a
+    snapshot, prune covered segments. A LIVE supervisor compacts itself
+    periodically — refuse if one appears to be running (its open segment
+    would race this tool) unless --force."""
+    import urllib.request
+
+    from ..config import config as _config
+    from ..server.journal import recover_state, synthesize_records
+    from ..server.state import ServerState
+
+    root = state_dir or _config["state_dir"]
+    url_file = os.path.join(root, "observability", "metrics_url")
+    if not force and os.path.exists(url_file):
+        with open(url_file) as f:
+            url = f.read().strip()
+        try:
+            urllib.request.urlopen(url, timeout=2).read()
+            raise click.ClickException(
+                f"a live supervisor answers at {url} — it compacts its own journal; "
+                "use --force to compact anyway (risks racing its open segment)"
+            )
+        except click.ClickException:
+            raise
+        except Exception:  # noqa: BLE001 — dead breadcrumb: safe to compact
+            pass
+    j = _open_journal(state_dir)
+    before = j.status()
+    state = ServerState(root)
+    from ..server.journal import IdempotencyCache
+
+    state.idempotency = IdempotencyCache(journal=None)
+    report = recover_state(state, j)
+    j.write_snapshot(synthesize_records(state))
+    after = j.status()
+    j.close()
+    click.echo(
+        f"compacted: {before['tail_records']} tail record(s) -> snapshot at seq {after['snapshot_seq']} "
+        f"({before['bytes']} -> {after['bytes']} bytes); "
+        f"replayed {report['records_applied']} record(s), {report['open_calls']} open call(s)"
+    )
 
 
 def _parse_prometheus(text: str) -> dict:

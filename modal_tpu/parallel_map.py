@@ -89,12 +89,17 @@ class _ControlPlaneMapTransport:
         self, call_id: str, input_id: str, retry_count: int, idx: int,
         item: Optional[api_pb2.FunctionPutInputsItem],
     ) -> None:
+        # restart-sized retry window: a supervisor crash-recovery takes
+        # seconds, and a failed re-submission permanently hangs this input's
+        # slot in the map — ride out the outage like put_batch does
         await retry_transient_errors(
             self.stub.FunctionRetryInputs,
             api_pb2.FunctionRetryInputsRequest(
                 function_call_jwt=call_id,
                 inputs=[api_pb2.FunctionRetryInputsItem(input_id=input_id, retry_count=retry_count)],
             ),
+            max_retries=8,
+            max_delay=15.0,
         )
 
     def discard(self, idx: int) -> None:

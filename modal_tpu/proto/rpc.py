@@ -360,6 +360,48 @@ def _instrument_stream(name: str, impl: Any) -> Any:
     return instrumented
 
 
+def _maybe_dedupe(servicer: Any, method: "RPCMethod", impl: Any) -> Any:
+    """Exactly-once layer for mutating RPCs (server/journal.py): when the
+    servicer carries a journal-backed IdempotencyCache and the method is in
+    IDEMPOTENT_RPCS, a request whose ``x-idempotency-key`` was already
+    answered replays the cached response instead of re-executing — a
+    ``retry_transient_errors`` re-send after a dropped response or a
+    supervisor restart cannot double-apply its effect.
+
+    Known window (documented in docs/RECOVERY.md): the dedupe record is
+    appended AFTER the handler's effect records, so a crash landing exactly
+    between them makes the client's retry re-execute the handler. For the
+    map plane that residue is harmless — duplicate inputs share an idx and
+    the client's finalized-idx set drops the duplicate output — and the
+    window is one buffered flush (~µs); closing it fully needs multi-record
+    atomic appends, deliberately out of scope."""
+    from ..server.journal import IDEMPOTENT_RPCS  # lazy: proto must not pull server at import
+
+    cache = getattr(servicer, "idempotency", None)
+    if cache is None or method.name not in IDEMPOTENT_RPCS:
+        return impl
+
+    async def deduped(request, context, _impl=impl, _name=method.name, _resp=method.response_type):
+        key = ""
+        for md_key, md_value in context.invocation_metadata() or ():
+            if md_key == "x-idempotency-key":
+                key = md_value if isinstance(md_value, str) else md_value.decode("utf-8", "replace")
+                break
+        if key:
+            hit = cache.get(key, _name)
+            if hit is not None:
+                from ..observability.catalog import IDEMPOTENT_REPLAYS
+
+                IDEMPOTENT_REPLAYS.inc(method=_name)
+                return _resp.FromString(hit)
+        response = await _impl(request, context)
+        if key:
+            cache.put(key, _name, response.SerializeToString())
+        return response
+
+    return deduped
+
+
 def _build_handler(
     servicer: Any, registry: dict[str, RPCMethod], service_name: str
 ) -> "grpc.GenericRpcHandler":
@@ -376,7 +418,7 @@ def _build_handler(
         )
         if method.arity == Arity.UNARY_UNARY:
             handlers[method.name] = grpc.unary_unary_rpc_method_handler(
-                _instrument_unary(method.name, impl), **kwargs
+                _instrument_unary(method.name, _maybe_dedupe(servicer, method, impl)), **kwargs
             )
         elif method.arity == Arity.UNARY_STREAM:
             handlers[method.name] = grpc.unary_stream_rpc_method_handler(

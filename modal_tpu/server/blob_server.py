@@ -59,8 +59,17 @@ class BlobServer:
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
-        await site.start()
+        try:
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+        except OSError:
+            if not self.port:
+                raise
+            # requested port unavailable (crashed predecessor's socket may
+            # linger): fall back to an ephemeral one
+            logger.warning(f"blob server port {self.port} unavailable; binding ephemeral")
+            site = web.TCPSite(self._runner, self.host, 0)
+            await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
         url = f"http://{self.host}:{self.port}"
         self.state.blob_url_base = url
@@ -85,6 +94,16 @@ class BlobServer:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+        # clean shutdown: drop the breadcrumb iff it still points at US — a
+        # crash leaves it behind (the CLI then reports it as stale), and a
+        # NEWER supervisor's breadcrumb must not be deleted by an old one
+        try:
+            crumb = os.path.join(self.state.state_dir, "observability", "metrics_url")
+            with open(crumb) as f:
+                if f.read().strip() == f"http://{self.host}:{self.port}/metrics":
+                    os.unlink(crumb)
+        except OSError:
+            pass
 
     async def _token_flow_approve(self, request: web.Request) -> web.Response:
         flow_id = request.match_info["flow_id"]

@@ -64,6 +64,11 @@ class InputPlaneServicer:
     def _mint_attempt(self, call_id: str, input_id: str, supersedes: str = "") -> str:
         token = make_id("at")
         self.s.attempts[token] = (call_id, input_id, time.monotonic())
+        # journaled so a client awaiting this attempt across a control-plane
+        # restart resumes instead of NOT_FOUND-ing (server/journal.py)
+        self.control._j(
+            "attempt", token=token, call_id=call_id, input_id=input_id, supersedes=supersedes
+        )
         if supersedes:
             # the replaced attempt's token must stop resolving
             self.s.attempts.pop(supersedes, None)
@@ -86,6 +91,15 @@ class InputPlaneServicer:
             call_type=call_type,
         )
         self.s.function_calls[call.function_call_id] = call
+        # journal via the control servicer (one sink for both planes): a
+        # crash mid-map must recover input-plane calls too, or the client's
+        # MapAwait resumes into NOT_FOUND
+        self.control._j(
+            "call",
+            function_call_id=call.function_call_id,
+            function_id=function_id,
+            call_type=call_type,
+        )
         return call
 
     async def _enqueue(self, fn, call, item: api_pb2.FunctionPutInputsItem) -> str:
@@ -150,18 +164,36 @@ class InputPlaneServicer:
         already handed them out)."""
         if prune_output:
             call.outputs[:] = [o for o in call.outputs if o.input_id != inp.input_id]
-        # the failed attempt's output already counted toward num_done; the
-        # retry will count again — keep num_unfinished_inputs truthful
-        call.num_done = max(0, call.num_done - 1)
+        was_done = inp.status == "done"
+        if was_done:
+            # the failed attempt's output already counted toward num_done; the
+            # retry will count again — keep num_unfinished_inputs truthful.
+            # Conditional: retrying an input that never delivered must not
+            # steal a count from a different completed input (and the journal
+            # replay guards its decrement with undo_done the same way).
+            call.num_done = max(0, call.num_done - 1)
         inp.status = "pending"
         inp.retry_count += 1
+        payload_update = None
         if new_input is not None and new_input.WhichOneof("args_oneof"):
             inp.input.CopyFrom(new_input)
+            payload_update = inp.input.SerializeToString()
         inp.delivered_to.clear()
         inp.claimed_by = ""
         inp.claimed_at = 0.0
         if inp.input_id not in fn.pending:
             fn.pending.append(inp.input_id)
+        rec: dict = {
+            "input_id": inp.input_id,
+            "retry_count": inp.retry_count,
+            "undo_done": was_done,
+            "prune_output": prune_output,
+        }
+        if payload_update is not None:
+            from .journal import _b64
+
+            rec["input"] = _b64(payload_update)
+        self.control._j("input_retry", **rec)
         return self._mint_attempt(call.function_call_id, inp.input_id, supersedes=supersedes)
 
     async def AttemptRetry(self, request: api_pb2.AttemptRetryRequest, context) -> api_pb2.AttemptRetryResponse:
@@ -274,7 +306,14 @@ class InputPlaneServer:
 
             handler_target = ChaosServicerProxy(self.servicer, self.chaos)
         self._server.add_generic_rpc_handlers((build_generic_handler(handler_target),))
+        requested = self.port
         self.port = self._server.add_insecure_port(f"127.0.0.1:{self.port}")
+        if self.port == 0 and requested:
+            # requested port unavailable (e.g. the crashed predecessor's
+            # socket lingering): fall back to an ephemeral one — clients with
+            # the old URL lose input-plane locality but the plane stays up
+            logger.warning(f"input plane port {requested} unavailable; binding ephemeral")
+            self.port = self._server.add_insecure_port("127.0.0.1:0")
         self.state.input_plane_url = f"grpc://127.0.0.1:{self.port}"
         await self._server.start()
         logger.debug(f"input plane up at {self.state.input_plane_url}")

@@ -1,0 +1,1284 @@
+"""Durable control plane: write-ahead journal + crash recovery.
+
+The supervisor's ``ServerState`` is volatile dataclasses; before this module a
+control-plane crash lost every in-flight ``.map()`` even though workers (PR 1)
+survive preemption. The journal makes the control plane's *logical* state —
+apps, functions, function calls, inputs, delivered outputs, named objects,
+worker registrations, idempotency dedupe entries — replayable:
+
+- **Records**: every mutating RPC in ``server/services.py`` (and the
+  scheduler's worker-deregistration transition) appends one typed,
+  monotonically-sequenced JSON record to ``<state_dir>/journal/``
+  (``segment-<n>.jsonl``). Records are compact effect descriptions, not RPC
+  requests, so replay is deterministic regardless of handler internals.
+- **Snapshots**: ``compact()`` synthesizes the records that would rebuild the
+  CURRENT state and writes them as ``snapshot-<seq>.jsonl``; segments fully
+  covered by the snapshot are pruned. Snapshot loading and tail replay share
+  one applier table (``_APPLIERS``) — there is no second deserializer to
+  drift.
+- **Recovery** (``recover_state``): apply snapshot + tail into a fresh
+  ``ServerState``. Claims are deliberately NOT journaled: an input that was
+  claimed at crash time recovers as *pending* (requeued for free, its
+  journaled ``resume_token`` intact), tasks/clusters recover as gone (the
+  scheduler relaunches from the backlog), and journaled workers recover in
+  ``adoption_pending`` until their next heartbeat re-adopts them.
+- **Exactly-once**: outputs carry dedupe keys (``input_id:retry_count``)
+  applied at append time, so a requeued input whose dead attempt already
+  reported cannot double-deliver; mutating RPCs are deduped by the client's
+  ``x-idempotency-key`` via a journal-backed seen-set (``IdempotencyCache``),
+  so a reconnect storm of ``retry_transient_errors`` re-sends after a
+  supervisor restart replays cached responses instead of re-executing.
+
+Durability model: appends are flushed to the OS (no fsync by default) — a
+``kill -9`` of the supervisor process loses nothing because the page cache
+survives the process; set ``MODAL_TPU_JOURNAL_FSYNC=1`` to also survive host
+power loss at a per-append fsync cost.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Iterable, Optional
+
+from ..config import logger
+from ..observability.catalog import JOURNAL_APPEND_SECONDS, JOURNAL_APPENDS, JOURNAL_BYTES
+from ..proto import api_pb2
+
+JOURNAL_DIRNAME = "journal"
+# segment roll size: small enough that compaction reclaims space promptly,
+# large enough that a soak doesn't churn file handles
+SEGMENT_MAX_RECORDS = int(os.environ.get("MODAL_TPU_JOURNAL_SEGMENT_RECORDS", "4096"))
+# auto-compaction threshold (scheduler reap tick calls maybe_compact)
+COMPACT_EVERY_RECORDS = int(os.environ.get("MODAL_TPU_JOURNAL_COMPACT_EVERY", "20000"))
+# idempotency seen-set bound (journal-backed; oldest evicted first)
+IDEMPOTENCY_MAX_ENTRIES = int(os.environ.get("MODAL_TPU_IDEMPOTENCY_MAX", "8192"))
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(bytes(data)).decode("ascii")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode("ascii"))
+
+
+# ---------------------------------------------------------------------------
+# RPC journal-coverage map — the declarative contract the parity test
+# (tests/test_api_parity.py) checks against server/services.py: every
+# implemented mutating RPC must be journaled or carry an explicit exemption.
+# ---------------------------------------------------------------------------
+
+# RPCs whose state effects are journaled (directly, or via the journaled
+# helpers they call: _enqueue_input, _append_output, _stop_app).
+JOURNALED_RPCS = frozenset(
+    {
+        "AppCreate",
+        "AppGetOrCreate",
+        "AppPublish",
+        "AppClientDisconnect",
+        "AppStop",
+        "AppDeploy",
+        "FunctionCreate",
+        "FunctionBindParams",
+        "FunctionUpdateSchedulingParams",
+        "FunctionMap",
+        "FunctionPutInputs",
+        "FunctionRetryInputs",
+        "FunctionGetOutputs",  # journals consumption (clear_on_success takes)
+        "FunctionPutOutputs",
+        "FunctionCallCancel",
+        "ContainerCheckpoint",  # resume tokens survive the restart
+        "TaskResult",  # input retry/fail outcomes via _append_output/input_retry
+        "ImageGetOrCreate",
+        "ImageDelete",
+        "VolumeGetOrCreate",
+        "VolumePutFiles2",
+        "VolumeRemoveFile",
+        "VolumeCopyFiles",
+        "VolumeCommit",
+        "VolumeRename",
+        "VolumeDelete",
+        "SecretGetOrCreate",
+        "SecretDelete",
+        "ProxyCreate",
+        "ProxyDelete",
+        "DictGetOrCreate",
+        "DictDelete",
+        "QueueGetOrCreate",
+        "QueueDelete",
+        "EnvironmentCreate",
+        "EnvironmentDelete",
+        "EnvironmentUpdate",
+        "WorkspaceSettingsSet",
+        "TokenFlowWait",  # granted tokens survive the restart
+        "WorkerRegister",
+    }
+)
+
+# Mutating RPCs deliberately NOT journaled, with the reason (the parity test
+# prints these so an exemption is a decision, not an accident).
+EXEMPT_RPCS: dict[str, str] = {
+    # liveness timestamps: rebuilt by the next heartbeat, meaningless stale
+    "AppHeartbeat": "liveness timestamp; next heartbeat rebuilds it",
+    "ContainerHeartbeat": "liveness timestamp; container is process-bound",
+    "WorkerHeartbeat": "liveness + drain state; re-announced by the worker",
+    "EphemeralObjectHeartbeat": "liveness timestamp for ephemeral objects",
+    # container/task runtime state: process-bound, recovery relaunches tasks
+    "ContainerHello": "task runtime state; tasks do not survive the crash",
+    "ContainerStop": "task runtime state; tasks do not survive the crash",
+    "FunctionGetInputs": "claims are transient by design: recovery requeues claimed inputs",
+    "TaskClusterHello": "gang rendezvous state; gangs relaunch from the backlog",
+    "ContainerLog": "log streams are best-effort; documented as lost on crash",
+    "FunctionCallPutData": "generator data chunks are an ephemeral stream (can be GiB-scale)",
+    "FunctionSetWebUrl": "runtime-transient; the serving container re-reports it",
+    # on-disk content-addressed stores are already durable
+    "MountPutFile": "content-addressed block store on disk is already durable",
+    "MountGetOrCreate": "manifest is stored as an on-disk block",
+    "VolumeBlockPut": "content-addressed block store on disk is already durable",
+    "BlobCreate": "mints an id + presigned URL only; blob bytes land on disk",
+    # sandboxes run as supervisor-host subprocesses: they cannot survive the
+    # control plane's host crashing, so their registry is not journaled
+    "SandboxCreate": "sandbox processes are supervisor-host-bound",
+    "SandboxTerminate": "sandbox processes are supervisor-host-bound",
+    "SandboxStdinWrite": "sandbox processes are supervisor-host-bound",
+    "SandboxSnapshotFs": "snapshot blob lands on disk; record is re-creatable",
+    "SandboxSnapshot": "snapshot blob lands on disk; record is re-creatable",
+    "SandboxRestore": "sandbox processes are supervisor-host-bound",
+    "SandboxSidecarCreate": "sandbox processes are supervisor-host-bound",
+    "SandboxSidecarStop": "sandbox processes are supervisor-host-bound",
+    "SandboxSidecarExit": "sandbox processes are supervisor-host-bound",
+    "TaskTunnelsUpdate": "tunnel listeners die with the supervisor process",
+    "TaskReady": "sandbox readiness is process-bound",
+    "TunnelStart": "tunnel listeners die with the supervisor process",
+    "TunnelStop": "tunnel listeners die with the supervisor process",
+    # ephemeral data-plane payloads (documented): dict/queue DATA is not
+    # journaled — their registry (ids, names) is
+    "DictUpdate": "ephemeral data-plane payload (registry IS journaled)",
+    "DictPop": "ephemeral data-plane payload (registry IS journaled)",
+    "DictClear": "ephemeral data-plane payload (registry IS journaled)",
+    "QueuePut": "ephemeral data-plane payload (registry IS journaled)",
+    "QueueGet": "ephemeral data-plane payload (registry IS journaled)",
+    "QueueClear": "ephemeral data-plane payload (registry IS journaled)",
+    "TokenFlowCreate": "pending browser flows are transient until granted",
+}
+
+# Mutating RPCs whose responses are deduped via the client's idempotency key
+# (journal-backed seen-set): a retried request after a response loss or a
+# supervisor restart replays the cached response instead of re-executing.
+IDEMPOTENT_RPCS = frozenset(
+    {
+        "FunctionMap",
+        "FunctionPutInputs",
+        "FunctionRetryInputs",
+        "FunctionPutOutputs",
+        "AppCreate",
+        "AppGetOrCreate",
+        "FunctionCreate",
+        "FunctionBindParams",
+    }
+)
+
+
+# ---------------------------------------------------------------------------
+# The journal itself
+# ---------------------------------------------------------------------------
+
+
+class Journal:
+    """Append-only JSONL segments + compacted snapshots under
+    ``<state_dir>/journal/``. Single-writer (the supervisor's event loop);
+    appends are synchronous and cheap (~µs: dict → json line → buffered
+    write + flush)."""
+
+    def __init__(self, state_dir: str, fsync: Optional[bool] = None):
+        self.dir = os.path.join(state_dir, JOURNAL_DIRNAME)
+        os.makedirs(self.dir, exist_ok=True)
+        try:
+            # records carry granted token secrets and secret env dicts in
+            # plaintext: the journal dir is owner-only, like auth.secret
+            os.chmod(self.dir, 0o700)
+        except OSError:
+            pass
+        self.fsync = (
+            fsync
+            if fsync is not None
+            else os.environ.get("MODAL_TPU_JOURNAL_FSYNC", "0") in ("1", "true", "yes")
+        )
+        self.seq = 0
+        self._segment_index = 0
+        self._segment_records = 0
+        self._records_since_snapshot = 0
+        self._fh = None
+        self._pending_appends: dict[str, int] = {}
+        self._pending_bytes = 0
+        # segment name -> max seq it holds (maintained as segments roll so
+        # compaction's prune decision never re-reads segment files on the
+        # supervisor's event loop)
+        self._segment_max_seq: dict[str, int] = {}
+        self._scan()
+
+    # -- layout -------------------------------------------------------------
+
+    def _segment_path(self, index: int) -> str:
+        return os.path.join(self.dir, f"segment-{index:08d}.jsonl")
+
+    def _snapshot_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"snapshot-{seq:012d}.jsonl")
+
+    def _list(self, prefix: str) -> list[str]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        return sorted(n for n in names if n.startswith(prefix) and n.endswith(".jsonl"))
+
+    def _scan(self) -> None:
+        """Recover seq / segment cursor from an existing journal dir. Reads
+        only each segment's trailing valid line (appends are in seq order, so
+        the last parseable record carries the segment's max seq) — JSON-
+        parsing every record here would double recovery's read cost."""
+        segments = self._list("segment-")
+        snapshots = self._list("snapshot-")
+        max_seq = 0
+        if snapshots:
+            max_seq = int(snapshots[-1][len("snapshot-") : -len(".jsonl")])
+        if segments:
+            self._segment_index = int(segments[-1][len("segment-") : -len(".jsonl")])
+        for name in segments:
+            seg_max = _last_seq(os.path.join(self.dir, name))
+            self._segment_max_seq[name] = seg_max
+            max_seq = max(max_seq, seg_max)
+        self.seq = max_seq
+
+    def has_records(self) -> bool:
+        return bool(self._list("segment-")) or bool(self._list("snapshot-"))
+
+    # -- append -------------------------------------------------------------
+
+    def _open_segment(self) -> None:
+        if self._fh is None or self._segment_records >= SEGMENT_MAX_RECORDS:
+            if self._fh is not None:
+                self._fh.close()
+            self._segment_index += 1
+            self._segment_records = 0
+            path = self._segment_path(self._segment_index)
+            self._fh = open(path, "a", buffering=1024 * 64)
+            try:
+                os.chmod(path, 0o600)  # records can carry secrets
+            except OSError:
+                pass
+
+    def _note_seq(self) -> None:
+        self._segment_max_seq[os.path.basename(self._fh.name)] = self.seq
+
+    # metric sampling stride: per-append counter/histogram updates would cost
+    # more than the append itself on the RPC hot path, so instrumentation is
+    # accumulated locally and flushed every Nth append (documented in the
+    # catalog help strings via "sampled")
+    _METRIC_SAMPLE_EVERY = 32
+
+    def append(self, t: str, **payload: Any) -> int:
+        """Append one typed record; returns its sequence number."""
+        sample = (self.seq % self._METRIC_SAMPLE_EVERY) == 0
+        t0 = time.perf_counter() if sample else 0.0
+        if self._fh is None or self._segment_records >= SEGMENT_MAX_RECORDS:
+            self._open_segment()
+        self.seq += 1
+        payload["seq"] = self.seq
+        payload["t"] = t
+        line = json.dumps(payload, separators=(",", ":")) + "\n"
+        self._fh.write(line)
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        self._segment_records += 1
+        self._records_since_snapshot += 1
+        self._note_seq()
+        self._pending_appends[t] = self._pending_appends.get(t, 0) + 1
+        self._pending_bytes += len(line)
+        if sample:
+            JOURNAL_APPEND_SECONDS.observe(time.perf_counter() - t0)
+            for rec_t, n in self._pending_appends.items():
+                JOURNAL_APPENDS.inc(n, type=rec_t)
+            self._pending_appends.clear()
+            JOURNAL_BYTES.inc(self._pending_bytes)
+            self._pending_bytes = 0
+        return self.seq
+
+    def records_since_snapshot(self) -> int:
+        return self._records_since_snapshot
+
+    # -- read / replay ------------------------------------------------------
+
+    def replay(self) -> tuple[list[dict], list[dict]]:
+        """(snapshot_records, tail_records): the latest snapshot's synthesized
+        records plus every segment record with seq > snapshot seq, in order.
+        Torn trailing lines (crash mid-write) are tolerated and skipped."""
+        snapshots = self._list("snapshot-")
+        snap_records: list[dict] = []
+        snap_seq = 0
+        if snapshots:
+            snap_seq = int(snapshots[-1][len("snapshot-") : -len(".jsonl")])
+            snap_records = list(_read_records(os.path.join(self.dir, snapshots[-1])))
+        tail: list[dict] = []
+        for name in self._list("segment-"):
+            for rec in _read_records(os.path.join(self.dir, name)):
+                if int(rec.get("seq", 0)) > snap_seq:
+                    tail.append(rec)
+        tail.sort(key=lambda r: int(r.get("seq", 0)))
+        return snap_records, tail
+
+    # -- snapshot / compaction ----------------------------------------------
+
+    @staticmethod
+    def _write_snapshot_file(records: Iterable[dict], path: str) -> None:
+        """Pure file write (tmp + fsync + rename): touches no Journal state,
+        so the async compaction path can push it to a thread."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.chmod(tmp, 0o600)  # records can carry secrets
+        except OSError:
+            pass
+        os.replace(tmp, path)
+
+    def _finish_snapshot(self, path: str, covered_seq: int) -> None:
+        """Prune what the snapshot at `covered_seq` covers. Uses the
+        in-memory per-segment max-seq map — re-reading every segment here
+        would stall the event loop the append hot path runs on. The live
+        segment is rolled (and so pruned) only when it holds nothing past
+        `covered_seq` — appends that landed while the snapshot file was being
+        written stay in the tail."""
+        from ..observability.catalog import JOURNAL_COMPACTIONS
+
+        live = os.path.basename(self._fh.name) if self._fh is not None else None
+        if live is not None and self._segment_max_seq.get(live, 0) <= covered_seq:
+            self._fh.close()
+            self._fh = None
+            self._segment_records = 0
+            live = None
+        for name in self._list("segment-"):
+            if name == live:
+                continue
+            seg_max = self._segment_max_seq.get(name)
+            if seg_max is not None and seg_max <= covered_seq:
+                os.unlink(os.path.join(self.dir, name))
+                self._segment_max_seq.pop(name, None)
+        for name in self._list("snapshot-"):
+            if os.path.join(self.dir, name) != path:
+                os.unlink(os.path.join(self.dir, name))
+        self._records_since_snapshot = max(0, self.seq - covered_seq)
+        JOURNAL_COMPACTIONS.inc()
+
+    def write_snapshot(self, records: Iterable[dict]) -> str:
+        """Synchronous snapshot covering seq<=self.seq (CLI / tests / small
+        states); the supervisor's periodic path is `compact_async`."""
+        path = self._snapshot_path(self.seq)
+        self._write_snapshot_file(records, path)
+        self._finish_snapshot(path, self.seq)
+        return path
+
+    async def compact_async(self, records: list[dict]) -> str:
+        """Event-loop-friendly compaction: the caller synthesizes `records`
+        on the loop (a consistent view — single-threaded), the bulk
+        serialize/write/fsync runs in a thread, and pruning (cheap, in-memory
+        max-seq map) finishes back on the loop. Appends racing the thread are
+        safe: they carry seq > covered_seq and survive in the tail."""
+        import asyncio
+
+        covered_seq = self.seq
+        path = self._snapshot_path(covered_seq)
+        await asyncio.to_thread(self._write_snapshot_file, records, path)
+        self._finish_snapshot(path, covered_seq)
+        return path
+
+    def status(self) -> dict:
+        segments = self._list("segment-")
+        snapshots = self._list("snapshot-")
+        by_type: dict[str, int] = {}
+        tail_records = 0
+        for name in segments:
+            for rec in _read_records(os.path.join(self.dir, name)):
+                tail_records += 1
+                by_type[rec.get("t", "?")] = by_type.get(rec.get("t", "?"), 0) + 1
+        size = 0
+        for name in segments + snapshots:
+            try:
+                size += os.path.getsize(os.path.join(self.dir, name))
+            except OSError:
+                pass
+        return {
+            "dir": self.dir,
+            "seq": self.seq,
+            "segments": len(segments),
+            "snapshot_seq": (
+                int(snapshots[-1][len("snapshot-") : -len(".jsonl")]) if snapshots else 0
+            ),
+            "tail_records": tail_records,
+            "records_by_type": dict(sorted(by_type.items())),
+            "bytes": size,
+            "fsync": self.fsync,
+        }
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def _last_seq(path: str) -> int:
+    """Max seq in a segment: appends are seq-ordered, so scan lines from the
+    end and return the first parseable record's seq (a torn trailing line is
+    skipped, same tolerance as replay)."""
+    try:
+        with open(path, "rb") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return 0
+    for raw in reversed(lines):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            return int(json.loads(raw).get("seq", 0))
+        except (json.JSONDecodeError, ValueError, AttributeError):
+            continue
+    return 0
+
+
+def archive_existing(state_dir: str) -> Optional[str]:
+    """Move an existing journal's segments + snapshots into a
+    ``discarded-<ts>/`` subdir. Used when a supervisor explicitly declines
+    recovery (recover=False): the abandoned state must not be silently merged
+    back by the NEXT boot's auto-recovery. Returns the archive dir, or None
+    when there was nothing to archive."""
+    jdir = os.path.join(state_dir, JOURNAL_DIRNAME)
+    try:
+        names = [
+            n
+            for n in os.listdir(jdir)
+            if (n.startswith("segment-") or n.startswith("snapshot-")) and n.endswith(".jsonl")
+        ]
+    except OSError:
+        return None
+    if not names:
+        return None
+    dest = os.path.join(jdir, f"discarded-{time.time_ns()}")
+    os.makedirs(dest, exist_ok=True)
+    for name in names:
+        os.replace(os.path.join(jdir, name), os.path.join(dest, name))
+    logger.warning(f"recovery declined: archived {len(names)} journal file(s) to {dest}")
+    return dest
+
+
+def _read_records(path: str):
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    # torn trailing line from a crash mid-write: skip —
+                    # the record was never acknowledged anywhere
+                    continue
+    except OSError:
+        return
+
+
+# ---------------------------------------------------------------------------
+# Idempotency seen-set (journal-backed)
+# ---------------------------------------------------------------------------
+
+
+class IdempotencyCache:
+    """Bounded key → serialized-response map for mutating RPCs. Entries are
+    journaled (``rpc_dedupe`` records) so a supervisor restart replays the
+    same responses to a client's retry storm — exactly-once RPC effects."""
+
+    def __init__(self, journal: Optional[Journal] = None, max_entries: int = IDEMPOTENCY_MAX_ENTRIES):
+        self.journal = journal
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[str, tuple[str, bytes]]" = OrderedDict()
+
+    def get(self, key: str, method: str) -> Optional[bytes]:
+        hit = self._entries.get(key)
+        if hit is None or hit[0] != method:
+            return None
+        self._entries.move_to_end(key)
+        return hit[1]
+
+    def put(self, key: str, method: str, response: bytes, *, journal: bool = True) -> None:
+        if key in self._entries:
+            self._entries.move_to_end(key)
+        self._entries[key] = (method, response)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+        if journal and self.journal is not None:
+            self.journal.append("rpc_dedupe", key=key, method=method, resp=_b64(response))
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# Appliers: one table shared by snapshot load and tail replay
+# ---------------------------------------------------------------------------
+
+
+def _proto(cls, b64_str: str):
+    msg = cls()
+    if b64_str:
+        msg.ParseFromString(_unb64(b64_str))
+    return msg
+
+
+def _apply_app(s, r):
+    from .state import AppState
+
+    app = s.apps.get(r["app_id"]) or AppState(app_id=r["app_id"])
+    app.name = r.get("name", "")
+    app.description = r.get("description", "")
+    app.state = r.get("state", api_pb2.APP_STATE_INITIALIZING)
+    app.environment_name = r.get("environment_name", "")
+    s.apps[r["app_id"]] = app
+    if r.get("deploy_name"):
+        s.deployed_apps[(app.environment_name, r["deploy_name"])] = app.app_id
+
+
+def _apply_app_state(s, r):
+    app = s.apps.get(r["app_id"])
+    if app is None:
+        return
+    app.state = r.get("state", app.state)
+    for tag, fn_id in (r.get("function_ids") or {}).items():
+        app.function_ids[tag] = fn_id
+    for tag, cls_id in (r.get("class_ids") or {}).items():
+        app.class_ids[tag] = cls_id
+    if r.get("name"):
+        app.name = r["name"]
+        s.deployed_apps[(app.environment_name, r["name"])] = app.app_id
+        if r.get("publish"):
+            # only AppPublish re-keys the deployed-function map; an AppDeploy
+            # record (name, no publish flag) must not wipe existing entries
+            for (env, app_name, tag) in list(s.deployed_functions.keys()):
+                if env == app.environment_name and app_name == r["name"]:
+                    del s.deployed_functions[(env, app_name, tag)]
+            for tag, fn_id in (r.get("function_ids") or {}).items():
+                s.deployed_functions[(app.environment_name, r["name"], tag)] = fn_id
+    if r.get("done"):
+        app.done = True
+        app.stopped_at = r.get("stopped_at", time.time())
+
+
+def _apply_function(s, r):
+    from .state import FunctionState
+
+    s.functions[r["function_id"]] = FunctionState(
+        function_id=r["function_id"],
+        app_id=r.get("app_id", ""),
+        tag=r.get("tag", ""),
+        definition=_proto(api_pb2.Function, r.get("definition", "")),
+        bound_parent=r.get("bound_parent") or None,
+        serialized_params=_unb64(r.get("serialized_params", "")),
+    )
+
+
+def _apply_fn_sched(s, r):
+    fn = s.functions.get(r["function_id"])
+    if fn is not None:
+        fn.autoscaler_override = _proto(api_pb2.AutoscalerSettings, r.get("settings", ""))
+
+
+def _apply_call(s, r):
+    from .state import FunctionCallState
+
+    s.function_calls[r["function_call_id"]] = FunctionCallState(
+        function_call_id=r["function_call_id"],
+        function_id=r.get("function_id", ""),
+        call_type=r.get("call_type", api_pb2.FUNCTION_CALL_TYPE_UNARY),
+        invocation_type=r.get("invocation_type", api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC),
+        return_exceptions=bool(r.get("return_exceptions")),
+        server_originated=bool(r.get("server_originated")),
+    )
+
+
+def _apply_input(s, r):
+    from .state import InputState
+
+    prior = s.inputs.get(r["input_id"])
+    inp = InputState(
+        input_id=r["input_id"],
+        function_call_id=r.get("function_call_id", ""),
+        idx=r.get("idx", 0),
+        input=_proto(api_pb2.FunctionInput, r.get("input", "")),
+        retry_count=r.get("retry_count", 0),
+        # a payload-resend record replacing an earlier input must not drop a
+        # checkpoint token journaled in between
+        resume_token=r.get("resume_token", "") or (prior.resume_token if prior else ""),
+    )
+    s.inputs[inp.input_id] = inp
+    call = s.function_calls.get(inp.function_call_id)
+    if call is not None and inp.input_id not in call.input_ids:
+        call.input_ids.append(inp.input_id)
+        call.num_inputs += 1
+    fn = s.functions.get(r.get("function_id", ""))
+    if fn is not None and inp.input_id not in fn.pending:
+        fn.pending.append(inp.input_id)
+
+
+def _apply_input_retry(s, r):
+    """A requeue/retry transition. The record mirrors its emitting site's
+    exact semantics: `undo_done` (input-plane attempt retry) re-opens a
+    delivered input's slot in num_done; `prune_output` additionally drops the
+    stale output so the new attempt is awaitable; the control-plane sites
+    emit neither (their dedupe keys shift via retry_count instead). No
+    done-guard: replay order mirrors the original timeline, so a site that
+    wouldn't have touched a done input never journaled against one."""
+    inp = s.inputs.get(r["input_id"])
+    if inp is None:
+        return
+    call = s.function_calls.get(inp.function_call_id)
+    if call is not None and r.get("undo_done") and inp.status == "done":
+        call.num_done = max(0, call.num_done - 1)
+        if r.get("prune_output"):
+            call.outputs[:] = [o for o in call.outputs if o.input_id != inp.input_id]
+    inp.retry_count = r.get("retry_count", inp.retry_count)
+    if r.get("input"):
+        inp.input.ParseFromString(_unb64(r["input"]))
+    inp.status = "pending"
+    inp.claimed_by = ""
+    inp.claimed_at = 0.0
+    inp.delivered_to.clear()
+    fn = s.functions.get(call.function_id) if call is not None else None
+    if fn is not None and inp.input_id not in fn.pending:
+        fn.pending.append(inp.input_id)
+
+
+def _apply_input_token(s, r):
+    inp = s.inputs.get(r["input_id"])
+    if inp is not None:
+        inp.resume_token = r.get("resume_token", "")
+
+
+def _apply_output(s, r):
+    call = s.function_calls.get(r["function_call_id"])
+    if call is None:
+        return
+    item = _proto(api_pb2.FunctionGetOutputsItem, r.get("item", ""))
+    key = f"{item.input_id}:{item.retry_count}"
+    if item.input_id and key in call.output_keys:
+        return  # replay of a deduped record
+    call.output_keys.add(key)
+    call.outputs.append(item)
+    call.num_done += 1
+    inp = s.inputs.get(item.input_id)
+    # a STALE output (snapshot synthesis emits the input with its CURRENT
+    # retry_count before the historical outputs list) must not mark a
+    # retried-and-pending input done again — the retry would never run
+    if inp is not None and item.retry_count >= inp.retry_count:
+        inp.status = "done"
+        fn = s.functions.get(call.function_id)
+        if fn is not None and item.input_id in fn.pending:
+            fn.pending.remove(item.input_id)
+
+
+def _apply_consumed(s, r):
+    call = s.function_calls.get(r["function_call_id"])
+    if call is not None:
+        call.outputs_consumed = max(call.outputs_consumed, int(r.get("n", 0)))
+
+
+def _apply_call_cancel(s, r):
+    call = s.function_calls.get(r["function_call_id"])
+    if call is None:
+        return
+    call.cancelled = True
+    fn = s.functions.get(call.function_id)
+    for input_id in call.input_ids:
+        inp = s.inputs.get(input_id)
+        if inp is not None and inp.status in ("pending", "claimed"):
+            inp.status = "cancelled"
+            if fn is not None and input_id in fn.pending:
+                fn.pending.remove(input_id)
+
+
+def _apply_worker(s, r):
+    from .state import WorkerState
+
+    s.workers[r["worker_id"]] = WorkerState(
+        worker_id=r["worker_id"],
+        hostname=r.get("hostname", ""),
+        tpu_type=r.get("tpu_type", ""),
+        num_chips=r.get("num_chips", 0),
+        topology=r.get("topology", ""),
+        milli_cpu=r.get("milli_cpu", 0),
+        memory_mb=r.get("memory_mb", 0),
+        container_address=r.get("container_address", ""),
+        router_address=r.get("router_address", ""),
+        slice_index=r.get("slice_index", 0),
+        region=r.get("region", ""),
+        zone=r.get("zone", ""),
+        spot=bool(r.get("spot")),
+        instance_type=r.get("instance_type", ""),
+    )
+
+
+def _apply_worker_gone(s, r):
+    s.workers.pop(r["worker_id"], None)
+
+
+def _apply_volume(s, r):
+    from .state import VolumeState
+
+    vol = s.volumes.get(r["volume_id"]) or VolumeState(volume_id=r["volume_id"])
+    vol.name = r.get("name", "")
+    vol.version = r.get("version", vol.version)
+    vol.ephemeral = bool(r.get("ephemeral"))
+    vol.last_heartbeat = time.time() if vol.ephemeral else 0.0
+    s.volumes[r["volume_id"]] = vol
+    if r.get("deploy_key"):
+        s.deployed_volumes[tuple(r["deploy_key"])] = vol.volume_id
+
+
+def _apply_volume_files(s, r):
+    vol = s.volumes.get(r["volume_id"])
+    if vol is None:
+        return
+    for fb64 in r.get("files", []):
+        f = _proto(api_pb2.VolumeFile, fb64)
+        vol.files[f.path] = f
+
+
+def _apply_volume_rm(s, r):
+    vol = s.volumes.get(r["volume_id"])
+    if vol is None:
+        return
+    path = r.get("path", "")
+    if r.get("recursive"):
+        for p in list(vol.files):
+            if p == path or p.startswith(path + "/"):
+                del vol.files[p]
+    else:
+        vol.files.pop(path, None)
+
+
+def _apply_volume_meta(s, r):
+    vol = s.volumes.get(r["volume_id"])
+    if vol is None:
+        return
+    if "name" in r:
+        for key, vid in list(s.deployed_volumes.items()):
+            if vid == vol.volume_id:
+                del s.deployed_volumes[key]
+                s.deployed_volumes[(key[0], r["name"])] = vid
+        vol.name = r["name"]
+    if "committed_version" in r:
+        vol.committed_version = r["committed_version"]
+
+
+def _apply_volume_del(s, r):
+    s.volumes.pop(r["volume_id"], None)
+    for key, vid in list(s.deployed_volumes.items()):
+        if vid == r["volume_id"]:
+            del s.deployed_volumes[key]
+
+
+def _apply_secret(s, r):
+    from .state import SecretState
+
+    sec = s.secrets.get(r["secret_id"]) or SecretState(secret_id=r["secret_id"])
+    sec.name = r.get("name", "")
+    sec.env_dict = dict(r.get("env", {}))
+    s.secrets[r["secret_id"]] = sec
+    if r.get("deploy_key"):
+        s.deployed_secrets[tuple(r["deploy_key"])] = sec.secret_id
+
+
+def _apply_secret_del(s, r):
+    s.secrets.pop(r["secret_id"], None)
+    for key, sid in list(s.deployed_secrets.items()):
+        if sid == r["secret_id"]:
+            del s.deployed_secrets[key]
+
+
+_DICTQ_POOLS = {
+    "dicts": ("deployed_dicts", "DictState", "dict_id"),
+    "queues": ("deployed_queues", "QueueState", "queue_id"),
+}
+
+
+def _apply_dictq(s, r):
+    from . import state as state_mod
+
+    pool_name = r["pool"]
+    deployed_name, cls_name, id_field = _DICTQ_POOLS[pool_name]
+    pool = getattr(s, pool_name)
+    cls = getattr(state_mod, cls_name)
+    obj = pool.get(r["id"]) or cls(**{id_field: r["id"]})
+    obj.name = r.get("name", "")
+    obj.ephemeral = bool(r.get("ephemeral"))
+    obj.last_heartbeat = time.time() if obj.ephemeral else 0.0
+    pool[r["id"]] = obj
+    if r.get("deploy_key"):
+        getattr(s, deployed_name)[tuple(r["deploy_key"])] = r["id"]
+
+
+def _apply_dictq_del(s, r):
+    pool_name = r["pool"]
+    deployed_name = _DICTQ_POOLS[pool_name][0]
+    getattr(s, pool_name).pop(r["id"], None)
+    deployed = getattr(s, deployed_name)
+    for key, oid in list(deployed.items()):
+        if oid == r["id"]:
+            del deployed[key]
+
+
+def _apply_proxy(s, r):
+    from .state import ProxyState
+
+    s.proxies[r["proxy_id"]] = ProxyState(
+        proxy_id=r["proxy_id"],
+        name=r.get("name", ""),
+        proxy_ip=r.get("proxy_ip", ""),
+        environment_name=r.get("environment_name", ""),
+    )
+    s.deployed_proxies[(r.get("environment_name", ""), r.get("name", ""))] = r["proxy_id"]
+
+
+def _apply_proxy_del(s, r):
+    proxy = s.proxies.pop(r["proxy_id"], None)
+    if proxy is not None:
+        s.deployed_proxies.pop((proxy.environment_name, proxy.name), None)
+
+
+def _apply_image(s, r):
+    from .state import ImageState
+
+    s.images[r["image_id"]] = ImageState(
+        image_id=r["image_id"],
+        definition=_proto(api_pb2.Image, r.get("definition", "")),
+        metadata=_proto(api_pb2.ImageMetadata, r.get("metadata", "")),
+        built=bool(r.get("built", True)),
+    )
+    if r.get("hash_key"):
+        s.images_by_hash[r["hash_key"]] = r["image_id"]
+
+
+def _apply_image_del(s, r):
+    s.images.pop(r["image_id"], None)
+    for key, image_id in list(s.images_by_hash.items()):
+        if image_id == r["image_id"]:
+            del s.images_by_hash[key]
+
+
+def _apply_environment(s, r):
+    s.environments[r["name"]] = r.get("web_suffix", "")
+
+
+def _apply_environment_del(s, r):
+    s.environments.pop(r["name"], None)
+
+
+def _apply_environment_update(s, r):
+    current = r["current"]
+    if current not in s.environments:
+        return
+    if "web_suffix" in r:
+        s.environments[current] = r["web_suffix"]
+    if r.get("name") and r["name"] != current:
+        s.environments[r["name"]] = s.environments.pop(current)
+        for (env, app_name), app_id in list(s.deployed_apps.items()):
+            if env == current:
+                del s.deployed_apps[(env, app_name)]
+                s.deployed_apps[(r["name"], app_name)] = app_id
+
+
+def _apply_ws_setting(s, r):
+    if r.get("value"):
+        s.workspace_settings[r["name"]] = r["value"]
+    else:
+        s.workspace_settings.pop(r["name"], None)
+
+
+def _apply_token(s, r):
+    s.tokens[r["token_id"]] = r.get("token_secret", "")
+    s.token_granted_at.setdefault(r["token_id"], r.get("granted_at", time.time()))
+
+
+def _apply_attempt(s, r):
+    s.attempts[r["token"]] = (r.get("call_id", ""), r.get("input_id", ""), time.monotonic())
+    if r.get("supersedes"):
+        s.attempts.pop(r["supersedes"], None)
+
+
+def _apply_rpc_dedupe(s, r):
+    if s.idempotency is not None:
+        s.idempotency.put(r["key"], r.get("method", ""), _unb64(r.get("resp", "")), journal=False)
+
+
+_APPLIERS: dict[str, Callable] = {
+    "app": _apply_app,
+    "app_state": _apply_app_state,
+    "function": _apply_function,
+    "fn_sched": _apply_fn_sched,
+    "call": _apply_call,
+    "input": _apply_input,
+    "input_retry": _apply_input_retry,
+    "input_token": _apply_input_token,
+    "output": _apply_output,
+    "consumed": _apply_consumed,
+    "call_cancel": _apply_call_cancel,
+    "worker": _apply_worker,
+    "worker_gone": _apply_worker_gone,
+    "volume": _apply_volume,
+    "volume_files": _apply_volume_files,
+    "volume_rm": _apply_volume_rm,
+    "volume_meta": _apply_volume_meta,
+    "volume_del": _apply_volume_del,
+    "secret": _apply_secret,
+    "secret_del": _apply_secret_del,
+    "dictq": _apply_dictq,
+    "dictq_del": _apply_dictq_del,
+    "proxy": _apply_proxy,
+    "proxy_del": _apply_proxy_del,
+    "image": _apply_image,
+    "image_del": _apply_image_del,
+    "environment": _apply_environment,
+    "environment_del": _apply_environment_del,
+    "environment_update": _apply_environment_update,
+    "ws_setting": _apply_ws_setting,
+    "token": _apply_token,
+    "attempt": _apply_attempt,
+    "rpc_dedupe": _apply_rpc_dedupe,
+}
+
+
+# ---------------------------------------------------------------------------
+# Snapshot synthesis: the records that would rebuild the CURRENT state
+# ---------------------------------------------------------------------------
+
+
+def synthesize_records(s) -> list[dict]:
+    """Records that, applied in order to a fresh ServerState, reproduce the
+    journal-relevant projection of ``s``. Claims/tasks/clusters/sandboxes are
+    deliberately absent (transient by design — see module docstring)."""
+    out: list[dict] = []
+    for name, suffix in s.environments.items():
+        out.append({"t": "environment", "name": name, "web_suffix": suffix})
+    for name, value in s.workspace_settings.items():
+        out.append({"t": "ws_setting", "name": name, "value": value})
+    for token_id, secret in s.tokens.items():
+        out.append(
+            {
+                "t": "token",
+                "token_id": token_id,
+                "token_secret": secret,
+                "granted_at": s.token_granted_at.get(token_id, 0.0),
+            }
+        )
+    hash_by_image = {v: k for k, v in s.images_by_hash.items()}
+    for img in s.images.values():
+        out.append(
+            {
+                "t": "image",
+                "image_id": img.image_id,
+                "definition": _b64(img.definition.SerializeToString()),
+                "metadata": _b64(img.metadata.SerializeToString()),
+                "built": img.built,
+                "hash_key": hash_by_image.get(img.image_id, ""),
+            }
+        )
+    deployed_by_app = {v: k[1] for k, v in s.deployed_apps.items()}
+    for app in s.apps.values():
+        out.append(
+            {
+                "t": "app",
+                "app_id": app.app_id,
+                "name": app.name,
+                "description": app.description,
+                "state": app.state,
+                "environment_name": app.environment_name,
+                "deploy_name": deployed_by_app.get(app.app_id, ""),
+            }
+        )
+        rec = {
+            "t": "app_state",
+            "app_id": app.app_id,
+            "state": app.state,
+            "function_ids": dict(app.function_ids),
+            "class_ids": dict(app.class_ids),
+            "name": deployed_by_app.get(app.app_id, ""),
+            "publish": True,  # authoritative function_ids: re-key deployed map
+        }
+        if app.done:
+            rec["done"] = True
+            rec["stopped_at"] = app.stopped_at
+        out.append(rec)
+    for fn in s.functions.values():
+        out.append(
+            {
+                "t": "function",
+                "function_id": fn.function_id,
+                "app_id": fn.app_id,
+                "tag": fn.tag,
+                "definition": _b64(fn.definition.SerializeToString()),
+                "bound_parent": fn.bound_parent or "",
+                "serialized_params": _b64(fn.serialized_params),
+            }
+        )
+        if fn.autoscaler_override is not None:
+            out.append(
+                {
+                    "t": "fn_sched",
+                    "function_id": fn.function_id,
+                    "settings": _b64(fn.autoscaler_override.SerializeToString()),
+                }
+            )
+    deployed_by_volume = {v: k for k, v in s.deployed_volumes.items()}
+    for vol in s.volumes.values():
+        deploy_key = deployed_by_volume.get(vol.volume_id)
+        out.append(
+            {
+                "t": "volume",
+                "volume_id": vol.volume_id,
+                "name": vol.name,
+                "version": vol.version,
+                "ephemeral": vol.ephemeral,
+                "deploy_key": list(deploy_key) if deploy_key else None,
+            }
+        )
+        if vol.files:
+            out.append(
+                {
+                    "t": "volume_files",
+                    "volume_id": vol.volume_id,
+                    "files": [_b64(f.SerializeToString()) for f in vol.files.values()],
+                }
+            )
+        if vol.committed_version:
+            out.append(
+                {"t": "volume_meta", "volume_id": vol.volume_id, "committed_version": vol.committed_version}
+            )
+    deployed_by_secret = {v: k for k, v in s.deployed_secrets.items()}
+    for sec in s.secrets.values():
+        deploy_key = deployed_by_secret.get(sec.secret_id)
+        out.append(
+            {
+                "t": "secret",
+                "secret_id": sec.secret_id,
+                "name": sec.name,
+                "env": dict(sec.env_dict),
+                "deploy_key": list(deploy_key) if deploy_key else None,
+            }
+        )
+    for pool_name in ("dicts", "queues"):
+        deployed_by_obj = {
+            v: k for k, v in getattr(s, _DICTQ_POOLS[pool_name][0]).items()
+        }
+        for obj_id, obj in getattr(s, pool_name).items():
+            deploy_key = deployed_by_obj.get(obj_id)
+            out.append(
+                {
+                    "t": "dictq",
+                    "pool": pool_name,
+                    "id": obj_id,
+                    "name": obj.name,
+                    "ephemeral": obj.ephemeral,
+                    "deploy_key": list(deploy_key) if deploy_key else None,
+                }
+            )
+    for proxy in s.proxies.values():
+        out.append(
+            {
+                "t": "proxy",
+                "proxy_id": proxy.proxy_id,
+                "name": proxy.name,
+                "proxy_ip": proxy.proxy_ip,
+                "environment_name": proxy.environment_name,
+            }
+        )
+    for worker in s.workers.values():
+        out.append(
+            {
+                "t": "worker",
+                "worker_id": worker.worker_id,
+                "hostname": worker.hostname,
+                "tpu_type": worker.tpu_type,
+                "num_chips": worker.num_chips,
+                "topology": worker.topology,
+                "milli_cpu": worker.milli_cpu,
+                "memory_mb": worker.memory_mb,
+                "container_address": worker.container_address,
+                "router_address": worker.router_address,
+                "slice_index": worker.slice_index,
+                "region": worker.region,
+                "zone": worker.zone,
+                "spot": worker.spot,
+                "instance_type": worker.instance_type,
+            }
+        )
+    for call in s.function_calls.values():
+        out.append(
+            {
+                "t": "call",
+                "function_call_id": call.function_call_id,
+                "function_id": call.function_id,
+                "call_type": call.call_type,
+                "invocation_type": call.invocation_type,
+                "return_exceptions": call.return_exceptions,
+                "server_originated": call.server_originated,
+            }
+        )
+    for inp in s.inputs.values():
+        call = s.function_calls.get(inp.function_call_id)
+        out.append(
+            {
+                "t": "input",
+                "input_id": inp.input_id,
+                "function_call_id": inp.function_call_id,
+                "function_id": call.function_id if call is not None else "",
+                "idx": inp.idx,
+                "input": _b64(inp.input.SerializeToString()),
+                "retry_count": inp.retry_count,
+                "resume_token": inp.resume_token,
+            }
+        )
+    for call in s.function_calls.values():
+        for item in call.outputs:
+            out.append(
+                {
+                    "t": "output",
+                    "function_call_id": call.function_call_id,
+                    "item": _b64(item.SerializeToString()),
+                }
+            )
+        if call.outputs_consumed:
+            out.append(
+                {"t": "consumed", "function_call_id": call.function_call_id, "n": call.outputs_consumed}
+            )
+        if call.cancelled:
+            out.append({"t": "call_cancel", "function_call_id": call.function_call_id})
+    for token, (call_id, input_id, _ts) in s.attempts.items():
+        out.append({"t": "attempt", "token": token, "call_id": call_id, "input_id": input_id})
+    if s.idempotency is not None:
+        for key, (method, resp) in s.idempotency._entries.items():
+            out.append({"t": "rpc_dedupe", "key": key, "method": method, "resp": _b64(resp)})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Recovery
+# ---------------------------------------------------------------------------
+
+
+def recover_state(state, journal: Journal) -> dict:
+    """Replay snapshot + tail into ``state`` and run the post-passes:
+    orphaned claimed inputs requeue (claims aren't journaled, so recovered
+    inputs are already pending unless an output marked them done), journaled
+    workers enter adoption_pending, and id counters advance past every
+    recovered id. Returns a recovery report dict."""
+    from ..observability import tracing
+    from ..observability.catalog import (
+        RECOVERIES,
+        RECOVERY_REPLAYED,
+        RECOVERY_REQUEUED_INPUTS,
+        RECOVERY_SECONDS,
+    )
+    from .state import bump_id_counter
+
+    t0 = time.time()
+    snap_records, tail = journal.replay()
+    applied = 0
+    skipped = 0
+    for rec in list(snap_records) + list(tail):
+        applier = _APPLIERS.get(rec.get("t", ""))
+        if applier is None:
+            skipped += 1
+            continue
+        try:
+            applier(state, rec)
+            applied += 1
+            RECOVERY_REPLAYED.inc(type=rec["t"])
+        except Exception:  # noqa: BLE001 — one bad record must not kill recovery
+            logger.exception(f"journal replay failed for record seq={rec.get('seq')} t={rec.get('t')}")
+            skipped += 1
+    # post-pass 1: id counters past every recovered id (a fresh make_id must
+    # never re-issue a journaled id)
+    for pool in (
+        state.apps,
+        state.functions,
+        state.function_calls,
+        state.inputs,
+        state.workers,
+        state.volumes,
+        state.secrets,
+        state.dicts,
+        state.queues,
+        state.proxies,
+        state.images,
+    ):
+        for obj_id in pool:
+            bump_id_counter(obj_id)
+    # attempt tokens are make_id("at") too: a re-minted colliding token would
+    # silently overwrite a recovered one and resolve a surviving client's
+    # AttemptAwait to the WRONG input's result
+    for token in state.attempts:
+        bump_id_counter(token)
+    # post-pass 2: every unfinished input is pending (claims were transient);
+    # make sure it sits in its function's pending queue exactly once
+    requeued = 0
+    for inp in state.inputs.values():
+        if inp.status not in ("pending",):
+            continue
+        call = state.function_calls.get(inp.function_call_id)
+        fn = state.functions.get(call.function_id) if call is not None else None
+        if fn is None:
+            continue
+        if inp.input_id not in fn.pending:
+            fn.pending.append(inp.input_id)
+        requeued += 1
+    RECOVERY_REQUEUED_INPUTS.inc(requeued)
+    # post-pass 3: recovered workers await re-adoption — no placements until
+    # their next heartbeat proves they survived the control-plane crash
+    now = time.time()
+    for worker in state.workers.values():
+        worker.adoption_pending = True
+        worker.recovered_at = now
+        worker.last_heartbeat = 0.0
+    open_calls = sum(1 for c in state.function_calls.values() if c.num_done < c.num_inputs)
+    took = time.time() - t0
+    RECOVERY_SECONDS.set(took)
+    RECOVERIES.inc(outcome="ok")
+    tracing.record_span(
+        "recovery.replay",
+        start=t0,
+        end=time.time(),
+        attrs={
+            "records_applied": applied,
+            "records_skipped": skipped,
+            "inputs_requeued": requeued,
+            "open_calls": open_calls,
+            "workers_pending_adoption": len(state.workers),
+        },
+    )
+    report = {
+        "records_applied": applied,
+        "records_skipped": skipped,
+        "inputs_requeued": requeued,
+        "open_calls": open_calls,
+        "workers_pending_adoption": len(state.workers),
+        "seconds": round(took, 4),
+    }
+    logger.warning(f"control plane recovered from journal: {report}")
+    return report

@@ -17,6 +17,7 @@ SURVEY §7 hard part 1). Responsibilities:
 from __future__ import annotations
 
 import asyncio
+import os
 import secrets
 import time
 from typing import Optional
@@ -49,6 +50,10 @@ TASK_LAUNCH_TIMEOUT = 60.0
 # margin past a draining worker's grace window before its unreported tasks
 # are force-reaped (covers a worker that died mid-drain)
 DRAIN_REAP_MARGIN = 10.0
+# journal-recovered workers that never heartbeat within this window after a
+# control-plane restart are deregistered (they died with, or before, the
+# supervisor) — until then they hold no placements (adoption_pending)
+WORKER_READOPT_GRACE_S = float(os.environ.get("MODAL_TPU_READOPT_GRACE", "30"))
 
 
 class Scheduler:
@@ -80,6 +85,7 @@ class Scheduler:
                     self._gc_scheduled_calls()
                     if self.servicer is not None:
                         self.servicer.reap_stale_ephemerals()
+                        await self.servicer.maybe_compact()
             except Exception:
                 logger.exception("scheduler iteration failed")
             try:
@@ -233,6 +239,16 @@ class Scheduler:
             ),
         )
         if self.servicer is not None:
+            # journal the call BEFORE its input (replay order): an input
+            # record referencing an unjournaled call would recover orphaned
+            self.servicer._j(
+                "call",
+                function_call_id=call_id,
+                function_id=fn.function_id,
+                call_type=call.call_type,
+                invocation_type=call.invocation_type,
+                server_originated=True,
+            )
             self.servicer._enqueue_input(fn, call, item)
         async with fn.input_condition:
             fn.input_condition.notify_all()
@@ -329,6 +345,11 @@ class Scheduler:
                 continue
             if worker.draining:
                 # drain state: a preempting host takes no NEW placements
+                continue
+            if worker.adoption_pending:
+                # journal-recovered worker that hasn't heartbeated since the
+                # restart: it may not exist anymore — no placements until its
+                # heartbeat re-adopts it (services.WorkerHeartbeat)
                 continue
             if not self._placement_ok(worker, placement):
                 continue
@@ -722,6 +743,22 @@ class Scheduler:
             ):
                 logger.info(f"drained worker {worker_id} deregistered")
                 del self.s.workers[worker_id]
+                self._journal_worker_gone(worker_id)
+            elif (
+                worker.adoption_pending
+                and worker.recovered_at
+                and now - worker.recovered_at > WORKER_READOPT_GRACE_S
+            ):
+                # journal-recovered worker never heartbeated post-restart:
+                # it did not survive the crash — drop it so placement
+                # satisfiability stops counting a ghost
+                logger.warning(f"recovered worker {worker_id} never re-adopted; deregistered")
+                del self.s.workers[worker_id]
+                self._journal_worker_gone(worker_id)
+
+    def _journal_worker_gone(self, worker_id: str) -> None:
+        if self.s.journal is not None:
+            self.s.journal.append("worker_gone", worker_id=worker_id)
 
     async def _reap_task(self, task: TaskState_, reason: str, free_requeue: bool) -> None:
         """Tear down one dead/stuck task. `free_requeue` (preemption): its

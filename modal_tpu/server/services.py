@@ -21,8 +21,14 @@ import grpc
 
 from ..config import logger
 from ..observability import tracing
-from ..observability.catalog import INPUT_QUEUE_WAIT, TASK_RESULTS, WORKER_HEARTBEATS
+from ..observability.catalog import (
+    INPUT_QUEUE_WAIT,
+    TASK_RESULTS,
+    WORKER_HEARTBEATS,
+    WORKERS_READOPTED,
+)
 from ..proto import api_pb2
+from .journal import _b64 as _jb64
 from .scheduler import PLACEMENT_UNSAT_GRACE_S
 from .state import (
     AppState,
@@ -58,6 +64,57 @@ class ModalTPUServicer:
         # real throttling control surfaced to containers on every GetInputs
         # response (reference rate_limit_sleep_duration)
         self.rate_limit_sleep_duration = 0.0
+
+    # ------------------------------------------------------------------
+    # Durable control plane (server/journal.py)
+    # ------------------------------------------------------------------
+
+    @property
+    def idempotency(self):
+        """Journal-backed idempotency seen-set, consumed by the dedupe
+        wrapper in proto/rpc.py. None when journaling is off."""
+        return self.s.idempotency
+
+    def _j(self, t: str, **payload) -> None:
+        """Append one typed record to the write-ahead journal (no-op when
+        journaling is off). Every mutating handler below calls this with the
+        EFFECT it just applied — replay is services-agnostic."""
+        j = self.s.journal
+        if j is not None:
+            j.append(t, **payload)
+
+    def _append_output(self, call: FunctionCallState, item: api_pb2.FunctionGetOutputsItem) -> bool:
+        """The one funnel every delivered output goes through: dedupe by
+        (input_id, retry_count) so a requeued input whose dead attempt
+        already reported cannot double-deliver, then append + journal.
+        Returns False when the output was a duplicate."""
+        key = f"{item.input_id}:{item.retry_count}"
+        if item.input_id and key in call.output_keys:
+            return False
+        if item.input_id:
+            call.output_keys.add(key)
+        call.outputs.append(item)
+        call.num_done += 1
+        call.first_output_at = call.first_output_at or time.time()
+        if self.s.journal is not None:  # don't pay serialize+b64 when journaling is off
+            self._j(
+                "output",
+                function_call_id=call.function_call_id,
+                item=_jb64(item.SerializeToString()),
+            )
+        return True
+
+    async def maybe_compact(self) -> None:
+        """Periodic journal compaction (scheduler reap tick): snapshot the
+        current state and prune covered segments once enough records pile up.
+        Synthesis happens on the loop (consistent view); the bulk write/fsync
+        runs in a thread so RPC handling never stalls on snapshot I/O."""
+        from .journal import COMPACT_EVERY_RECORDS, synthesize_records
+
+        j = self.s.journal
+        if j is not None and j.records_since_snapshot() >= COMPACT_EVERY_RECORDS:
+            await j.compact_async(synthesize_records(self.s))
+            logger.info(f"journal compacted at seq {j.seq}")
 
     # ------------------------------------------------------------------
     # Misc
@@ -100,6 +157,7 @@ class ModalTPUServicer:
         if not name:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "environment needs a name")
         self.s.environments.setdefault(name, "")
+        self._j("environment", name=name, web_suffix=self.s.environments[name])
         return api_pb2.EnvironmentCreateResponse()
 
     async def EnvironmentDelete(self, request, context):
@@ -109,6 +167,7 @@ class ModalTPUServicer:
                 grpc.StatusCode.FAILED_PRECONDITION, f"environment {name!r} still has deployed apps"
             )
         self.s.environments.pop(name, None)
+        self._j("environment_del", name=name)
         return api_pb2.EnvironmentDeleteResponse()
 
     async def EnvironmentUpdate(self, request, context):
@@ -128,6 +187,12 @@ class ModalTPUServicer:
                 if env == current:
                     del self.s.deployed_apps[(env, app_name)]
                     self.s.deployed_apps[(request.name, app_name)] = app_id
+        rec: dict = {"current": current}
+        if request.HasField("web_suffix"):
+            rec["web_suffix"] = request.web_suffix
+        if request.HasField("name") and request.name:
+            rec["name"] = request.name
+        self._j("environment_update", **rec)
         return api_pb2.EnvironmentUpdateResponse()
 
     async def TokenFlowCreate(self, request, context):
@@ -174,6 +239,12 @@ class ModalTPUServicer:
         # same credentials.
         self.s.tokens[flow["token_id"]] = flow["token_secret"]
         self.s.token_granted_at.setdefault(flow["token_id"], time.time())
+        self._j(
+            "token",
+            token_id=flow["token_id"],
+            token_secret=flow["token_secret"],
+            granted_at=self.s.token_granted_at[flow["token_id"]],
+        )
         self.s.pending_token_flows.pop(request.token_flow_id, None)
         return api_pb2.TokenFlowWaitResponse(
             token_id=flow["token_id"], token_secret=flow["token_secret"], workspace_name="local"
@@ -229,6 +300,7 @@ class ModalTPUServicer:
         if not request.value:
             # empty value = unset (there is no separate delete RPC)
             self.s.workspace_settings.pop(request.name, None)
+            self._j("ws_setting", name=request.name, value="")
             return api_pb2.WorkspaceSettingsSetResponse()
         if request.name == "image_builder_version":
             from ..builder import known_versions
@@ -244,6 +316,7 @@ class ModalTPUServicer:
                 grpc.StatusCode.NOT_FOUND, f"environment {request.value!r} does not exist"
             )
         self.s.workspace_settings[request.name] = request.value
+        self._j("ws_setting", name=request.name, value=request.value)
         return api_pb2.WorkspaceSettingsSetResponse()
 
     # ------------------------------------------------------------------
@@ -252,11 +325,19 @@ class ModalTPUServicer:
 
     async def AppCreate(self, request: api_pb2.AppCreateRequest, context) -> api_pb2.AppCreateResponse:
         app_id = make_id("ap")
-        self.s.apps[app_id] = AppState(
+        app = AppState(
             app_id=app_id,
             description=request.description,
             state=request.app_state or api_pb2.APP_STATE_INITIALIZING,
             environment_name=self._resolve_environment(request.environment_name),
+        )
+        self.s.apps[app_id] = app
+        self._j(
+            "app",
+            app_id=app_id,
+            description=app.description,
+            state=app.state,
+            environment_name=app.environment_name,
         )
         return api_pb2.AppCreateResponse(app_id=app_id, app_page_url=f"http://local/apps/{app_id}")
 
@@ -275,6 +356,15 @@ class ModalTPUServicer:
                 environment_name=key[0],
             )
             self.s.deployed_apps[key] = app_id
+            self._j(
+                "app",
+                app_id=app_id,
+                name=request.app_name,
+                description=request.app_name,
+                state=api_pb2.APP_STATE_DEPLOYED,
+                environment_name=key[0],
+                deploy_name=request.app_name,
+            )
         elif request.object_creation_type == FAIL_IF_EXISTS:
             await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"app {request.app_name!r} exists")
         return api_pb2.AppGetOrCreateResponse(app_id=app_id)
@@ -311,6 +401,15 @@ class ModalTPUServicer:
                     commit_info=request.commit_info,
                 )
             )
+        self._j(
+            "app_state",
+            app_id=app.app_id,
+            state=app.state,
+            function_ids=dict(request.function_ids),
+            class_ids=dict(request.class_ids),
+            name=request.name or "",
+            publish=True,  # replay re-keys deployed_functions (AppDeploy doesn't)
+        )
         self.s.schedule_event.set()  # min_containers may need warm pools
         return api_pb2.AppPublishResponse(url=f"http://local/apps/{app.app_id}")
 
@@ -330,6 +429,9 @@ class ModalTPUServicer:
         app.state = api_pb2.APP_STATE_STOPPED
         app.stopped_at = time.time()
         app.done = True
+        self._j(
+            "app_state", app_id=app.app_id, state=app.state, done=True, stopped_at=app.stopped_at
+        )
         # stop tasks belonging to the app
         for task in list(self.s.tasks.values()):
             if task.app_id == app.app_id and task.state not in (
@@ -396,6 +498,7 @@ class ModalTPUServicer:
             await context.abort(grpc.StatusCode.NOT_FOUND, "app not found")
         app.state = api_pb2.APP_STATE_DEPLOYED
         self.s.deployed_apps[(app.environment_name, request.name)] = app.app_id
+        self._j("app_state", app_id=app.app_id, state=app.state, name=request.name)
         return api_pb2.AppDeployResponse(url=f"http://local/apps/{app.app_id}")
 
     async def AppGetByDeploymentName(self, request, context) -> api_pb2.AppGetByDeploymentNameResponse:
@@ -507,9 +610,19 @@ class ModalTPUServicer:
             definition=definition,
         )
         self.s.functions[function_id] = fn
+        self._j(
+            "function",
+            function_id=function_id,
+            app_id=request.app_id,
+            tag=fn.tag,
+            definition=_jb64(definition.SerializeToString()),
+        )
         app = self.s.apps.get(request.app_id)
         if app is not None:
             app.function_ids[fn.tag] = function_id
+            self._j(
+                "app_state", app_id=app.app_id, state=app.state, function_ids={fn.tag: function_id}
+            )
         self.s.schedule_event.set()
         return api_pb2.FunctionCreateResponse(
             function_id=function_id, handle_metadata=self._function_metadata(fn)
@@ -564,6 +677,15 @@ class ModalTPUServicer:
             serialized_params=request.serialized_params,
         )
         self.s.functions[bound_id] = bound
+        self._j(
+            "function",
+            function_id=bound_id,
+            app_id=parent.app_id,
+            tag=parent.tag,
+            definition=_jb64(bound_def.SerializeToString()),
+            bound_parent=parent.function_id,
+            serialized_params=_jb64(request.serialized_params),
+        )
         return api_pb2.FunctionBindParamsResponse(
             bound_function_id=bound_id, handle_metadata=self._function_metadata(bound)
         )
@@ -607,6 +729,11 @@ class ModalTPUServicer:
         if fn is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
         fn.autoscaler_override = request.settings
+        self._j(
+            "fn_sched",
+            function_id=request.function_id,
+            settings=_jb64(request.settings.SerializeToString()),
+        )
         self.s.schedule_event.set()
         return api_pb2.FunctionUpdateSchedulingParamsResponse()
 
@@ -640,6 +767,16 @@ class ModalTPUServicer:
         call.input_ids.append(input_id)
         call.num_inputs += 1
         fn.pending.append(input_id)
+        if self.s.journal is not None:  # don't pay serialize+b64 when journaling is off
+            self._j(
+                "input",
+                input_id=input_id,
+                function_call_id=call.function_call_id,
+                function_id=fn.function_id,
+                idx=item.idx,
+                input=_jb64(item.input.SerializeToString()),
+                retry_count=0,
+            )
         return inp
 
     async def FunctionMap(self, request: api_pb2.FunctionMapRequest, context) -> api_pb2.FunctionMapResponse:
@@ -655,6 +792,14 @@ class ModalTPUServicer:
             return_exceptions=request.return_exceptions,
         )
         self.s.function_calls[call_id] = call
+        self._j(
+            "call",
+            function_call_id=call_id,
+            function_id=request.function_id,
+            call_type=call.call_type,
+            invocation_type=call.invocation_type,
+            return_exceptions=call.return_exceptions,
+        )
         resp = api_pb2.FunctionMapResponse(
             function_call_id=call_id,
             function_call_jwt=call_id,
@@ -698,6 +843,22 @@ class ModalTPUServicer:
             old.retry_count = item.retry_count
             if item.input.WhichOneof("args_oneof"):  # payload resend optional
                 old.input.CopyFrom(item.input)
+                # re-journal the payload so a post-crash replay retries the
+                # NEW bytes, not the original enqueue's (resume_token carried
+                # over: the replacing record must not drop the checkpoint)
+                if self.s.journal is not None:
+                    self._j(
+                        "input",
+                        input_id=old.input_id,
+                        function_call_id=old.function_call_id,
+                        function_id=call.function_id,
+                        idx=old.idx,
+                        input=_jb64(old.input.SerializeToString()),
+                        retry_count=old.retry_count,
+                        resume_token=old.resume_token,
+                    )
+            else:
+                self._j("input_retry", input_id=old.input_id, retry_count=old.retry_count)
             old.delivered_to.clear()
             old.claimed_by = ""
             old.claimed_at = 0.0
@@ -737,6 +898,11 @@ class ModalTPUServicer:
                 taken = available[:n]
                 if request.clear_on_success:
                     call.outputs_consumed += n
+                    # the consumption pointer survives a restart: a recovered
+                    # call must not re-deliver outputs this client already took
+                    self._j(
+                        "consumed", function_call_id=call.function_call_id, n=call.outputs_consumed
+                    )
                 return api_pb2.FunctionGetOutputsResponse(
                     outputs=taken,
                     last_entry_id=str(start + n),
@@ -816,6 +982,7 @@ class ModalTPUServicer:
         if call is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "call not found")
         call.cancelled = True
+        self._j("call_cancel", function_call_id=call.function_call_id)
         fn = self.s.functions[call.function_id]
         # drop pending inputs; notify running tasks via heartbeat channel
         for input_id in call.input_ids:
@@ -1114,18 +1281,18 @@ class ModalTPUServicer:
                 ):
                     continue
                 inp.status = "done"
-            call.outputs.append(
+            appended = self._append_output(
+                call,
                 api_pb2.FunctionGetOutputsItem(
                     result=item.result,
                     idx=item.idx,
                     input_id=item.input_id,
                     data_format=item.data_format,
                     retry_count=item.retry_count,
-                )
+                ),
             )
-            call.num_done += 1
-            call.first_output_at = call.first_output_at or time.time()
-            touched.add(call.function_call_id)
+            if appended:
+                touched.add(call.function_call_id)
         for call_id in touched:
             call = self.s.function_calls[call_id]
             async with call.output_condition:
@@ -1149,6 +1316,9 @@ class ModalTPUServicer:
                 or (not inp.claimed_by and not inp.resume_token)
             ):
                 inp.resume_token = request.resume_token
+                # the checkpoint must survive a control-plane crash too — a
+                # recovered (requeued) input is redelivered with its token
+                self._j("input_token", input_id=request.input_id, resume_token=request.resume_token)
                 logger.debug(
                     f"resume token recorded for {request.input_id}: {request.resume_token!r}"
                 )
@@ -1292,6 +1462,7 @@ class ModalTPUServicer:
         for key, image_id in list(self.s.images_by_hash.items()):
             if image_id == request.image_id:
                 del self.s.images_by_hash[key]
+        self._j("image_del", image_id=request.image_id)
         return api_pb2.ImageDeleteResponse()
 
     async def ContainerLog(self, request: api_pb2.ContainerLogRequest, context):
@@ -1423,13 +1594,12 @@ class ModalTPUServicer:
             call = self.s.function_calls.get(inp.function_call_id)
             if call is None:
                 continue
-            call.outputs.append(
+            self._append_output(
+                call,
                 api_pb2.FunctionGetOutputsItem(
                     result=result, idx=inp.idx, input_id=inp.input_id, retry_count=inp.retry_count
-                )
+                ),
             )
-            call.num_done += 1
-            call.first_output_at = call.first_output_at or time.time()
             async with call.output_condition:
                 call.output_condition.notify_all()
 
@@ -1477,6 +1647,7 @@ class ModalTPUServicer:
             if inp.retry_count < retries:
                 inp.retry_count += 1
                 inp.status = "pending"
+                self._j("input_retry", input_id=inp.input_id, retry_count=inp.retry_count)
                 # Clear delivery bookkeeping from the dead gang: a stale
                 # delivered_to set would otherwise mark the input claimed
                 # after reaching only one rank of the replacement gang.
@@ -1494,12 +1665,12 @@ class ModalTPUServicer:
                 # drop them so backlog/delivery scans don't see phantom work
                 if inp.input_id in fn.pending:
                     fn.pending.remove(inp.input_id)
-                call.outputs.append(
+                self._append_output(
+                    call,
                     api_pb2.FunctionGetOutputsItem(
                         result=result, idx=inp.idx, input_id=inp.input_id, retry_count=inp.retry_count
-                    )
+                    ),
                 )
-                call.num_done += 1
                 async with call.output_condition:
                     call.output_condition.notify_all()
 
@@ -1530,6 +1701,9 @@ class ModalTPUServicer:
             inp.claimed_at = 0.0
             if inp.input_id not in fn.pending:
                 fn.pending.append(inp.input_id)
+            # free requeue (no budget consumed) — journaled so a crash after
+            # the preemption replays the input as pending, not claimed
+            self._j("input_retry", input_id=inp.input_id, retry_count=inp.retry_count)
             requeued += 1
         if requeued:
             logger.warning(
@@ -1934,6 +2108,12 @@ class ModalTPUServicer:
 
     async def WorkerRegister(self, request: api_pb2.WorkerRegisterRequest, context) -> api_pb2.WorkerRegisterResponse:
         worker_id = request.worker_id or make_id("wk")
+        stale = self.s.workers.get(worker_id)
+        if stale is not None:
+            # re-registration under an existing id (worker survived a
+            # control-plane restart, or re-announced after deregistration):
+            # the stale record must not leak chips/tasks into the new one
+            self._release_worker_tasks(stale)
         self.s.workers[worker_id] = WorkerState(
             worker_id=worker_id,
             hostname=request.hostname,
@@ -1950,8 +2130,36 @@ class ModalTPUServicer:
             spot=request.spot,
             instance_type=request.instance_type,
         )
+        self._j(
+            "worker",
+            worker_id=worker_id,
+            hostname=request.hostname,
+            tpu_type=request.tpu_type,
+            num_chips=request.num_chips,
+            topology=request.topology,
+            milli_cpu=request.milli_cpu,
+            memory_mb=request.memory_mb,
+            container_address=request.container_address,
+            router_address=request.router_address,
+            slice_index=request.slice_index,
+            region=request.region,
+            zone=request.zone,
+            spot=request.spot,
+            instance_type=request.instance_type,
+        )
         self.s.schedule_event.set()
         return api_pb2.WorkerRegisterResponse(worker_id=worker_id)
+
+    def _release_worker_tasks(self, worker: WorkerState) -> None:
+        """Detach a stale WorkerState's bookkeeping before it is replaced:
+        tasks it supposedly ran are marked lost (their inputs retry/fail via
+        the reaper) rather than KeyError-ing later scans."""
+        for task_id in list(worker.active_tasks):
+            task = self.s.tasks.get(task_id)
+            if task is not None and not task.finished_at:
+                task.terminate = True
+        worker.active_tasks.clear()
+        worker.chips_in_use.clear()
 
     async def SandboxGetCommandRouterAccess(
         self, request: api_pb2.SandboxGetCommandRouterAccessRequest, context
@@ -2242,26 +2450,49 @@ class ModalTPUServicer:
         if worker is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "worker not registered")
         while True:
-            event = await worker.events.get()
+            try:
+                event = await asyncio.wait_for(worker.events.get(), timeout=5.0)
+            except asyncio.TimeoutError:
+                # re-registration (reannounce / poll-NOT_FOUND re-announce)
+                # replaces the WorkerState — and with it the events queue the
+                # scheduler targets. A stream still draining the ABANDONED
+                # queue would starve the worker of placements forever: end
+                # the stream so the agent reconnects and binds the live one.
+                if self.s.workers.get(request.worker_id) is not worker:
+                    return
+                continue
             yield event
 
     async def WorkerHeartbeat(self, request, context) -> api_pb2.WorkerHeartbeatResponse:
         worker = self.s.workers.get(request.worker_id)
-        if worker is not None:
-            WORKER_HEARTBEATS.inc()
-            worker.last_heartbeat = time.time()
-            if request.draining and not worker.draining and self.scheduler is not None:
-                # worker announces an impending preemption (SIGTERM from the
-                # cloud): enter drain state. The worker SIGTERMs its own
-                # containers, so don't double-signal them from here. Honor
-                # the grace the worker promised its containers — reaping on
-                # the env default would SIGKILL them mid-checkpoint-flush.
-                grace = request.drain_grace_s or float(
-                    os.environ.get("MODAL_TPU_PREEMPT_GRACE", "10")
-                )
-                await self.scheduler.drain_worker(
-                    request.worker_id, grace_s=grace, notify_worker=False
-                )
+        if worker is None:
+            # unknown id — e.g. this control plane restarted without (or
+            # before) the worker's journal record, or the worker was
+            # deregistered. Never KeyError, never silently ignore: instruct
+            # the worker to re-announce under its old id.
+            return api_pb2.WorkerHeartbeatResponse(reannounce=True)
+        if worker.adoption_pending:
+            # journal-recovered worker proved it survived the control-plane
+            # crash: re-adopt — placements may land here again
+            worker.adoption_pending = False
+            worker.recovered_at = 0.0
+            WORKERS_READOPTED.inc()
+            logger.info(f"worker {request.worker_id} re-adopted after recovery")
+            self.s.schedule_event.set()
+        WORKER_HEARTBEATS.inc()
+        worker.last_heartbeat = time.time()
+        if request.draining and not worker.draining and self.scheduler is not None:
+            # worker announces an impending preemption (SIGTERM from the
+            # cloud): enter drain state. The worker SIGTERMs its own
+            # containers, so don't double-signal them from here. Honor
+            # the grace the worker promised its containers — reaping on
+            # the env default would SIGKILL them mid-checkpoint-flush.
+            grace = request.drain_grace_s or float(
+                os.environ.get("MODAL_TPU_PREEMPT_GRACE", "10")
+            )
+            await self.scheduler.drain_worker(
+                request.worker_id, grace_s=grace, notify_worker=False
+            )
         return api_pb2.WorkerHeartbeatResponse()
 
     # ------------------------------------------------------------------
@@ -2281,6 +2512,14 @@ class ModalTPUServicer:
                 image_id=image_id, definition=request.image, metadata=metadata, built=True
             )
             self.s.images_by_hash[key] = image_id
+            self._j(
+                "image",
+                image_id=image_id,
+                definition=_jb64(request.image.SerializeToString()),
+                metadata=_jb64(metadata.SerializeToString()),
+                built=True,
+                hash_key=key,
+            )
         return api_pb2.ImageGetOrCreateResponse(image_id=image_id, metadata=self.s.images[image_id].metadata)
 
     async def ImageJoinStreaming(self, request, context) -> api_pb2.ImageJoinStreamingResponse:
@@ -2349,6 +2588,12 @@ class ModalTPUServicer:
                 ephemeral=request.object_creation_type == EPHEMERAL,
                 last_heartbeat=time.time(),
             )
+            self._j(
+                "volume",
+                volume_id=volume_id,
+                version=request.version,
+                ephemeral=request.object_creation_type == EPHEMERAL,
+            )
             return api_pb2.VolumeGetOrCreateResponse(
                 volume_id=volume_id, metadata=api_pb2.VolumeMetadata(version=request.version)
             )
@@ -2362,6 +2607,13 @@ class ModalTPUServicer:
                 volume_id=volume_id, name=request.deployment_name, version=request.version
             )
             self.s.deployed_volumes[key] = volume_id
+            self._j(
+                "volume",
+                volume_id=volume_id,
+                name=request.deployment_name,
+                version=request.version,
+                deploy_key=list(key),
+            )
         vol = self.s.volumes[volume_id]
         return api_pb2.VolumeGetOrCreateResponse(
             volume_id=volume_id, metadata=api_pb2.VolumeMetadata(version=vol.version, name=vol.name)
@@ -2376,6 +2628,7 @@ class ModalTPUServicer:
         )
         if missing:
             return api_pb2.VolumePutFiles2Response(missing_blocks=missing)
+        stored = []
         for f in request.files:
             path = f.path.lstrip("/")
             if request.disallow_overwrite_existing_files and path in vol.files:
@@ -2385,6 +2638,13 @@ class ModalTPUServicer:
             new.path = path
             new.mtime = time.time()
             vol.files[path] = new
+            stored.append(new)
+        if stored:
+            self._j(
+                "volume_files",
+                volume_id=request.volume_id,
+                files=[_jb64(f.SerializeToString()) for f in stored],
+            )
         return api_pb2.VolumePutFiles2Response()
 
     async def VolumeBlockPut(self, request, context) -> api_pb2.VolumeBlockPutResponse:
@@ -2453,6 +2713,7 @@ class ModalTPUServicer:
             del vol.files[path]
         else:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"file {path!r} not found")
+        self._j("volume_rm", volume_id=request.volume_id, path=path, recursive=request.recursive)
         return api_pb2.VolumeRemoveFileResponse()
 
     async def VolumeCopyFiles(self, request, context) -> api_pb2.VolumeCopyFilesResponse:
@@ -2460,6 +2721,7 @@ class ModalTPUServicer:
         if vol is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
         dst = request.dst_path.lstrip("/")
+        copied = []
         for src in request.src_paths:
             src = src.lstrip("/")
             f = vol.files.get(src)
@@ -2469,6 +2731,13 @@ class ModalTPUServicer:
             new.CopyFrom(f)
             new.path = (dst.rstrip("/") + "/" + src.rsplit("/", 1)[-1]) if dst.endswith("/") or len(request.src_paths) > 1 else dst
             vol.files[new.path] = new
+            copied.append(new)
+        if copied:
+            self._j(
+                "volume_files",
+                volume_id=request.volume_id,
+                files=[_jb64(f.SerializeToString()) for f in copied],
+            )
         return api_pb2.VolumeCopyFilesResponse()
 
     async def VolumeCommit(self, request, context) -> api_pb2.VolumeCommitResponse:
@@ -2476,6 +2745,7 @@ class ModalTPUServicer:
         if vol is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
         vol.committed_version += 1
+        self._j("volume_meta", volume_id=request.volume_id, committed_version=vol.committed_version)
         return api_pb2.VolumeCommitResponse(skip_reload=False)
 
     async def VolumeReload(self, request, context) -> api_pb2.VolumeReloadResponse:
@@ -2492,6 +2762,7 @@ class ModalTPUServicer:
                 del self.s.deployed_volumes[key]
                 self.s.deployed_volumes[(key[0], request.name)] = vid
         vol.name = request.name
+        self._j("volume_meta", volume_id=request.volume_id, name=request.name)
         return api_pb2.VolumeRenameResponse()
 
     async def VolumeDelete(self, request, context) -> api_pb2.VolumeDeleteResponse:
@@ -2500,6 +2771,7 @@ class ModalTPUServicer:
             for key, vid in list(self.s.deployed_volumes.items()):
                 if vid == request.volume_id:
                     del self.s.deployed_volumes[key]
+            self._j("volume_del", volume_id=request.volume_id)
         return api_pb2.VolumeDeleteResponse()
 
     async def VolumeList(self, request, context) -> api_pb2.VolumeListResponse:
@@ -2520,6 +2792,7 @@ class ModalTPUServicer:
         ):
             secret_id = make_id("st")
             self.s.secrets[secret_id] = SecretState(secret_id=secret_id, env_dict=dict(request.env_dict))
+            self._j("secret", secret_id=secret_id, env=dict(request.env_dict))
             return api_pb2.SecretGetOrCreateResponse(secret_id=secret_id)
         key = (self._resolve_environment(request.environment_name), request.deployment_name)
         secret_id = self.s.deployed_secrets.get(key)
@@ -2531,10 +2804,24 @@ class ModalTPUServicer:
                 secret_id=secret_id, name=request.deployment_name, env_dict=dict(request.env_dict)
             )
             self.s.deployed_secrets[key] = secret_id
+            self._j(
+                "secret",
+                secret_id=secret_id,
+                name=request.deployment_name,
+                env=dict(request.env_dict),
+                deploy_key=list(key),
+            )
         elif request.object_creation_type == FAIL_IF_EXISTS:
             await context.abort(grpc.StatusCode.ALREADY_EXISTS, "secret exists")
         elif request.env_dict:
             self.s.secrets[secret_id].env_dict = dict(request.env_dict)
+            self._j(
+                "secret",
+                secret_id=secret_id,
+                name=self.s.secrets[secret_id].name,
+                env=dict(request.env_dict),
+                deploy_key=list(key),
+            )
         self.s.secrets[secret_id].last_used_at = time.time()
         return api_pb2.SecretGetOrCreateResponse(secret_id=secret_id)
 
@@ -2554,6 +2841,7 @@ class ModalTPUServicer:
             for key, sid in list(self.s.deployed_secrets.items()):
                 if sid == request.secret_id:
                     del self.s.deployed_secrets[key]
+            self._j("secret_del", secret_id=request.secret_id)
         return api_pb2.SecretDeleteResponse()
 
     # ------------------------------------------------------------------
@@ -2595,6 +2883,13 @@ class ModalTPUServicer:
         )
         self.s.proxies[proxy_id] = proxy
         self.s.deployed_proxies[key] = proxy_id
+        self._j(
+            "proxy",
+            proxy_id=proxy_id,
+            name=proxy.name,
+            proxy_ip=proxy.proxy_ip,
+            environment_name=proxy.environment_name,
+        )
         return api_pb2.ProxyCreateResponse(
             proxy=api_pb2.Proxy(proxy_id=proxy_id, name=proxy.name, proxy_ip=proxy.proxy_ip)
         )
@@ -2625,6 +2920,7 @@ class ModalTPUServicer:
         if proxy is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "proxy not found")
         self.s.deployed_proxies.pop((proxy.environment_name, proxy.name), None)
+        self._j("proxy_del", proxy_id=request.proxy_id)
         return api_pb2.ProxyDeleteResponse()
 
     # -- ephemeral-object liveness (reference _object.py:21) ----------------
@@ -2673,6 +2969,12 @@ class ModalTPUServicer:
                 ephemeral=request.object_creation_type == EPHEMERAL,
                 last_heartbeat=time.time(),
             )
+            self._j(
+                "dictq",
+                pool="dicts",
+                id=dict_id,
+                ephemeral=request.object_creation_type == EPHEMERAL,
+            )
             return api_pb2.DictGetOrCreateResponse(dict_id=dict_id)
         key = (self._resolve_environment(request.environment_name), request.deployment_name)
         dict_id = self.s.deployed_dicts.get(key)
@@ -2682,6 +2984,9 @@ class ModalTPUServicer:
             dict_id = make_id("di")
             self.s.dicts[dict_id] = DictState(dict_id=dict_id, name=request.deployment_name)
             self.s.deployed_dicts[key] = dict_id
+            self._j(
+                "dictq", pool="dicts", id=dict_id, name=request.deployment_name, deploy_key=list(key)
+            )
         return api_pb2.DictGetOrCreateResponse(dict_id=dict_id)
 
     async def DictUpdate(self, request, context) -> api_pb2.DictUpdateResponse:
@@ -2745,6 +3050,7 @@ class ModalTPUServicer:
             for key, did in list(self.s.deployed_dicts.items()):
                 if did == request.dict_id:
                     del self.s.deployed_dicts[key]
+            self._j("dictq_del", pool="dicts", id=request.dict_id)
         return api_pb2.DictDeleteResponse()
 
     async def DictList(self, request, context) -> api_pb2.DictListResponse:
@@ -2767,6 +3073,12 @@ class ModalTPUServicer:
                 ephemeral=request.object_creation_type == EPHEMERAL,
                 last_heartbeat=time.time(),
             )
+            self._j(
+                "dictq",
+                pool="queues",
+                id=queue_id,
+                ephemeral=request.object_creation_type == EPHEMERAL,
+            )
             return api_pb2.QueueGetOrCreateResponse(queue_id=queue_id)
         key = (self._resolve_environment(request.environment_name), request.deployment_name)
         queue_id = self.s.deployed_queues.get(key)
@@ -2776,6 +3088,9 @@ class ModalTPUServicer:
             queue_id = make_id("qu")
             self.s.queues[queue_id] = QueueState(queue_id=queue_id, name=request.deployment_name)
             self.s.deployed_queues[key] = queue_id
+            self._j(
+                "dictq", pool="queues", id=queue_id, name=request.deployment_name, deploy_key=list(key)
+            )
         return api_pb2.QueueGetOrCreateResponse(queue_id=queue_id)
 
     async def QueuePut(self, request: api_pb2.QueuePutRequest, context) -> api_pb2.QueuePutResponse:
@@ -2857,6 +3172,7 @@ class ModalTPUServicer:
             for key, qid in list(self.s.deployed_queues.items()):
                 if qid == request.queue_id:
                     del self.s.deployed_queues[key]
+            self._j("dictq_del", pool="queues", id=request.queue_id)
         return api_pb2.QueueDeleteResponse()
 
     async def QueueList(self, request, context) -> api_pb2.QueueListResponse:

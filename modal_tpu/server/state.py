@@ -26,6 +26,22 @@ def make_id(prefix: str) -> str:
     return f"{prefix}-{next(counter):08d}"
 
 
+def bump_id_counter(existing_id: str) -> None:
+    """Advance the prefix counter past an id recovered from the journal so a
+    fresh make_id can never re-issue it (server/journal.py recover_state).
+    Counters only ever move forward — safe with several supervisors sharing
+    one process (tests)."""
+    prefix, _, num = existing_id.rpartition("-")
+    if not prefix or not num.isdigit():
+        return
+    floor = int(num) + 1
+    counter = _id_counters.setdefault(prefix, itertools.count(1))
+    # itertools.count has no peek: draw once to learn the position, then
+    # replace with whichever is further along
+    current = next(counter)
+    _id_counters[prefix] = itertools.count(max(current, floor))
+
+
 @dataclass
 class AppState:
     app_id: str
@@ -86,6 +102,10 @@ class FunctionCallState:
     return_exceptions: bool = False
     first_output_at: float = 0.0
     server_originated: bool = False  # scheduled fire: GC after completion
+    # exactly-once outputs (server/journal.py): dedupe keys
+    # ("input_id:retry_count") of every delivered output — a requeued input
+    # whose dead attempt already reported cannot double-deliver
+    output_keys: set = field(default_factory=set)
 
 
 @dataclass
@@ -184,6 +204,11 @@ class WorkerState:
     # drain_deadline are force-reaped (their inputs requeue for free)
     draining: bool = False
     drain_deadline: float = 0.0
+    # journal recovery (server/journal.py): a worker rebuilt from the journal
+    # takes no placements until its next heartbeat re-adopts it; never
+    # re-adopted within the grace window ⇒ deregistered by the reaper
+    adoption_pending: bool = False
+    recovered_at: float = 0.0
 
     def free_chips(self) -> list[int]:
         return [c for c in range(self.num_chips) if c not in self.chips_in_use]
@@ -357,6 +382,12 @@ class ServerState:
 
         # scheduling wakeup
         self.schedule_event = asyncio.Event()
+
+        # durable control plane (server/journal.py): wired by the supervisor
+        # when journaling is enabled. journal = write-ahead record sink;
+        # idempotency = journal-backed seen-set for mutating RPC dedupe.
+        self.journal = None  # Optional[journal.Journal]
+        self.idempotency = None  # Optional[journal.IdempotencyCache]
 
     # -- blob store ---------------------------------------------------------
 

@@ -22,10 +22,15 @@ from ..observability.catalog import CHAOS_SEED
 from ..proto.rpc import build_generic_handler
 from .blob_server import BlobServer
 from .input_plane import InputPlaneServer
+from .journal import IdempotencyCache, Journal, recover_state
 from .scheduler import Scheduler
 from .services import ModalTPUServicer
 from .state import ServerState
 from .worker import WorkerAgent
+
+
+def _journal_enabled() -> bool:
+    return os.environ.get("MODAL_TPU_JOURNAL", "1") not in ("0", "false", "no")
 
 
 class LocalSupervisor:
@@ -39,6 +44,7 @@ class LocalSupervisor:
         servicer_cls: type = ModalTPUServicer,  # tests inject fault-wrapping subclasses
         hosts_per_slice: int = 0,  # 0 = all workers share slice 0
         chaos: Optional[ChaosPolicy] = None,  # one policy object, every layer
+        recover: Optional[bool] = None,  # None = auto: recover iff a journal exists
     ):
         self.num_workers = num_workers
         self.port = port
@@ -46,6 +52,8 @@ class LocalSupervisor:
         self.worker_chips = worker_chips
         self.worker_tpu_type = worker_tpu_type
         self.hosts_per_slice = hosts_per_slice
+        self.recover = recover
+        self.recovery_report: Optional[dict] = None  # set when start() replayed a journal
         self.state = ServerState(self.state_dir)
         # chaos: explicit policy, else env-driven (MODAL_TPU_CHAOS=1)
         self.chaos = chaos if chaos is not None else ChaosPolicy.from_env()
@@ -59,6 +67,79 @@ class LocalSupervisor:
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._chaos_task: Optional[asyncio.Task] = None
         self._chaos_subtasks: set[asyncio.Task] = set()  # strong refs (GC guard)
+        # serializes crash_restart: two supervisor_crash chaos events due in
+        # one tick must restart sequentially, not interleave teardown/rebuild
+        self._crash_lock = asyncio.Lock()
+
+    def _attach_journal(self) -> None:
+        """Open the write-ahead journal (server/journal.py) and, when the
+        state dir already holds one, replay it into this ServerState BEFORE
+        any RPC is served: open calls resume, orphaned claimed inputs
+        requeue, journaled workers await re-adoption by their next heartbeat."""
+        if not _journal_enabled():
+            return
+        if self.recover is False:
+            # explicit decline: archive any existing records — otherwise the
+            # NEXT boot's auto-recovery would merge the abandoned state with
+            # this run's, resurrecting ghost apps/calls/inputs
+            from .journal import archive_existing
+
+            archive_existing(self.state_dir)
+        journal = Journal(self.state_dir)
+        # the input-plane JWT secret must survive the restart, or every
+        # already-minted client token turns UNAUTHENTICATED (not retried)
+        secret_path = os.path.join(journal.dir, "auth.secret")
+        try:
+            if os.path.exists(secret_path):
+                with open(secret_path, "rb") as f:
+                    self.state.auth_secret = f.read()
+            else:
+                with open(secret_path, "wb") as f:
+                    f.write(self.state.auth_secret)
+                os.chmod(secret_path, 0o600)
+        except OSError as exc:
+            logger.warning(f"auth secret persistence failed: {exc}")
+        should_recover = self.recover if self.recover is not None else journal.has_records()
+        if should_recover and journal.has_records():
+            self.state.idempotency = IdempotencyCache(journal=None)  # filled by replay
+            self.recovery_report = recover_state(self.state, journal)
+        # wire AFTER replay: replaying must not re-append its own records
+        self.state.journal = journal
+        if self.state.idempotency is None:
+            self.state.idempotency = IdempotencyCache(journal=journal)
+        else:
+            self.state.idempotency.journal = journal
+        # data-plane port continuity: clients that survive a control-plane
+        # restart hold the OLD input-plane/blob URLs (handed out at
+        # ClientHello / BlobCreate) — rebinding the same ports makes their
+        # retry loops land on the recovered plane instead of a dead socket.
+        # Explicitly-requested ports are respected; fallback is ephemeral.
+        ports_path = os.path.join(journal.dir, "ports.json")
+        try:
+            import json as _json
+
+            with open(ports_path) as f:
+                saved = _json.load(f)
+            if not self.blob_server.port:
+                self.blob_server.port = int(saved.get("blob", 0))
+            if not self.input_plane.port:
+                self.input_plane.port = int(saved.get("input_plane", 0))
+        except (OSError, ValueError):
+            pass
+
+    def _save_ports(self) -> None:
+        """Record the bound data-plane ports for the next (post-crash) boot."""
+        if self.state.journal is None:
+            return
+        import json as _json
+
+        try:
+            with open(os.path.join(self.state.journal.dir, "ports.json"), "w") as f:
+                _json.dump(
+                    {"blob": self.blob_server.port, "input_plane": self.input_plane.port}, f
+                )
+        except OSError:
+            pass
 
     @property
     def server_url(self) -> str:
@@ -70,27 +151,15 @@ class LocalSupervisor:
             # span sink under the supervisor dir; exported to containers via
             # MODAL_TPU_TRACE_DIR (observability/tracing.py)
             tracing.configure(config.get("trace_dir") or os.path.join(self.state_dir, "traces"))
+        # journal + recovery BEFORE the gRPC server binds: the first client
+        # retry after a restart must already see the replayed state (and the
+        # dedupe wrapper captures state.idempotency at handler-build time)
+        self._attach_journal()
         if self.chaos is not None:
             # /metrics echoes the active chaos seed so a soak failure is
             # attributable to the exact injected fault sequence
             CHAOS_SEED.set(float(self.chaos.seed))
-        self._grpc_server = grpc.aio.server(
-            options=[
-                ("grpc.max_receive_message_length", 128 * 1024 * 1024),
-                ("grpc.max_send_message_length", 128 * 1024 * 1024),
-            ]
-        )
-        # chaos attaches at the handler boundary so the servicer itself (and
-        # every in-process caller: scheduler, tests) stays clean
-        handler_target = (
-            ChaosServicerProxy(self.servicer, self.chaos) if self.chaos is not None else self.servicer
-        )
-        self._grpc_server.add_generic_rpc_handlers((build_generic_handler(handler_target),))
-        self.port = self._grpc_server.add_insecure_port(f"127.0.0.1:{self.port}")
-        await self._grpc_server.start()
-        await self.blob_server.start()
-        await self.input_plane.start()
-        self.scheduler.start()
+        await self._start_control_plane(self.port)
         for i in range(self.num_workers):
             worker = WorkerAgent(
                 self.server_url,
@@ -106,12 +175,42 @@ class LocalSupervisor:
             self._chaos_task = asyncio.create_task(self._chaos_event_loop(), name="chaos-events")
         logger.debug(f"local supervisor up at {self.server_url} ({self.num_workers} workers)")
 
+    async def _start_control_plane(self, grpc_port: int) -> None:
+        """Bind + start the gRPC server, blob server, input plane, and
+        scheduler — ONE code path for a fresh boot and the post-crash
+        rebuild, so they can never drift."""
+        self._grpc_server = grpc.aio.server(
+            options=[
+                ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+                ("grpc.max_send_message_length", 128 * 1024 * 1024),
+            ]
+        )
+        # chaos attaches at the handler boundary so the servicer itself (and
+        # every in-process caller: scheduler, tests) stays clean
+        handler_target = (
+            ChaosServicerProxy(self.servicer, self.chaos) if self.chaos is not None else self.servicer
+        )
+        self._grpc_server.add_generic_rpc_handlers((build_generic_handler(handler_target),))
+        self.port = self._grpc_server.add_insecure_port(f"127.0.0.1:{grpc_port}")
+        await self._grpc_server.start()
+        await self.blob_server.start()
+        await self.input_plane.start()
+        self._save_ports()
+        self.scheduler.start()
+
     async def _chaos_event_loop(self) -> None:
         """Fire scheduled chaos events (worker kill / preempt / heartbeat
         blackhole) once their output-count threshold passes."""
         while True:
             try:
                 for ev in self.chaos.pop_due_events():
+                    if ev.kind == "supervisor_crash":
+                        # control-plane crash-and-recover: worker-agnostic
+                        logger.warning("chaos: crashing + recovering the control plane")
+                        t = asyncio.create_task(self.crash_restart())
+                        self._chaos_subtasks.add(t)
+                        t.add_done_callback(self._chaos_subtasks.discard)
+                        continue
                     idx = min(ev.worker_index, len(self.workers) - 1)
                     if idx < 0:
                         continue
@@ -135,6 +234,69 @@ class LocalSupervisor:
         """Simulate a TPU-slice preemption notice for one worker: drain +
         graceful container stop + checkpoint flush + input requeue."""
         await self.workers[index].preempt(grace_s)
+
+    async def crash_restart(self) -> Optional[dict]:
+        """Simulated control-plane crash + journal recovery, in one process
+        (chaos `supervisor_crash` event; the subprocess analogue is kill -9 +
+        re-exec, tests/test_chaos_soak.py). The old ServerState is ABANDONED
+        — nothing is drained or flushed beyond what the journal already holds
+        — then a fresh state is rebuilt by replay and served on the same
+        ports. Worker agents are left running: their next heartbeat gets
+        `reannounce` or re-adopts the journal-recovered record."""
+        if not _journal_enabled():
+            logger.warning("supervisor_crash chaos event ignored: journaling is off")
+            return None
+        async with self._crash_lock:
+            return await self._crash_restart_locked()
+
+    async def _crash_restart_locked(self) -> Optional[dict]:
+        import time as _time
+
+        t0 = _time.time()
+        old_journal = self.state.journal
+        grpc_port, blob_port, input_port = (
+            self.port,
+            self.blob_server.port,
+            getattr(self.input_plane, "port", 0),
+        )
+        # this supervisor's workers are IN-PROCESS: a real crash of this
+        # process takes their container subprocesses with it — kill them so
+        # the simulation matches (the worker AGENTS survive and re-adopt;
+        # remote-worker orphan semantics are covered by the dedupe tests)
+        for worker in self.workers:
+            worker.kill_containers()
+        # abrupt teardown: no graceful drain, no state flush — in-flight RPCs
+        # see UNAVAILABLE and retry against the recovered plane
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=None)
+        await self.scheduler.stop()
+        await self.input_plane.stop()
+        await self.blob_server.stop()
+        if old_journal is not None:
+            old_journal.close()
+        # rebuild the whole control plane from the journal
+        self.state = ServerState(self.state_dir)
+        self.servicer = type(self.servicer)(self.state)
+        self.servicer.chaos = self.chaos
+        self.scheduler = Scheduler(self.state, self.servicer)
+        self.servicer.scheduler = self.scheduler
+        self.blob_server = BlobServer(self.state, port=blob_port, chaos=self.chaos)
+        self.input_plane = InputPlaneServer(
+            self.state, self.servicer, port=input_port, chaos=self.chaos
+        )
+        self.recover = True
+        self._attach_journal()
+        await self._start_control_plane(grpc_port)
+        tracing.record_span(
+            "recovery.crash_restart",
+            start=t0,
+            end=_time.time(),
+            attrs=dict(self.recovery_report or {}),
+        )
+        logger.warning(
+            f"control plane crash-restarted in {_time.time() - t0:.2f}s: {self.recovery_report}"
+        )
+        return self.recovery_report
 
     async def stop(self) -> None:
         # bounded: a supervisor that cannot shut down must not hang its host
@@ -165,6 +327,8 @@ class LocalSupervisor:
         await self.blob_server.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)
+        if self.state.journal is not None:
+            self.state.journal.close()
 
 
 async def serve_forever(

@@ -141,6 +141,7 @@ class WorkerAgent:
             num_chips = self._override_chips
         if self._override_type is not None:
             tpu_type = self._override_type
+        self._inventory = (tpu_type, num_chips, topology)
         # second data plane: the task command router clients dial directly
         # (reference task_command_router.proto — exec/stdio/FS on the worker)
         import grpc as _grpc
@@ -154,6 +155,17 @@ class WorkerAgent:
         router_port = self._router_server.add_insecure_port("127.0.0.1:0")
         await self._router_server.start()
         self.router_address = f"127.0.0.1:{router_port}"
+        await self._register()
+        self._tasks.append(asyncio.create_task(self._poll_loop(), name=f"worker-poll-{self.worker_id}"))
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop(), name=f"worker-hb-{self.worker_id}"))
+        logger.debug(f"worker {self.worker_id} registered ({num_chips} chips, type={tpu_type!r})")
+
+    async def _register(self) -> None:
+        """(Re-)announce this host to the control plane. Reused verbatim when
+        a restarted control plane answers a heartbeat with `reannounce` or a
+        poll with NOT_FOUND: the SAME worker_id is presented, so a journal-
+        recovered WorkerState is replaced in place instead of colliding."""
+        tpu_type, num_chips, topology = self._inventory
         resp = await retry_transient_errors(
             self._stub.WorkerRegister,
             api_pb2.WorkerRegisterRequest(
@@ -176,9 +188,6 @@ class WorkerAgent:
             max_delay=2.0,
         )
         self.worker_id = resp.worker_id
-        self._tasks.append(asyncio.create_task(self._poll_loop(), name=f"worker-poll-{self.worker_id}"))
-        self._tasks.append(asyncio.create_task(self._heartbeat_loop(), name=f"worker-hb-{self.worker_id}"))
-        logger.debug(f"worker {self.worker_id} registered ({num_chips} chips, type={tpu_type!r})")
 
     async def stop(self) -> None:
         self._stopped = True
@@ -209,7 +218,7 @@ class WorkerAgent:
     async def _heartbeat_loop(self) -> None:
         while not self._stopped:
             try:
-                await retry_transient_errors(
+                resp = await retry_transient_errors(
                     self._stub.WorkerHeartbeat,
                     api_pb2.WorkerHeartbeatRequest(
                         worker_id=self.worker_id,
@@ -219,6 +228,12 @@ class WorkerAgent:
                     ),
                     max_retries=2,
                 )
+                if resp.reannounce:
+                    # the control plane restarted without our registration
+                    # (e.g. journal disabled or record compacted away):
+                    # re-register under the same id immediately
+                    logger.warning(f"worker {self.worker_id} unknown to control plane; re-announcing")
+                    await self._register()
             except Exception as exc:
                 logger.warning(f"worker heartbeat failed: {exc}")
             await asyncio.sleep(5.0)
@@ -315,6 +330,22 @@ class WorkerAgent:
             except Exception as exc:
                 if self._stopped:
                     return
+                import grpc as _grpc
+
+                if (
+                    isinstance(exc, _grpc.aio.AioRpcError)
+                    and exc.code() == _grpc.StatusCode.NOT_FOUND
+                ):
+                    # restarted control plane doesn't know this worker id:
+                    # re-announce (same id), then resume polling
+                    try:
+                        logger.warning(
+                            f"worker {self.worker_id} poll NOT_FOUND; re-announcing to control plane"
+                        )
+                        await self._register()
+                        continue
+                    except Exception as reg_exc:  # noqa: BLE001
+                        logger.warning(f"worker re-announce failed: {reg_exc}")
                 logger.warning(f"worker poll stream broke ({exc}); reconnecting")
                 await asyncio.sleep(0.5)
 

@@ -207,6 +207,67 @@ def test_every_implemented_rpc_is_instrumented():
     assert "modal_tpu_client_rpc_latency_seconds" in METRIC_CATALOG
 
 
+@pytest.mark.recovery
+def test_every_mutating_rpc_is_journal_covered():
+    """Journal-coverage parity (server/journal.py): every RPC the control
+    plane implements must be classified — journaled (its effects replay
+    after a crash), read-only, or explicitly exempt WITH a reason. An RPC
+    that mutates ServerState but is none of the three would silently lose
+    state across a supervisor restart — fail it loudly here, so adding an
+    RPC forces a durability decision."""
+    import inspect
+
+    from modal_tpu.server.journal import _APPLIERS, EXEMPT_RPCS, IDEMPOTENT_RPCS, JOURNALED_RPCS
+    from modal_tpu.server.services import ModalTPUServicer
+
+    implemented = {
+        name
+        for name, fn in vars(ModalTPUServicer).items()
+        if name[:1].isupper()
+        and (inspect.iscoroutinefunction(fn) or inspect.isasyncgenfunction(fn))
+    }
+    assert implemented, "servicer implements no RPCs?"
+    classified = JOURNALED_RPCS | set(EXEMPT_RPCS)
+    # RPCs not classified at all must be read-only BY DECLARATION: the
+    # journal module is the single place durability decisions live, so an
+    # unclassified mutating RPC is indistinguishable from a forgotten one —
+    # keep the unclassified set pinned to the known read-only surface.
+    readonly = implemented - classified
+    KNOWN_READONLY = {
+        # pure lookups / long-polls / streams — no ServerState mutation that
+        # must survive a restart
+        "AppCountLogs", "AppDeploymentHistory", "AppFetchLogs", "AppGetByDeploymentName",
+        "AppGetLayout", "AppGetLogs", "AppList", "AppListProfiles", "AuthTokenGet",
+        "BlobGet", "ClientHello", "ClusterList", "DictContains", "DictContents",
+        "DictGet", "DictLen", "DictList", "EnvironmentList", "FunctionCallGetData",
+        "FunctionCallGetInfo", "FunctionCallList", "FunctionGet", "FunctionGetCurrentStats",
+        "FunctionGetWebUrl", "ImageFromId", "ImageJoinStreaming", "ImageList",
+        "MapCheckInputs", "ProxyGet", "ProxyList", "QueueLen", "QueueList",
+        "QueueNextItems", "SandboxGetFromName",
+        "SandboxGetCommandRouterAccess", "SandboxGetLogs", "SandboxGetStdin",
+        "SandboxGetTaskId", "SandboxGetTunnels", "SandboxList", "SandboxSidecarList",
+        "SandboxSnapshotGet", "SandboxWait", "SecretList", "TaskGetTimeline", "TaskList",
+        "VolumeBlockGet", "VolumeGetFile2", "VolumeList", "VolumeListFiles", "VolumeReload",
+        "WorkerPoll", "WorkspaceMemberList", "WorkspaceNameLookup", "WorkspaceSettingsList",
+    }
+    unclassified = readonly - KNOWN_READONLY
+    assert not unclassified, (
+        f"RPCs with no durability classification (add to JOURNALED_RPCS, EXEMPT_RPCS "
+        f"with a reason, or — if truly read-only — KNOWN_READONLY here): {sorted(unclassified)}"
+    )
+    # classifications must reference real handlers (catch renames/typos)
+    for name in (JOURNALED_RPCS | set(EXEMPT_RPCS) | IDEMPOTENT_RPCS) - {
+        # input-plane delegations journal via the control servicer's helpers
+        "MapStartOrContinue", "AttemptStart", "AttemptRetry",
+    }:
+        assert name in implemented, f"journal coverage map names unknown RPC {name!r}"
+    # deduped RPCs must also be journaled (the seen-set IS journal-backed)
+    assert IDEMPOTENT_RPCS <= JOURNALED_RPCS
+    # every record type a handler can emit has a replay applier
+    assert {"app", "function", "call", "input", "output", "consumed", "worker",
+            "rpc_dedupe", "input_retry", "input_token"} <= set(_APPLIERS)
+
+
 @pytest.mark.observability
 def test_blob_http_routes_chaos_and_metrics_parity(tmp_path):
     """Instrumentation parity for the HTTP data plane, extended to the

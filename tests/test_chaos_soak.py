@@ -8,6 +8,10 @@ Run explicitly: `pytest -m chaos` (or `-m slow`).
 
 from __future__ import annotations
 
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import pytest
@@ -101,6 +105,149 @@ def test_soak_map_survives_faults_and_preemption(chaotic_supervisor):
         f"{sum(sup.chaos.injected.values())} faults injected, "
         f"fault log head: {sup.chaos.fault_log[:8]}"
     )
+
+
+def _count_journal_records(state_dir: str, record_type: str) -> int:
+    import glob
+    import json as _json
+
+    n = 0
+    for path in glob.glob(os.path.join(state_dir, "journal", "segment-*.jsonl")):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        if _json.loads(line).get("t") == record_type:
+                            n += 1
+                    except _json.JSONDecodeError:
+                        continue
+        except OSError:
+            continue
+    return n
+
+
+def _spawn_supervisor(port: int, state_dir: str, tmp_path) -> "subprocess.Popen":
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MODAL_TPU_JAX_PLATFORM"] = "cpu"
+    env["MODAL_TPU_AUTO_LOCAL_SERVER"] = "0"
+    env["MODAL_TPU_STATE_DIR"] = state_dir
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(os.path.join(str(tmp_path), f"supervisor-{time.time_ns()}.log"), "wb")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "modal_tpu.server",
+            "--port",
+            str(port),
+            "--workers",
+            "2",
+            "--state-dir",
+            state_dir,
+        ],
+        env=env,
+        stdout=log,
+        stderr=log,
+        start_new_session=True,
+    )
+
+
+def _wait_port(port: int, timeout_s: float = 60.0) -> None:
+    import socket
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"control plane on port {port} never came up")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.recovery
+def test_kill9_supervisor_mid_map_recovers_exactly_once(tmp_path, monkeypatch):
+    """ISSUE 4 acceptance: a kill -9'd supervisor recovers from its journal —
+    an in-flight 50-input map resumes after the restart (same port, same
+    state dir) and delivers every output exactly once. The client is NOT
+    restarted: its retry loops must ride the outage transparently (channel
+    re-dial + call-resume by function_call_id)."""
+    import threading
+
+    import modal_tpu
+    from modal_tpu._utils.grpc_utils import find_free_port
+    from modal_tpu.client import _Client
+
+    state_dir = str(tmp_path / "state")
+    port = find_free_port()
+    proc = _spawn_supervisor(port, state_dir, tmp_path)
+    procs = [proc]
+    try:
+        _wait_port(port)
+        monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{port}")
+        _Client.set_env_client(None)
+
+        app = modal_tpu.App("kill9-soak")
+
+        def slow_square(x):
+            import time as _t
+
+            _t.sleep(0.15)
+            return x * x
+
+        f = app.function(serialized=True)(slow_square)
+        results: list = []
+        errors: list = []
+
+        def run_map():
+            try:
+                with app.run():
+                    results.extend(f.map(range(50)))
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        t = threading.Thread(target=run_map)
+        t.start()
+        # kill once the map is genuinely mid-flight: >= 8 outputs journaled
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if _count_journal_records(state_dir, "output") >= 8:
+                break
+            if not t.is_alive():
+                pytest.fail(f"map finished/died before the kill window (errors={errors})")
+            time.sleep(0.25)
+        else:
+            pytest.fail("map never produced enough outputs to kill mid-flight")
+        os.killpg(proc.pid, signal.SIGKILL)  # the whole process group: workers too
+        proc.wait(timeout=30)
+        # restart on the same port + state dir: recovery replays the journal
+        proc2 = _spawn_supervisor(port, state_dir, tmp_path)
+        procs.append(proc2)
+        _wait_port(port)
+        t.join(timeout=300)
+        assert not t.is_alive(), "map never completed after supervisor restart"
+        assert not errors, f"map failed across the kill -9: {errors}"
+        assert len(results) == 50, f"expected 50 outputs exactly once, got {len(results)}"
+        assert sorted(results) == [x * x for x in range(50)], "lost/duplicated/corrupted results"
+    finally:
+        env_client = _Client._client_from_env
+        if env_client is not None and not env_client._closed:
+            env_client._close()
+        _Client.set_env_client(None)
+        for p in procs:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 @pytest.mark.slow

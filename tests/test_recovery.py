@@ -1,0 +1,554 @@
+"""Durable control plane (ISSUE 4): journal replay, compaction, idempotency
+dedupe, worker re-adoption, and in-process crash recovery.
+
+The kill -9 subprocess soak lives in tests/test_chaos_soak.py (slow tier);
+these run in tier 1 (`pytest -m recovery` selects just them).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.recovery
+
+
+class _Ctx:
+    """Minimal grpc context for direct handler calls."""
+
+    def invocation_metadata(self):
+        return ()
+
+    async def abort(self, code, details=""):
+        raise RuntimeError(f"abort {code}: {details}")
+
+
+async def _build_servicer(state_dir: str, with_journal: bool = True):
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.journal import IdempotencyCache, Journal
+    from modal_tpu.server.services import ModalTPUServicer
+    from modal_tpu.server.state import ServerState
+
+    state = ServerState(state_dir)
+    if with_journal:
+        state.journal = Journal(state_dir)
+        state.idempotency = IdempotencyCache(journal=state.journal)
+    servicer = ModalTPUServicer(state)
+    ctx = _Ctx()
+    app = await servicer.AppCreate(api_pb2.AppCreateRequest(description="rec"), ctx)
+    fn = await servicer.FunctionCreate(
+        api_pb2.FunctionCreateRequest(
+            app_id=app.app_id, function=api_pb2.Function(function_name="f"), tag="f"
+        ),
+        ctx,
+    )
+    call = await servicer.FunctionMap(
+        api_pb2.FunctionMapRequest(
+            function_id=fn.function_id, function_call_type=api_pb2.FUNCTION_CALL_TYPE_MAP
+        ),
+        ctx,
+    )
+    return servicer, ctx, fn.function_id, call.function_call_id
+
+
+def _recovered_state(state_dir: str):
+    from modal_tpu.server.journal import IdempotencyCache, Journal, recover_state
+    from modal_tpu.server.state import ServerState
+
+    state = ServerState(state_dir)
+    state.idempotency = IdempotencyCache(journal=None)
+    journal = Journal(state_dir)
+    report = recover_state(state, journal)
+    state.journal = journal
+    state.idempotency.journal = journal
+    return state, report
+
+
+# ---------------------------------------------------------------------------
+# journal mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_segments_and_torn_tail(tmp_path):
+    from modal_tpu.server import journal as J
+
+    j = J.Journal(str(tmp_path))
+    for i in range(10):
+        j.append("environment", name=f"env-{i}")
+    j.close()
+    # torn trailing line (crash mid-write) must be skipped, not crash replay
+    seg = sorted(p for p in os.listdir(j.dir) if p.startswith("segment-"))[-1]
+    with open(os.path.join(j.dir, seg), "a") as f:
+        f.write('{"seq": 11, "t": "environ')
+    j2 = J.Journal(str(tmp_path))
+    snap, tail = j2.replay()
+    assert snap == []
+    assert [r["name"] for r in tail] == [f"env-{i}" for i in range(10)]
+    assert [r["seq"] for r in tail] == list(range(1, 11))
+    # reopened journal continues the sequence monotonically
+    assert j2.append("environment", name="env-next") == 11
+    j2.close()
+
+
+def test_journal_segment_rotation(tmp_path, monkeypatch):
+    from modal_tpu.server import journal as J
+
+    monkeypatch.setattr(J, "SEGMENT_MAX_RECORDS", 5)
+    j = J.Journal(str(tmp_path))
+    for i in range(12):
+        j.append("environment", name=f"e{i}")
+    segments = [p for p in os.listdir(j.dir) if p.startswith("segment-")]
+    assert len(segments) == 3  # 5 + 5 + 2
+    _, tail = j.replay()
+    assert len(tail) == 12 and [r["seq"] for r in tail] == list(range(1, 13))
+    j.close()
+
+
+async def test_snapshot_compaction_prunes_and_replays_equivalently(tmp_path):
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.journal import synthesize_records
+
+    servicer, ctx, fn_id, call_id = await _build_servicer(str(tmp_path / "a"))
+    resp = await servicer.FunctionPutInputs(
+        api_pb2.FunctionPutInputsRequest(
+            function_id=fn_id,
+            function_call_id=call_id,
+            inputs=[
+                api_pb2.FunctionPutInputsItem(idx=i, input=api_pb2.FunctionInput(args=b"x" * 64))
+                for i in range(20)
+            ],
+        ),
+        ctx,
+    )
+    # half the inputs deliver
+    for item in list(resp.inputs)[:10]:
+        await servicer.FunctionPutOutputs(
+            api_pb2.FunctionPutOutputsRequest(
+                outputs=[
+                    api_pb2.FunctionPutOutputsItem(
+                        function_call_id=call_id,
+                        input_id=item.input_id,
+                        idx=item.idx,
+                        result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+                    )
+                ]
+            ),
+            ctx,
+        )
+    j = servicer.s.journal
+    pre_status = j.status()
+    assert pre_status["tail_records"] > 30
+    j.write_snapshot(synthesize_records(servicer.s))
+    post_status = j.status()
+    assert post_status["snapshot_seq"] == j.seq
+    assert post_status["bytes"] < pre_status["bytes"] or post_status["tail_records"] <= 1
+    j.close()
+    # replay from the snapshot reproduces the call exactly
+    state, report = _recovered_state(str(tmp_path / "a"))
+    call = state.function_calls[call_id]
+    assert call.num_inputs == 20 and call.num_done == 10
+    assert len(call.outputs) == 10
+    assert sorted(len(state.inputs) for _ in [0]) == [20]
+    fn = state.functions[fn_id]
+    assert len(fn.pending) == 10  # unfinished inputs back in the queue
+    state.journal.close()
+
+
+def test_declined_recovery_archives_old_journal(tmp_path):
+    """recover=False must not leave the abandoned records where the NEXT
+    boot's auto-recovery would merge them back in."""
+    from modal_tpu.server.journal import Journal, archive_existing
+
+    j = Journal(str(tmp_path))
+    j.append("environment", name="ghost")
+    j.close()
+    dest = archive_existing(str(tmp_path))
+    assert dest is not None and os.path.isdir(dest)
+    fresh = Journal(str(tmp_path))
+    assert not fresh.has_records() and fresh.seq == 0
+    assert archive_existing(str(tmp_path)) is None  # nothing left to archive
+    fresh.close()
+
+
+def test_journal_files_are_owner_only(tmp_path):
+    """Records carry token secrets / secret env dicts: segments, snapshots,
+    and the journal dir itself must be owner-only."""
+    import stat
+
+    from modal_tpu.server.journal import Journal
+
+    j = Journal(str(tmp_path))
+    j.append("token", token_id="tk-x", token_secret="ts-secret")
+    j.write_snapshot([{"t": "environment", "name": "e"}])
+    for name in os.listdir(j.dir):
+        full = os.path.join(j.dir, name)
+        if name.endswith(".jsonl"):
+            assert stat.S_IMODE(os.stat(full).st_mode) == 0o600, name
+    assert stat.S_IMODE(os.stat(j.dir).st_mode) == 0o700
+    j.close()
+
+
+async def test_compact_async_keeps_racing_appends(tmp_path):
+    """The supervisor's off-loop compaction covers only the seq captured at
+    synthesis time: records appended while the snapshot file is being written
+    survive in the tail."""
+    from modal_tpu.server import journal as J
+
+    j = J.Journal(str(tmp_path))
+    for i in range(6):
+        j.append("environment", name=f"e{i}")
+    records = [{"t": "environment", "name": f"e{i}"} for i in range(6)]
+    covered = j.seq
+    await j.compact_async(records)
+    j.append("environment", name="late")  # lands after the snapshot's coverage
+    snap, tail = j.replay()
+    assert len(snap) == 6
+    assert [r["name"] for r in tail] == ["late"] and tail[0]["seq"] == covered + 1
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# recovery semantics
+# ---------------------------------------------------------------------------
+
+
+async def test_recovery_requeues_claimed_inputs_and_dedupes_outputs(tmp_path):
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.state import make_id
+
+    servicer, ctx, fn_id, call_id = await _build_servicer(str(tmp_path / "s"))
+    resp = await servicer.FunctionPutInputs(
+        api_pb2.FunctionPutInputsRequest(
+            function_id=fn_id,
+            function_call_id=call_id,
+            inputs=[
+                api_pb2.FunctionPutInputsItem(idx=i, input=api_pb2.FunctionInput(args=b"p"))
+                for i in range(6)
+            ],
+        ),
+        ctx,
+    )
+    items = list(resp.inputs)
+    # simulate claims (claims are NOT journaled — by design they must recover
+    # as pending) and a checkpointed resume token
+    for item in items[:3]:
+        inp = servicer.s.inputs[item.input_id]
+        inp.status = "claimed"
+        inp.claimed_by = "ta-dead"
+        servicer.s.functions[fn_id].pending.remove(item.input_id)
+    await servicer.ContainerCheckpoint(
+        api_pb2.ContainerCheckpointRequest(
+            task_id="ta-dead", input_id=items[0].input_id, resume_token="step-41"
+        ),
+        ctx,
+    )
+    # one claimed input DID report before the crash
+    await servicer.FunctionPutOutputs(
+        api_pb2.FunctionPutOutputsRequest(
+            outputs=[
+                api_pb2.FunctionPutOutputsItem(
+                    function_call_id=call_id,
+                    input_id=items[2].input_id,
+                    idx=items[2].idx,
+                    result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+                )
+            ]
+        ),
+        ctx,
+    )
+    servicer.s.journal.close()
+
+    state, report = _recovered_state(str(tmp_path / "s"))
+    assert report["records_applied"] > 0 and report["records_skipped"] == 0
+    call = state.function_calls[call_id]
+    assert call.num_inputs == 6 and call.num_done == 1
+    # every unfinished input recovered as pending (claims were orphaned)
+    unfinished = [i for i in state.inputs.values() if i.status == "pending"]
+    assert len(unfinished) == 5
+    assert report["inputs_requeued"] == 5
+    fn = state.functions[fn_id]
+    assert sorted(fn.pending) == sorted(i.input_id for i in unfinished)
+    # the resume token survived, so the requeued attempt resumes mid-work
+    assert state.inputs[items[0].input_id].resume_token == "step-41"
+    # exactly-once: the dead attempt's duplicate report is dropped on the
+    # recovered state (same input_id + retry_count dedupe key)
+    from modal_tpu.server.services import ModalTPUServicer
+
+    recovered_servicer = ModalTPUServicer(state)
+    await recovered_servicer.FunctionPutOutputs(
+        api_pb2.FunctionPutOutputsRequest(
+            outputs=[
+                api_pb2.FunctionPutOutputsItem(
+                    function_call_id=call_id,
+                    input_id=items[2].input_id,
+                    idx=items[2].idx,
+                    result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+                )
+            ]
+        ),
+        ctx,
+    )
+    assert call.num_done == 1 and len(call.outputs) == 1
+    # id counters advanced past recovered ids: no collisions possible
+    assert make_id("in") not in state.inputs
+    assert make_id("fc") not in state.function_calls
+    state.journal.close()
+
+
+async def test_app_deploy_replay_keeps_deployed_functions(tmp_path):
+    """An AppDeploy after AppPublish must not wipe the deployed-function map
+    on replay (only publish records re-key it)."""
+    from modal_tpu.proto import api_pb2
+
+    servicer, ctx, fn_id, _ = await _build_servicer(str(tmp_path / "d"))
+    app_id = next(iter(servicer.s.apps))
+    await servicer.AppPublish(
+        api_pb2.AppPublishRequest(
+            app_id=app_id,
+            name="depl",
+            app_state=api_pb2.APP_STATE_DEPLOYED,
+            function_ids={"f": fn_id},
+        ),
+        ctx,
+    )
+    await servicer.AppDeploy(api_pb2.AppDeployRequest(app_id=app_id, name="depl"), ctx)
+    assert servicer.s.deployed_functions[("", "depl", "f")] == fn_id
+    servicer.s.journal.close()
+    state, _ = _recovered_state(str(tmp_path / "d"))
+    assert state.deployed_functions.get(("", "depl", "f")) == fn_id
+    assert state.deployed_apps.get(("", "depl")) == app_id
+    state.journal.close()
+
+
+async def test_recovered_worker_awaits_readoption(tmp_path):
+    from modal_tpu.proto import api_pb2
+
+    servicer, ctx, _, _ = await _build_servicer(str(tmp_path / "w"))
+    resp = await servicer.WorkerRegister(
+        api_pb2.WorkerRegisterRequest(hostname="h1", num_chips=8, tpu_type="local-sim"),
+        ctx,
+    )
+    servicer.s.journal.close()
+    state, report = _recovered_state(str(tmp_path / "w"))
+    worker = state.workers[resp.worker_id]
+    assert worker.adoption_pending and worker.num_chips == 8
+    assert report["workers_pending_adoption"] == 1
+    # the next heartbeat re-adopts it
+    from modal_tpu.server.services import ModalTPUServicer
+
+    recovered = ModalTPUServicer(state)
+    await recovered.WorkerHeartbeat(
+        api_pb2.WorkerHeartbeatRequest(worker_id=resp.worker_id), ctx
+    )
+    assert not worker.adoption_pending
+    # a heartbeat from an id nobody ever journaled instructs re-announce
+    hb = await recovered.WorkerHeartbeat(
+        api_pb2.WorkerHeartbeatRequest(worker_id="wk-ghost"), ctx
+    )
+    assert hb.reannounce
+    state.journal.close()
+
+
+def test_recovered_attempt_tokens_never_collide(tmp_path):
+    """A re-minted attempt token colliding with a recovered one would resolve
+    a surviving client's AttemptAwait to the WRONG input's result — recovery
+    must advance the 'at' id counter past every recovered token."""
+    from modal_tpu.server.journal import Journal
+    from modal_tpu.server.state import make_id
+
+    j = Journal(str(tmp_path))
+    tokens = [make_id("at") for _ in range(3)]
+    for tok in tokens:
+        j.append("attempt", token=tok, call_id="fc-x", input_id="in-x")
+    j.close()
+    state, _ = _recovered_state(str(tmp_path))
+    assert set(tokens) <= set(state.attempts)
+    fresh = make_id("at")
+    assert fresh not in state.attempts, f"fresh token {fresh} collides with a recovered one"
+    state.journal.close()
+
+
+def test_idempotency_cache_bounded_and_journal_backed(tmp_path):
+    from modal_tpu.server.journal import IdempotencyCache, Journal
+
+    j = Journal(str(tmp_path))
+    cache = IdempotencyCache(journal=j, max_entries=3)
+    for i in range(5):
+        cache.put(f"k{i}", "FunctionMap", f"resp-{i}".encode())
+    assert len(cache) == 3
+    assert cache.get("k0", "FunctionMap") is None  # evicted oldest-first
+    assert cache.get("k4", "FunctionMap") == b"resp-4"
+    assert cache.get("k4", "WrongMethod") is None  # method must match
+    j.close()
+    # replayed cache answers the same keys after a "restart"
+    from modal_tpu.server.journal import recover_state
+    from modal_tpu.server.state import ServerState
+
+    state = ServerState(str(tmp_path / "st"))
+    state.idempotency = IdempotencyCache(journal=None)
+    j2 = Journal(str(tmp_path))
+    recover_state(state, j2)
+    assert state.idempotency.get("k4", "FunctionMap") == b"resp-4"
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# end to end (real gRPC; supervisor fixture from conftest)
+# ---------------------------------------------------------------------------
+
+
+def test_function_map_retry_storm_is_exactly_once(supervisor):
+    """The same FunctionMap request re-sent with one idempotency key (what a
+    retry_transient_errors reconnect storm produces) must create ONE call."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.grpc_utils import create_channel
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.proto.rpc import ModalTPUStub
+
+    async def go():
+        channel = create_channel(supervisor.server_url)
+        stub = ModalTPUStub(channel)
+        app = await stub.AppCreate(api_pb2.AppCreateRequest(description="dedupe"))
+        fn = await stub.FunctionCreate(
+            api_pb2.FunctionCreateRequest(
+                app_id=app.app_id, function=api_pb2.Function(function_name="g"), tag="g"
+            )
+        )
+        req = api_pb2.FunctionMapRequest(
+            function_id=fn.function_id, function_call_type=api_pb2.FUNCTION_CALL_TYPE_MAP
+        )
+        md = [("x-idempotency-key", "storm-1")]
+        first = await stub.FunctionMap(req, metadata=md)
+        second = await stub.FunctionMap(req, metadata=md)
+        third = await stub.FunctionMap(req, metadata=[("x-idempotency-key", "storm-2")])
+        await channel.close()
+        return first, second, third
+
+    first, second, third = synchronizer.run(go())
+    assert first.function_call_id == second.function_call_id
+    assert third.function_call_id != first.function_call_id
+    calls = supervisor.state.function_calls
+    assert first.function_call_id in calls and third.function_call_id in calls
+    from modal_tpu.observability.catalog import IDEMPOTENT_REPLAYS
+
+    assert IDEMPOTENT_REPLAYS.value(method="FunctionMap") >= 1
+
+
+def test_crash_restart_resumes_open_map_exactly_once(supervisor):
+    """In-process crash simulation (the chaos `supervisor_crash` event): an
+    in-flight map survives the control plane abandoning its entire state and
+    rebuilding from the journal mid-run; every output arrives exactly once.
+    (The kill -9 subprocess variant is tests/test_chaos_soak.py.)"""
+    import threading
+    import time as _time
+
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+
+    sup = supervisor
+    app = modal_tpu.App("recovery-map")
+
+    def slow_double(x):
+        import time as _t
+
+        _t.sleep(0.3)
+        return x * 2
+
+    f = app.function(serialized=True)(slow_double)
+    results: list = []
+    errors: list = []
+
+    def run_map():
+        try:
+            with app.run():
+                results.extend(f.map(range(12)))
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    t = threading.Thread(target=run_map)
+    t.start()
+    # wait until the map is genuinely mid-flight (some outputs delivered)
+    deadline = _time.monotonic() + 90
+    while _time.monotonic() < deadline:
+        done = sum(c.num_done for c in sup.state.function_calls.values())
+        if done >= 2:
+            break
+        _time.sleep(0.1)
+    else:
+        t.join(timeout=5)
+        pytest.fail(f"map never got going (errors={errors})")
+    report = synchronizer.run(sup.crash_restart())
+    assert report is not None and report["records_applied"] > 0
+    t.join(timeout=240)
+    assert not t.is_alive(), "map did not finish after crash_restart"
+    assert not errors, f"map failed across restart: {errors}"
+    assert sorted(results) == [x * 2 for x in range(12)], "outputs lost or duplicated"
+    from modal_tpu.observability.catalog import RECOVERIES
+
+    assert RECOVERIES.value(outcome="ok") >= 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+async def test_cli_journal_status_and_compact(tmp_path):
+    from click.testing import CliRunner
+
+    from modal_tpu.cli.entry_point import cli as cli_root
+    from modal_tpu.proto import api_pb2
+
+    state_dir = str(tmp_path / "state")
+    servicer, ctx, fn_id, call_id = await _build_servicer(state_dir)
+    await servicer.FunctionPutInputs(
+        api_pb2.FunctionPutInputsRequest(
+            function_id=fn_id,
+            function_call_id=call_id,
+            inputs=[
+                api_pb2.FunctionPutInputsItem(idx=i, input=api_pb2.FunctionInput(args=b"z"))
+                for i in range(8)
+            ],
+        ),
+        ctx,
+    )
+    servicer.s.journal.close()
+
+    result = CliRunner().invoke(cli_root, ["journal", "status", "--state-dir", state_dir, "--json"])
+    assert result.exit_code == 0, result.output
+    st = json.loads(result.output)
+    assert st["tail_records"] > 0 and st["records_by_type"]["input"] == 8
+
+    result = CliRunner().invoke(cli_root, ["journal", "compact", "--state-dir", state_dir])
+    assert result.exit_code == 0, result.output
+    assert "compacted" in result.output
+
+    result = CliRunner().invoke(cli_root, ["journal", "status", "--state-dir", state_dir, "--json"])
+    st = json.loads(result.output)
+    assert st["snapshot_seq"] == st["seq"] and st["tail_records"] <= 1
+
+    # compacted journal still recovers the full picture
+    state, _ = _recovered_state(state_dir)
+    assert state.function_calls[call_id].num_inputs == 8
+    state.journal.close()
+
+    result = CliRunner().invoke(cli_root, ["journal", "status", "--state-dir", str(tmp_path / "nope")])
+    assert result.exit_code != 0 and "no journal" in result.output
+
+
+def test_cli_metrics_reports_stale_breadcrumb(tmp_path):
+    from click.testing import CliRunner
+
+    from modal_tpu._utils.grpc_utils import find_free_port
+    from modal_tpu.cli.entry_point import cli as cli_root
+
+    state_dir = tmp_path / "state"
+    obs = state_dir / "observability"
+    obs.mkdir(parents=True)
+    # breadcrumb left behind by a dead supervisor: nothing listens there
+    (obs / "metrics_url").write_text(f"http://127.0.0.1:{find_free_port()}/metrics\n")
+    result = CliRunner().invoke(cli_root, ["metrics", "--state-dir", str(state_dir)])
+    assert result.exit_code != 0
+    assert "stale" in result.output and "not answering" in result.output
