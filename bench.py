@@ -219,11 +219,14 @@ def _make_app(tpu_type: str, timeout_s: int):
             # cold path: weights on device + prefill + the FUSED decode scan
             # (the SAME program the measure phase times, so cold numbers
             # describe the real decode path). The server's first_output_at
-            # for this call IS cold-start-to-first-step.
+            # for this call IS cold-start-to-first-step. Init runs under ONE
+            # jit so it is a single XLA computation the persistent
+            # compilation cache can serve (eager per-param init is pure
+            # Python tracing overhead no cache can remove).
             from modal_tpu.models.sampling import host_sync
 
             t0 = _time.perf_counter()
-            params = init_params(cfg, jax.random.PRNGKey(0))
+            params = jax.jit(lambda k: init_params(cfg, k))(jax.random.PRNGKey(0))
             host_sync(params)
             init_s = _time.perf_counter() - t0
             prompt = jnp.ones((batch, prompt_len), jnp.int32)
@@ -344,8 +347,18 @@ def _make_snap_app(tpu_type: str, timeout_s: int, model_name: str, use_volume_we
     return app, SnapModel
 
 
-def _snap_cold_start(app, snap_model, batch: int, prompt_len: int, fn_timeout: int):
+def _snap_cold_start(app, snap_model, batch: int, prompt_len: int, fn_timeout: int, sup=None):
     stats = None
+    warm_hit = False
+    pool = getattr(sup.workers[0], "pool", None) if sup is not None else None
+    if pool is not None and (pool.baseline > 0 or pool.targets or pool.directives):
+        # the A/B must ride the warm pool: wait for a parked interpreter so
+        # the measured path is handoff, not a racy fresh spawn. Skipped when
+        # the pool is configured empty (MODAL_TPU_BENCH_WARM_POOL=0) — the
+        # wait would poll a permanently-empty pool for the full timeout.
+        from modal_tpu._utils.async_utils import synchronizer as _sync
+
+        _sync.run(pool.wait_parked(1, 60.0))
     with app.run():
         obj = snap_model()
         fc = obj.first_step.spawn(batch, prompt_len)
@@ -355,9 +368,11 @@ def _snap_cold_start(app, snap_model, batch: int, prompt_len: int, fn_timeout: i
             stats = obj.get_load_stats.remote()
         except Exception:  # noqa: BLE001 — stats are additive
             pass
+    if tl.tasks:
+        warm_hit = bool(tl.tasks[0].warm_pool_hit)
     if tl.tasks and tl.tasks[0].first_output_at and tl.tasks[0].created_at:
-        return tl.tasks[0].first_output_at - tl.tasks[0].created_at, stats
-    return None, stats
+        return tl.tasks[0].first_output_at - tl.tasks[0].created_at, stats, warm_hit
+    return None, stats, warm_hit
 
 
 # ---------------------------------------------------------------------------
@@ -438,6 +453,24 @@ def child_main(mode: str) -> None:
 
     state_dir = tempfile.mkdtemp(prefix="modal_tpu_bench_")
     tpu_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    # Warm-pool cold starts (server/warm_pool.py): keep ONE pre-forked
+    # interpreter parked so the measured cold start is the handoff path —
+    # the production default this bench is supposed to certify. The timeline
+    # warm_pool_hit field proves which path actually served.
+    warm_pool = os.environ.get("MODAL_TPU_BENCH_WARM_POOL", "1") == "1"
+    if warm_pool:
+        os.environ["MODAL_TPU_WARM_POOL"] = "1"
+        # parked interpreters pay the import bill up front: jax plus the
+        # model/sampling modules the benched function body imports
+        os.environ.setdefault(
+            "MODAL_TPU_WARM_POOL_PREIMPORT",
+            "jax,modal_tpu.models.llama,modal_tpu.models.sampling,modal_tpu.models.quant",
+        )
+        if mode != "tpu":
+            # CPU fallback simulates the slice with the SAME device count the
+            # pool boots with, so backend pre-init while parked is safe (on
+            # real chips the per-task TPU_VISIBLE_DEVICES pinning forbids it)
+            os.environ.setdefault("MODAL_TPU_WARM_POOL_PREINIT", "1")
     sup = LocalSupervisor(
         num_workers=1,
         state_dir=state_dir,
@@ -447,6 +480,44 @@ def child_main(mode: str) -> None:
     synchronizer.run(sup.start())
     os.environ["MODAL_TPU_SERVER_URL"] = sup.server_url
     _Client.set_env_client(None)
+    if warm_pool:
+        # bounded: a pool that fails to park must not eat the bench budget —
+        # the run then just measures the fresh-spawn path (hit=False, honest)
+        synchronizer.run(sup.workers[0].pool.wait_parked(1, 90.0))
+
+    # Compile-cache prewarm (the Image.prewarm mechanism, modeled in-bench):
+    # run the SAME entry points once against the persistent XLA compilation
+    # cache (min-compile-time 0 so every kernel lands), then evict the pool
+    # interpreter that served it. The measured cold start below runs in a
+    # FRESH interpreter whose first input hits the on-disk cache — compile
+    # is a build-time cost, not a boot-time cost (docs/COLDSTART.md).
+    compile_cache_prewarmed = False
+    if (
+        warm_pool
+        and mode != "tpu"
+        and os.environ.get("MODAL_TPU_BENCH_PRECOMPILE", "1") == "1"
+    ):
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+        try:
+            prime_app, prime_fn = _make_app(tpu_type=f"{tpu_gen}-1", timeout_s=fn_timeout)
+            with prime_app.run():
+                prime_fn.remote("warmup", model_name, batch, prompt_len, gen_len)
+
+            async def _reset_pool(pool):
+                # kill the primed interpreter: its in-process jit caches must
+                # not masquerade as cold-start wins — only the PERSISTENT
+                # cache carries over to the fresh replacement
+                for e in list(pool.entries.values()):
+                    e.evicting = True
+                    try:
+                        e.proc.kill()
+                    except ProcessLookupError:
+                        pass
+                return await pool.wait_parked(1, 90.0)
+
+            compile_cache_prewarmed = synchronizer.run(_reset_pool(sup.workers[0].pool))
+        except Exception as exc:  # noqa: BLE001 — prewarm is additive
+            sys.stderr.write(f"bench: compile-cache prewarm failed: {exc}\n")
 
     app, llama_bench = _make_app(tpu_type=f"{tpu_gen}-1", timeout_s=fn_timeout)
 
@@ -491,8 +562,10 @@ def child_main(mode: str) -> None:
 
     # Honest cold start: server-stamped scheduler-assignment -> first output.
     cold_start_s = boot_s = exec_s = None
+    warm_pool_hit = False
     if tl.tasks:
         t0 = tl.tasks[0]
+        warm_pool_hit = bool(t0.warm_pool_hit)
         if t0.first_output_at and t0.created_at:
             cold_start_s = t0.first_output_at - t0.created_at
         if t0.started_at and t0.created_at:
@@ -532,6 +605,12 @@ def child_main(mode: str) -> None:
         "cold_start_to_first_step_s": round(cold_start_s, 2) if cold_start_s else None,
         "cold_start_boot_s": round(boot_s, 2) if boot_s else None,
         "cold_start_first_step_exec_s": round(exec_s, 2) if exec_s else None,
+        # acceptance proof: the measured cold start was served by a
+        # pre-forked warm-pool interpreter (handoff, no re-exec)
+        "warm_pool_hit": warm_pool_hit,
+        # the persistent XLA compile cache was primed (Image.prewarm model):
+        # the measured first step hit a warm on-disk cache in a FRESH process
+        "compile_cache_prewarmed": compile_cache_prewarmed,
         "weights_init_s": round(warm["weights_init_s"], 2),
         "prefill_compile_s": round(warm["prefill_compile_s"], 2),
         "warmup_call_wall_s": round(warm_wall_s, 2),
@@ -574,14 +653,19 @@ def child_main(mode: str) -> None:
             snap_app, snap_model = _make_snap_app(
                 f"{tpu_gen}-1", fn_timeout, model_name, use_volume_weights=bool(ckpt_export.get("ok"))
             )
-            cold_fresh, fresh_stats = _snap_cold_start(snap_app, snap_model, batch, prompt_len, fn_timeout)
-            cold_restore, _ = _snap_cold_start(snap_app, snap_model, batch, prompt_len, fn_timeout)
+            cold_fresh, fresh_stats, hit_a = _snap_cold_start(
+                snap_app, snap_model, batch, prompt_len, fn_timeout, sup=sup
+            )
+            cold_restore, _, hit_b = _snap_cold_start(
+                snap_app, snap_model, batch, prompt_len, fn_timeout, sup=sup
+            )
             if cold_fresh is not None:
                 result["cold_start_fresh_enter_s"] = round(cold_fresh, 2)
             if cold_restore is not None:
                 result["cold_start_snap_restore_s"] = round(cold_restore, 2)
             if cold_fresh and cold_restore:
                 result["snap_restore_speedup"] = round(cold_fresh / cold_restore, 2)
+            result["snap_warm_pool_hit"] = bool(hit_a and hit_b)
             if fresh_stats:
                 result["weights_from_volume"] = fresh_stats.get("from_volume", False)
                 result["weights_load_peak_rss_gb"] = round(fresh_stats["peak_rss_gb"], 2)
@@ -766,33 +850,47 @@ def _run_attempt(mode: str, timeout_s: float) -> dict | None:
     return None
 
 
-def _run_recovery_bench(timeout_s: float) -> dict | None:
-    """tools/bench_recovery.py in a subprocess (CPU, hermetic tmp state)."""
+def _run_microbench(label: str, script: str, sentinel: str, timeout_s: float) -> dict | None:
+    """Run a tools/ microbench in a subprocess (CPU, hermetic tmp state) and
+    parse its one sentinel-prefixed JSON line. Shared by the recovery and
+    coldstart phases so their env scrubbing can't drift."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    env["MODAL_TPU_JAX_PLATFORM"] = "cpu"
     env["MODAL_TPU_AUTO_LOCAL_SERVER"] = "0"
-    sys.stderr.write(f"bench[recovery]: microbench starting (budget {timeout_s:.0f}s)\n")
+    sys.stderr.write(f"bench[{label}]: microbench starting (budget {timeout_s:.0f}s)\n")
     try:
         out = subprocess.run(
-            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_recovery.py")],
+            [sys.executable, os.path.join(REPO_ROOT, "tools", script)],
             capture_output=True,
             timeout=timeout_s,
             text=True,
             env=env,
         )
     except subprocess.TimeoutExpired:
-        sys.stderr.write("bench[recovery]: timed out\n")
+        sys.stderr.write(f"bench[{label}]: timed out\n")
         return None
     for line in reversed(out.stdout.splitlines()):
-        if line.startswith("RECOVERY_BENCH_RESULT "):
+        if line.startswith(sentinel + " "):
             try:
-                return json.loads(line[len("RECOVERY_BENCH_RESULT "):])
+                return json.loads(line[len(sentinel) + 1 :])
             except json.JSONDecodeError:
                 return None
-    sys.stderr.write(f"bench[recovery]: no result (rc={out.returncode})\n")
+    sys.stderr.write(f"bench[{label}]: no result (rc={out.returncode})\n")
     return None
+
+
+def _run_coldstart_bench(timeout_s: float) -> dict | None:
+    """tools/bench_coldstart.py: fresh-spawn vs warm-pool handoff vs
+    snapshot A/B, server-stamped."""
+    return _run_microbench("coldstart", "bench_coldstart.py", "COLDSTART_BENCH_RESULT", timeout_s)
+
+
+def _run_recovery_bench(timeout_s: float) -> dict | None:
+    """tools/bench_recovery.py: journal overhead + replay throughput."""
+    return _run_microbench("recovery", "bench_recovery.py", "RECOVERY_BENCH_RESULT", timeout_s)
 
 
 def main() -> None:
@@ -843,9 +941,13 @@ def _orchestrate() -> None:
     # no matter what the tunnel does for the rest of the budget.
     if _remaining() > 60:
         _bank(_run_attempt("cpu", min(CPU_ATTEMPT_TIMEOUT_S, _remaining())))
+    # Additive microbench phases (2.5-2.7) are for REAL rounds: under the
+    # fake-result test hook they'd only burn the signal-delivery tests'
+    # bounded relay windows on subprocesses the tests never inspect.
+    fake_mode = bool(os.environ.get("MODAL_TPU_BENCH_FAKE_RESULT"))
     # Phase 2.5: 8B int8 smoke on CPU (VERDICT r4: the int8 path must execute
     # every round even when the chip is unreachable) — additive fields only.
-    if os.environ.get("MODAL_TPU_BENCH_8B", "1") == "1" and _remaining() > 120:
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_8B", "1") == "1" and _remaining() > 120:
         smoke = _run_attempt("smoke8b", min(SMOKE8B_TIMEOUT_S, _remaining()))
         if smoke is not None:
             if _BANK["best"] is None:
@@ -855,11 +957,19 @@ def _orchestrate() -> None:
     # Phase 2.6: durability microbench (tools/bench_recovery.py): journal
     # append overhead on the RPC hot path + 10k-record replay time —
     # additive fields only, never fatal (ISSUE 4 acceptance evidence).
-    if os.environ.get("MODAL_TPU_BENCH_RECOVERY", "1") == "1" and _remaining() > 150:
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_RECOVERY", "1") == "1" and _remaining() > 150:
         rec = _run_recovery_bench(min(240.0, _remaining()))
         if rec is not None and _BANK["best"] is not None:
             for k, v in rec.items():
                 _BANK["best"][f"recovery_{k}"] = v
+    # Phase 2.7: cold-start microbench (tools/bench_coldstart.py): fresh
+    # spawn vs warm-pool handoff vs snapshot A/B — additive coldstart_*
+    # fields (ISSUE 5 acceptance evidence; warm_pool_hit proves the path).
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_COLDSTART", "1") == "1" and _remaining() > 150:
+        cold = _run_coldstart_bench(min(240.0, _remaining()))
+        if cold is not None and _BANK["best"] is not None:
+            for k, v in cold.items():
+                _BANK["best"][f"coldstart_{k}"] = v
     # Phase 3: poll the relay for a bounded window (never against our own
     # total deadline — the round-3 killer), attempting TPU whenever it answers.
     while (

@@ -51,6 +51,13 @@ KNOB_RPCS: dict[str, frozenset] = {
 
 HEARTBEAT_RPCS = frozenset({"ContainerHeartbeat", "WorkerHeartbeat"})
 
+# Lifecycle knobs consumed OUTSIDE the RPC decision engine (budgeted one-shot
+# counters like the RPC-family knobs, but drained by the component they
+# target). warm_kill_handoff: the warm pool SIGKILLs the parked interpreter
+# right after the handoff payload is queued — the ack never lands and the
+# placement must fall back to a fresh spawn (docs/COLDSTART.md).
+LIFECYCLE_KNOBS = frozenset({"warm_kill_handoff"})
+
 # HTTP blob routes are injected under pseudo-RPC names so one policy and one
 # rate table cover the gRPC and HTTP planes alike. BlockGet is the volume
 # content-block route (GET /block/{sha}, Range-capable) the striped Volume
@@ -129,6 +136,9 @@ class ChaosPolicy:
         - MODAL_TPU_CHAOS_SUPERVISOR_CRASH_AFTER (int N: crash + journal-
           recover the control plane once N outputs have been produced;
           comma-separate for repeated crashes, e.g. "10,30")
+        - MODAL_TPU_CHAOS_WARM_KILL_HANDOFF (int N: kill the next N warm-pool
+          interpreters mid-handoff; the placements must fall back to fresh
+          spawns — server/warm_pool.py)
         """
         if os.environ.get("MODAL_TPU_CHAOS", "") not in ("1", "true", "yes"):
             return None
@@ -154,7 +164,7 @@ class ChaosPolicy:
                 rates[name.strip()] = float(rate)
             else:
                 rates[part] = default_rate
-        return cls(
+        policy = cls(
             seed=int(os.environ.get("MODAL_TPU_CHAOS_SEED", "0") or 0),
             error_rates=rates,
             default_error_rate=default_rate if apply_default else 0.0,
@@ -163,6 +173,14 @@ class ChaosPolicy:
             latency_rate=float(os.environ.get("MODAL_TPU_CHAOS_LATENCY_RATE", "1") or 1),
             events=events,
         )
+        try:
+            warm_kill = int(os.environ.get("MODAL_TPU_CHAOS_WARM_KILL_HANDOFF", "0") or 0)
+        except ValueError:
+            warm_kill = 0
+            logger.warning("ignoring malformed MODAL_TPU_CHAOS_WARM_KILL_HANDOFF")
+        if warm_kill > 0:
+            policy.fail_counts["warm_kill_handoff"] = warm_kill
+        return policy
 
     # -- deterministic decision engine --------------------------------------
 
@@ -275,12 +293,24 @@ class ChaosPolicy:
     # -- conftest knob surface ------------------------------------------------
 
     def set_knob(self, knob: str, count: int) -> None:
-        if knob not in KNOB_RPCS:
-            raise KeyError(f"unknown chaos knob {knob!r} (have {sorted(KNOB_RPCS)})")
+        if knob not in KNOB_RPCS and knob not in LIFECYCLE_KNOBS:
+            raise KeyError(
+                f"unknown chaos knob {knob!r} (have {sorted(KNOB_RPCS) + sorted(LIFECYCLE_KNOBS)})"
+            )
         self.fail_counts[knob] = count
 
     def get_knob(self, knob: str) -> int:
         return self.fail_counts.get(knob, 0)
+
+    def consume_knob(self, knob: str) -> bool:
+        """Drain one charge of a budgeted lifecycle knob (warm_kill_handoff
+        etc.); True = the component should inject its fault now."""
+        if self.fail_counts.get(knob, 0) <= 0:
+            return False
+        self.fail_counts[knob] -= 1
+        self._note_fault(knob, self.call_counts.get(knob, 0), f"{knob} budget")
+        self.call_counts[knob] = self.call_counts.get(knob, 0) + 1
+        return True
 
 
 class ChaosServicerProxy:

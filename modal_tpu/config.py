@@ -131,6 +131,14 @@ _SETTINGS: dict[str, _Setting] = {
     "local_workers": _Setting(1, int),
     # Force JAX platform inside containers (cpu for tests, tpu in prod).
     "jax_platform": _Setting(""),
+    # Warm-pool cold starts (server/warm_pool.py): baseline pre-forked
+    # parked interpreters per worker for the host-venv image (0 = off; the
+    # scheduler can additionally direct per-image pools via min/buffer
+    # containers). Env: MODAL_TPU_WARM_POOL.
+    "warm_pool": _Setting(0, int),
+    # Modules a parked interpreter imports at boot (the expensive part of
+    # cold start); comma-separated. Env: MODAL_TPU_WARM_POOL_PREIMPORT.
+    "warm_pool_preimport": _Setting("jax"),
     # Per-module import tracing in containers (cold-start attribution;
     # events land in <task_dir>/imports.jsonl — runtime/telemetry.py).
     "import_trace": _Setting(False, _to_boolean),

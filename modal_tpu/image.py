@@ -351,6 +351,36 @@ class _Image(_Object, type_prefix="im"):
             rep=f"{self._rep}.run_function({getattr(raw_f, '__name__', 'fn')!r})",
         )
 
+    def prewarm(
+        self,
+        raw_f: Callable,
+        *,
+        secrets: Sequence[_Secret] = (),
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        force_build: bool = False,
+    ) -> "_Image":
+        """Compile-cache prewarm at image-build time (cold-start elimination,
+        docs/COLDSTART.md): run `raw_f` during the build with the persistent
+        XLA compilation cache pointed INSIDE the image, so every jit entry
+        point the function traces is compiled once at build time and every
+        container cold start hits a warm cache. `raw_f` should call the
+        function's jit entry points on representative shapes.
+
+        XLA's ahead-of-time pipeline makes compilation a build-time, not
+        boot-time, cost — the TPU analogue of baking weights with
+        `run_function` (which this rides on: same build machinery, plus the
+        cache env wiring in server/image_builder.py)."""
+        return _Image._from_args(
+            base_images={"base": self},
+            dockerfile_commands=["#PREWARM"],
+            secrets=secrets,
+            build_function=raw_f,
+            build_function_args=(args, kwargs or {}),
+            force_build=force_build,
+            rep=f"{self._rep}.prewarm({getattr(raw_f, '__name__', 'fn')!r})",
+        )
+
     def imports(self):
         """Context manager guarding imports that only exist inside the image
         (reference _image.py imports())."""

@@ -96,6 +96,29 @@ IMAGE_BUILD_SECONDS = REGISTRY.histogram(
     buckets=(0.01, 0.1, 0.5, 1, 5, 15, 30, 60, 120, 300, 600),
 )
 
+# -- warm-pool cold starts (server/warm_pool.py, docs/COLDSTART.md) -----------
+
+WARM_POOL_SIZE = REGISTRY.gauge(
+    "modal_tpu_warm_pool_size",
+    "Pre-forked pool interpreters in this worker process, by state (booting|parked|serving).",
+    ("state",),
+)
+WARM_POOL_PLACEMENTS = REGISTRY.counter(
+    "modal_tpu_warm_pool_placements_total",
+    "Task placements by warm-pool outcome (hit | miss_empty | miss_key | miss_chips | handoff_failed).",
+    ("outcome",),
+)
+WARM_POOL_EVICTIONS = REGISTRY.counter(
+    "modal_tpu_warm_pool_evictions_total",
+    "Parked interpreters evicted, by reason (image_change | target_shrunk | drain | died | poisoned).",
+    ("reason",),
+)
+WARM_POOL_HANDOFF_SECONDS = REGISTRY.histogram(
+    "modal_tpu_warm_pool_handoff_seconds",
+    "Adoption latency: handoff enqueued to interpreter ack (the warm 'boot').",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10),
+)
+
 # -- blob data plane ----------------------------------------------------------
 
 BLOB_BYTES = REGISTRY.counter(

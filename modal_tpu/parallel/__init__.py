@@ -1,0 +1,3 @@
+from .mesh import AXIS_ORDER, MeshConstraintError, build_mesh, validate_mesh_constraints
+
+__all__ = ["AXIS_ORDER", "MeshConstraintError", "build_mesh", "validate_mesh_constraints"]

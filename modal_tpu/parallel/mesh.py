@@ -30,19 +30,52 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 AXIS_ORDER = ("data", "pipe", "expert", "fsdp", "seq", "model")
 
 
+class MeshConstraintError(ValueError):
+    """A mesh/model combination the workload layer cannot execute, rejected
+    at MESH-BUILD time — before any parameter initialization or compile —
+    instead of as a mid-run failure deep inside a jitted loss."""
+
+
+def validate_mesh_constraints(axes: dict[str, int], model_cfg=None) -> None:
+    """Documented composition constraints of the parallelism matrix.
+
+    pipe × expert (pipeline × MoE): the GPipe schedule shards the stacked
+    layer params over `pipe` and scans a uniform layer block per stage;
+    switch-MoE layers route tokens through an `expert`-sharded all-to-all
+    whose dispatch does not commute with the stage rotation. The composition
+    is unsupported — use expert parallelism (mesh `expert` axis) without
+    `pipe`, or a dense config with `pipe`. Raises MeshConstraintError so
+    callers fail before devoting minutes to sharded init/compile.
+    """
+    pipe = int(axes.get("pipe", 1) or 1)
+    expert = int(axes.get("expert", 1) or 1)
+    is_moe = bool(getattr(model_cfg, "is_moe", False)) if model_cfg is not None else False
+    if pipe > 1 and (expert > 1 or is_moe):
+        raise MeshConstraintError(
+            f"pipeline parallelism (pipe={pipe}) cannot compose with MoE layers "
+            f"(expert={expert}, is_moe={is_moe}): the GPipe stage scan assumes a "
+            "uniform dense layer block per stage, and the expert all-to-all does "
+            "not commute with the stage rotation. Drop the pipe axis (use expert "
+            "parallelism alone) or use a dense model config with pipe."
+        )
+
+
 def build_mesh(
     axes: Optional[dict[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    model_cfg=None,
 ) -> Mesh:
     """Build a Mesh with named axes. Missing axes default to 1; axis sizes
     must multiply to the device count (a trailing unnamed remainder goes to
-    fsdp)."""
+    fsdp). Passing `model_cfg` validates model×mesh composition constraints
+    (pipe × MoE) here, at mesh-build time."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     axes = dict(axes or {})
     unknown = set(axes) - set(AXIS_ORDER)
     if unknown:
         raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
+    validate_mesh_constraints(axes, model_cfg)
     sized = {k: v for k, v in axes.items() if v and v > 1}
     prod = math.prod(sized.values()) if sized else 1
     if prod > n or n % prod != 0:

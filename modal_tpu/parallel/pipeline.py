@@ -51,11 +51,16 @@ def pipeline_loss(
     sharded over fsdp/model keep their shardings and XLA inserts the
     all-gathers under the stage scan as usual."""
     from ..models.llama import _layer_forward, rms_norm, rope_frequencies
+    from .mesh import MeshConstraintError
 
+    # Defense in depth: direct pipeline_loss callers get the same documented
+    # constraint error the mesh-build path raises (create_sharded_state /
+    # build_mesh(model_cfg=...) reject pipe × MoE before any init/compile).
     if cfg.is_moe:
-        raise NotImplementedError(
-            "pipeline parallelism with MoE layers is not supported yet; "
-            "use expert parallelism (mesh expert axis) without pipe"
+        raise MeshConstraintError(
+            "pipeline parallelism cannot compose with MoE layers: the GPipe "
+            "stage scan assumes a uniform dense layer block per stage. Use "
+            "expert parallelism (mesh expert axis) without pipe."
         )
     n_stages = mesh.shape[axis_name]
     if cfg.n_layers % n_stages:

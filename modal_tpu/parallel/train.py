@@ -87,8 +87,10 @@ def make_train_step(
     donated state. With `pipeline_mesh` the loss is the GPipe-microbatched
     pipeline over its `pipe` axis (parallel/pipeline.py)."""
     if pipeline_mesh is not None:
+        from .mesh import validate_mesh_constraints
         from .pipeline import pipeline_loss
 
+        validate_mesh_constraints(dict(pipeline_mesh.shape), cfg)
         n_stages = pipeline_mesh.shape["pipe"]
         num_micro = tc.resolve_num_microbatches(n_stages)
 
@@ -122,6 +124,11 @@ def create_sharded_state(
 
     Returns (state, train_step, token_sharding).
     """
+    from .mesh import validate_mesh_constraints
+
+    # constraint check BEFORE sharded init: pipe × MoE must fail here, not
+    # minutes later inside the jitted loss (mesh-build-time contract)
+    validate_mesh_constraints(dict(mesh.shape), cfg)
     optimizer = make_optimizer(tc)
     pipe = mesh.shape.get("pipe", 1) > 1
     p_shardings = param_shardings(mesh, cfg, pipe=pipe)
@@ -162,7 +169,7 @@ def train_demo(
     from ..models.llama import get_config
 
     cfg = get_config(cfg_name)
-    mesh = build_mesh(mesh_axes)
+    mesh = build_mesh(mesh_axes, model_cfg=cfg)
     tc = TrainConfig(warmup_steps=10, total_steps=100)
     with mesh:
         state, step_fn, token_sharding = create_sharded_state(mesh, cfg, tc)

@@ -247,6 +247,9 @@ ROUTER_SERVICE_NAME = "modal.tpu.api.TaskCommandRouter"
 
 _ROUTER_OVERRIDES: dict[str, tuple[Optional[str], Optional[str], Arity]] = {
     "TaskExecStdioRead": (None, "TaskExecStdioChunk", Arity.UNARY_STREAM),
+    # warm-pool handoff (server/warm_pool.py): parked interpreters long-poll
+    # the worker's router for their next ContainerArguments
+    "PoolAwaitArguments": ("PoolAwaitRequest", "PoolAwaitResponse", Arity.UNARY_UNARY),
 }
 
 _ROUTER_RPC_NAMES = [
@@ -256,6 +259,8 @@ _ROUTER_RPC_NAMES = [
     "TaskExecPtyResize",
     "TaskExecWait",
     "TaskFsOp",
+    "PoolAwaitArguments",
+    "PoolAdoptAck",
 ]
 
 

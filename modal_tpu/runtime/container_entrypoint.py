@@ -45,6 +45,12 @@ from .io_manager import ContainerIOManager, IOContext
 from .user_code import Service, import_class_service, import_single_function_service
 
 
+# Warm-pool serving (server/warm_pool.py): True while this process runs a
+# placement it received by handoff instead of a fresh exec — echoed on
+# ContainerHello so the control plane can stamp the task's timeline.
+_WARM_POOL_SERVE = False
+
+
 def load_container_arguments() -> api_pb2.ContainerArguments:
     path = os.environ.get("MODAL_TPU_CONTAINER_ARGS_PATH")
     if not path:
@@ -404,7 +410,7 @@ async def main_async() -> int:
 
     await retry_transient_errors(
         client.stub.ContainerHello,
-        api_pb2.ContainerHelloRequest(task_id=task_id),
+        api_pb2.ContainerHelloRequest(task_id=task_id, warm_pool_hit=_WARM_POOL_SERVE),
         max_retries=5,
     )
 
@@ -599,6 +605,286 @@ def check_thread_leaks() -> list:
     return leaked
 
 
+# ---------------------------------------------------------------------------
+# Warm-pool mode (server/warm_pool.py, docs/COLDSTART.md): this process was
+# pre-forked by the worker to park with imports done, then serve placements
+# by handoff over the task router — no re-exec between tasks.
+# ---------------------------------------------------------------------------
+
+# env the scrub removes before parking: cluster/rendezvous and per-task state
+# a previous context could leak into a future placement's jax init
+_CLUSTER_ENV_SCRUB = (
+    "MODAL_TPU_BOUND_PARAMS",
+    "MODAL_TPU_TASK_ID",
+    "MODAL_TPU_TASK_DIR",
+    "MODAL_TPU_CONTAINER_ARGS_PATH",
+    "TPU_VISIBLE_DEVICES",
+    "TPU_PROCESS_BOUNDS",
+    "TPU_PROCESS_ADDRESSES",
+    "TPU_WORKER_ID",
+    "TPU_WORKER_HOSTNAMES",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_ADDRESS",
+)
+
+
+def _pool_preimport() -> None:
+    """Pay the import bill while parked: jax (and anything else configured)
+    is imported BUT no backend is initialized — device pinning / XLA flags
+    still apply at adoption time, before the first jax computation."""
+    import importlib
+
+    setup_compilation_cache()
+    for key in _CLUSTER_ENV_SCRUB:
+        os.environ.pop(key, None)
+    for mod in filter(None, (m.strip() for m in str(config["warm_pool_preimport"]).split(","))):
+        t0 = time.time()
+        try:
+            importlib.import_module(mod)
+            tracing.record_span(
+                "coldstart.preimport", start=t0, end=time.time(), attrs={"module": mod}
+            )
+        except Exception as exc:  # noqa: BLE001 — a missing module must not kill the pool
+            logger.warning(f"warm pool pre-import of {mod!r} failed: {exc}")
+    if os.environ.get("MODAL_TPU_WARM_POOL_PREINIT") == "1":
+        # Opt-in: initialize the jax backend and prime the dispatch/compile
+        # machinery while parked. ONLY safe when every placement's device
+        # topology equals the pool's spawn default — device flags applied at
+        # adoption cannot take effect once the backend exists (the bench CPU
+        # path sets this; the chip-pinning TPU path must NOT).
+        t0 = time.time()
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jax.jit(lambda x: (x * 2 + jax.random.normal(jax.random.PRNGKey(0), x.shape)).sum())(
+                jnp.ones((8, 8))
+            ).block_until_ready()
+            tracing.record_span(
+                "coldstart.preinit",
+                start=t0,
+                end=time.time(),
+                attrs={"n_devices": len(jax.devices())},
+            )
+        except Exception as exc:  # noqa: BLE001
+            logger.warning(f"warm pool backend pre-init failed: {exc}")
+
+
+def _reset_process_state(base_env: dict, base_cwd: str, added_paths: list) -> None:
+    """The restore contract between placements (docs/COLDSTART.md): env and
+    cwd are restored to the park-time snapshot, SDK singletons are cleared,
+    and the synchronizer loop + imported *library* modules (jax!) carry over.
+    USER modules loaded from the placement's own sys.path additions
+    (globals_path / PYTHONPATH delta) are purged along with those paths —
+    app B's `import utils` must never resolve to app A's cached module.
+    User code must not assume process-global state survives a placement."""
+    global PROFILE_DIR
+    from ..client import _Client
+    from .io_manager import ContainerIOManager
+
+    os.environ.clear()
+    os.environ.update(base_env)
+    try:
+        os.chdir(base_cwd)
+    except OSError:
+        pass
+    if added_paths:
+        roots = tuple(os.path.abspath(p) + os.sep for p in added_paths)
+        for name, mod in list(sys.modules.items()):
+            mod_file = getattr(mod, "__file__", None) or ""
+            if mod_file and os.path.abspath(mod_file).startswith(roots):
+                del sys.modules[name]
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+    _Client.set_env_client(None)
+    ContainerIOManager._singleton = None
+    PROFILE_DIR = None
+
+
+async def _pool_runner(state: dict) -> int:
+    """Park → await handoff → serve → re-park, on the synchronizer loop."""
+    import json
+
+    import grpc as _grpc
+
+    from .._utils.grpc_utils import create_channel
+    from ..proto.rpc import TaskRouterStub
+
+    global _WARM_POOL_SERVE
+    pool_id = os.environ["MODAL_TPU_POOL_ID"]
+    token = os.environ.get("MODAL_TPU_POOL_TOKEN", "")
+    router_addr = os.environ["MODAL_TPU_POOL_ROUTER"]
+    channel = create_channel(f"grpc://{router_addr}")
+    stub = TaskRouterStub(channel)
+    base_env = dict(os.environ)
+    base_cwd = os.getcwd()
+    generation = 0
+    rc = 0
+    try:
+        while not state["evict"]:
+            poll = asyncio.ensure_future(
+                stub.PoolAwaitArguments(
+                    api_pb2.PoolAwaitRequest(
+                        pool_id=pool_id,
+                        token=token,
+                        generation=generation,
+                        pid=os.getpid(),
+                        timeout=50.0,
+                    )
+                )
+            )
+            state["poll"] = poll
+            try:
+                resp = await poll
+            except asyncio.CancelledError:
+                break  # SIGTERM while parked
+            except _grpc.aio.AioRpcError as exc:
+                # the worker owns this process's lifecycle: a router that
+                # stopped answering means the worker is gone — exit, don't spin
+                logger.warning(f"warm pool poll failed ({exc.code()}); exiting")
+                break
+            finally:
+                state["poll"] = None
+            if resp.evict:
+                logger.debug("warm pool interpreter evicted")
+                break
+            if not resp.has_task:
+                continue  # poll window lapsed; park again
+            # --- adopt: apply the env delta in-process, ack, serve ---------
+            for key in resp.env_unset:
+                os.environ.pop(key, None)
+            env_set = json.loads(resp.env_set_json or "{}")
+            cwd = env_set.pop("MODAL_TPU_POOL_CWD", "")
+            os.environ.update(env_set)
+            os.environ["MODAL_TPU_CONTAINER_ARGS_PATH"] = resp.args_path
+            # PYTHONPATH changes don't retro-apply to sys.path: prepend the
+            # task's entries (globals_path etc.) so user imports resolve —
+            # tracked so the re-park reset can remove them AND purge the
+            # user modules they loaded (cross-app contamination guard)
+            added_paths = []
+            for entry in reversed(os.environ.get("PYTHONPATH", "").split(os.pathsep)):
+                if entry and entry not in sys.path:
+                    sys.path.insert(0, entry)
+                    added_paths.append(entry)
+            if cwd:
+                try:
+                    os.chdir(cwd)
+                except OSError as exc:
+                    logger.warning(f"warm pool chdir({cwd!r}) failed: {exc}")
+                else:
+                    # fresh spawns run `python -m ...` with cwd=container_cwd,
+                    # which puts that dir on sys.path[0] — mirror it so
+                    # workdir-resolved user imports behave identically on the
+                    # pooled path (tracked: removed + purged at re-park)
+                    if cwd not in sys.path:
+                        sys.path.insert(0, cwd)
+                        added_paths.append(cwd)
+            try:
+                await stub.PoolAdoptAck(
+                    api_pb2.PoolAdoptAckRequest(
+                        pool_id=pool_id, token=token, handoff_id=resp.handoff_id, task_id=resp.task_id
+                    )
+                )
+            except _grpc.aio.AioRpcError as exc:
+                # worker withdrew the handoff (or died): never run a task the
+                # worker doesn't believe we own
+                logger.warning(f"warm pool adopt-ack rejected ({exc.code()}); exiting")
+                rc = 1
+                break
+            _WARM_POOL_SERVE = True
+            task = asyncio.ensure_future(main_async())
+            state["task"] = task
+            try:
+                rc = await task
+            except asyncio.CancelledError:
+                rc = 0  # graceful termination already reported via TaskResult
+            except BaseException:  # noqa: BLE001 — a crashed serve poisons the pool
+                traceback.print_exc()
+                rc = 1
+            finally:
+                state["task"] = None
+            generation += 1
+            _reset_process_state(base_env, base_cwd, added_paths)
+            if rc != 0:
+                # don't re-park an interpreter whose serve crashed: process
+                # state is suspect — exit and let the pool respawn fresh
+                break
+    finally:
+        try:
+            await channel.close()
+        except Exception:  # noqa: BLE001
+            pass
+    return rc
+
+
+def _install_preempt_handler(loop, handle_term) -> None:
+    """SIGUSR2 = preemption notice (worker _signal_preempt), shared by main()
+    and pool_main() so the flush contract can never drift between fresh and
+    pooled interpreters: flush every in-flight input's resume token to the
+    control plane (bounded — the grace window is ticking), THEN route into
+    the normal graceful-termination path (@exit hooks, TaskResult)."""
+    import signal
+
+    async def _preempt_flush() -> None:
+        from .io_manager import ContainerIOManager
+
+        io = ContainerIOManager.singleton()
+        if io is not None:
+            try:
+                await asyncio.wait_for(io.flush_resume_tokens(), timeout=8.0)
+            except Exception:
+                traceback.print_exc()
+        handle_term(signal.SIGUSR2, None)
+
+    def _handle_preempt(signum, frame):
+        logger.warning("preemption notice received; flushing checkpoints")
+        loop.call_soon_threadsafe(lambda: asyncio.ensure_future(_preempt_flush()))
+
+    signal.signal(signal.SIGUSR2, _handle_preempt)
+
+
+def pool_main() -> None:
+    """Entry for MODAL_TPU_POOL_ID processes: identical signal semantics to
+    main(), but the body loops placements instead of exiting after one."""
+    import signal
+
+    from .._utils.async_utils import synchronizer
+    from .main_thread_exec import MainThreadExecutor, set_executor
+
+    _pool_preimport()
+    loop = synchronizer._ensure_loop()
+    state: dict = {"task": None, "poll": None, "evict": False}
+
+    def _handle_term(signum, frame):
+        state["evict"] = True
+        task = state.get("task")
+        poll = state.get("poll")
+        if task is not None:
+            loop.call_soon_threadsafe(task.cancel)
+        elif poll is not None:
+            loop.call_soon_threadsafe(poll.cancel)
+
+    signal.signal(signal.SIGTERM, _handle_term)
+    _install_preempt_handler(loop, _handle_term)
+
+    executor = MainThreadExecutor()
+    executor.install_signal_handler()
+    set_executor(executor)
+    cf = asyncio.run_coroutine_threadsafe(_pool_runner(state), loop)
+    try:
+        executor.run_until(cf)
+    except KeyboardInterrupt:
+        cf.cancel()
+        raise
+    finally:
+        set_executor(None)
+        check_thread_leaks()
+    sys.exit(cf.result())
+
+
 def main() -> None:
     # Run the entrypoint's async main on the synchronizer loop: all SDK
     # coroutines (which the dual-surface wrappers pin to that loop) then run
@@ -623,28 +909,7 @@ def main() -> None:
             loop.call_soon_threadsafe(task.cancel)
 
     signal.signal(signal.SIGTERM, _handle_term)
-
-    # SIGUSR2 = preemption notice (worker _signal_preempt): unlike SIGTERM's
-    # immediate cancel, first flush every in-flight input's resume token to
-    # the control plane (ContainerCheckpoint) so the requeued attempts resume
-    # from their checkpoints — THEN cancel into the normal graceful-exit path
-    # (@exit hooks, TaskResult) inside the grace window.
-    async def _preempt_flush() -> None:
-        from .io_manager import ContainerIOManager
-
-        io = ContainerIOManager.singleton()
-        if io is not None:
-            try:
-                await asyncio.wait_for(io.flush_resume_tokens(), timeout=8.0)
-            except Exception:
-                traceback.print_exc()
-        _handle_term(signal.SIGUSR2, None)
-
-    def _handle_preempt(signum, frame):
-        logger.warning("preemption notice received; flushing checkpoints")
-        loop.call_soon_threadsafe(lambda: asyncio.ensure_future(_preempt_flush()))
-
-    signal.signal(signal.SIGUSR2, _handle_preempt)
+    _install_preempt_handler(loop, _handle_term)
 
     # Cancellable sync inputs: the asyncio machinery lives on the
     # synchronizer's daemon thread, leaving THIS (main) thread free to host
@@ -677,4 +942,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("MODAL_TPU_POOL_ID"):
+        pool_main()
+    else:
+        main()

@@ -413,7 +413,20 @@ class ImageBuilder:
 
     async def _run_build_function(self, image, built, run_shell, shell_env, build_dir) -> None:
         """Execute a run_function() build step with the image's python
-        (reference _image.py:2175 — bake weights/caches at build time)."""
+        (reference _image.py:2175 — bake weights/caches at build time).
+
+        #PREWARM layers (Image.prewarm, docs/COLDSTART.md) additionally point
+        the persistent XLA compilation cache inside the image rootfs before
+        the function runs: the jit entry points it traces are compiled at
+        BUILD time, and the cache dir is recorded as image env so every
+        container launched from this image starts with a warm cache."""
+        if any(c.strip() == "#PREWARM" for c in image.dockerfile_commands):
+            cache_dir = os.path.join(built.rootfs, "cache", "jax")
+            os.makedirs(cache_dir, exist_ok=True)
+            built.env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+            # cache even millisecond compiles: the whole point is that NO
+            # first-input compile happens in the container
+            built.env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
         payload = os.path.join(build_dir, "build_fn.pkl")
         with open(payload, "wb") as f:
             f.write(image.build_function_serialized)

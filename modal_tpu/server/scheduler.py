@@ -105,6 +105,11 @@ class Scheduler:
                 if inp is not None and inp.status == "pending":
                     depth += 1
         SCHED_QUEUE_DEPTH.set(depth)
+        # Warm-pool sizing (server/warm_pool.py): min_containers /
+        # buffer_containers keep BOOTED interpreters parked on workers for
+        # the function's image, not just scheduled slots — scale-ups and
+        # post-idle restarts then skip process boot + imports entirely.
+        desired_pools: dict[str, int] = {}
         for fn in list(self.s.functions.values()):
             app = self.s.apps.get(fn.app_id)
             if app is not None and app.done:
@@ -142,6 +147,13 @@ class Scheduler:
                 continue
             fn.placement_unsat_since = 0.0  # satisfiable again
             settings = fn.autoscaler
+            if (fn.definition.group_size or 0) <= 1:
+                # gangs are excluded: they jax.distributed-initialize in
+                # process, which a parked interpreter must never inherit
+                pool_target = min(4, max(settings.min_containers, settings.buffer_containers))
+                if pool_target > 0:
+                    image_key = fn.definition.image_id or ""
+                    desired_pools[image_key] = max(desired_pools.get(image_key, 0), pool_target)
             live = [
                 tid
                 for tid in fn.task_ids
@@ -203,6 +215,48 @@ class Scheduler:
             for _ in range(max(0, need)):
                 if not await self._launch_task(fn):
                     break  # no capacity right now
+        await self._sync_pool_directives(desired_pools)
+
+    async def _sync_pool_directives(self, desired: dict[str, int]) -> None:
+        """Push warm-pool sizing diffs to workers (PoolDirective on the poll
+        stream). The target is CLUSTER-wide (min/buffer_containers semantics)
+        and is split evenly across eligible workers — broadcasting the full
+        target to every host would multiply the parked-interpreter count by
+        fleet size. Removals ride as target=0 — the worker evicts that
+        image's parked interpreters (eviction on image change / app stop)."""
+        eligible = sorted(
+            (w for w in self.s.workers.values() if not w.draining and not w.adoption_pending),
+            key=lambda w: w.worker_id,
+        )
+        n = len(eligible)
+        for i, worker in enumerate(eligible):
+            sent = worker.pool_directives
+            for image_id, target in desired.items():
+                # even split with the remainder on the first workers:
+                # cluster target 4 over 8 hosts parks 4 interpreters, not 32
+                share = (target + n - 1 - i) // n
+                prev = sent.get(image_id)
+                if share > 0 and prev != share:
+                    await worker.events.put(
+                        api_pb2.WorkerPollResponse(
+                            pool_directive=api_pb2.PoolDirective(image_id=image_id, target=share)
+                        )
+                    )
+                    sent[image_id] = share
+                elif share == 0 and prev is not None:
+                    await worker.events.put(
+                        api_pb2.WorkerPollResponse(
+                            pool_directive=api_pb2.PoolDirective(image_id=image_id, target=0)
+                        )
+                    )
+                    del sent[image_id]
+            for image_id in [k for k in sent if k not in desired]:
+                await worker.events.put(
+                    api_pb2.WorkerPollResponse(
+                        pool_directive=api_pb2.PoolDirective(image_id=image_id, target=0)
+                    )
+                )
+                del sent[image_id]
 
     async def _evaluate_schedule(self, fn: FunctionState) -> None:
         """Fire Cron/Period schedules: enqueue one zero-arg input per due
@@ -358,7 +412,13 @@ class Scheduler:
             free = len(worker.free_chips()) - (reserved or {}).get(worker.worker_id, 0)
             if chips_needed > 0 and free < chips_needed:
                 continue
-            score = len(worker.active_tasks) + (rank_load or {}).get(worker.worker_id, 0)
+            # least-loaded first; warm-pool inventory breaks ties — a host
+            # with a parked interpreter serves the placement without a fresh
+            # process boot (server/warm_pool.py)
+            score = (
+                len(worker.active_tasks) + (rank_load or {}).get(worker.worker_id, 0),
+                0 if worker.warm_pool_ready > 0 else 1,
+            )
             if best is None or score < best_score:
                 best, best_score = worker, score
         return best

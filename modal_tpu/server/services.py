@@ -1058,6 +1058,10 @@ class ModalTPUServicer:
         task.state = api_pb2.TASK_STATE_ACTIVE
         task.started_at = task.started_at or time.time()
         task.last_heartbeat = time.time()
+        if request.warm_pool_hit:
+            # placement served by a pre-forked warm-pool interpreter
+            # (handoff, no re-exec) — surfaced on TaskGetTimeline
+            task.warm_pool_hit = True
         fn = self.s.functions.get(task.function_id)
         if fn is not None:
             fn.init_failures = 0  # a container came up: init is healthy
@@ -1772,6 +1776,7 @@ class ModalTPUServicer:
                     first_input_at=task.first_input_at,
                     first_output_at=task.first_output_at,
                     finished_at=task.finished_at,
+                    warm_pool_hit=task.warm_pool_hit,
                 )
             )
         return resp
@@ -2481,6 +2486,7 @@ class ModalTPUServicer:
             self.s.schedule_event.set()
         WORKER_HEARTBEATS.inc()
         worker.last_heartbeat = time.time()
+        worker.warm_pool_ready = request.warm_pool_ready
         if request.draining and not worker.draining and self.scheduler is not None:
             # worker announces an impending preemption (SIGTERM from the
             # cloud): enter drain state. The worker SIGTERMs its own

@@ -162,6 +162,9 @@ class TaskState_:
     # trace context of the input whose backlog caused this launch: the
     # container's boot/import spans parent here (cold-start attribution)
     trace_context: str = ""
+    # served by a pre-forked warm-pool interpreter (ContainerHello stamp;
+    # surfaced on TaskGetTimeline so bench.py can prove the warm path)
+    warm_pool_hit: bool = False
 
 
 @dataclass
@@ -209,6 +212,12 @@ class WorkerState:
     # re-adopted within the grace window ⇒ deregistered by the reaper
     adoption_pending: bool = False
     recovered_at: float = 0.0
+    # parked warm-pool interpreters this host reported on its last heartbeat
+    # (scheduler prefers warm hosts on placement ties)
+    warm_pool_ready: int = 0
+    # image_id -> target last directed to this worker (scheduler
+    # _sync_pool_directives; diffed so directives are sent on change only)
+    pool_directives: dict[str, int] = field(default_factory=dict)
 
     def free_chips(self) -> list[int]:
         return [c for c in range(self.num_chips) if c not in self.chips_in_use]

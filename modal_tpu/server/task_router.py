@@ -88,6 +88,9 @@ class TaskRouterServicer:
         self._execs: dict[str, ExecState] = {}
         self._finished_order: list[str] = []
         self._start_locks: dict[str, asyncio.Lock] = {}
+        # warm pool (server/warm_pool.py): set by the owning WorkerAgent so
+        # parked interpreters can long-poll this plane for their handoffs
+        self.pool = None
 
     # -- worker wiring ------------------------------------------------------
 
@@ -405,6 +408,48 @@ class TaskRouterServicer:
                 except asyncio.TimeoutError:
                     pass
             return api_pb2.TaskExecWaitResponse(completed=True, returncode=st.returncode)
+
+    # -- warm-pool handoff (server/warm_pool.py, docs/COLDSTART.md) ---------
+
+    async def PoolAwaitArguments(
+        self, request: api_pb2.PoolAwaitRequest, context
+    ) -> api_pb2.PoolAwaitResponse:
+        """Parked interpreter long-poll: block until the worker hands this
+        pool entry a placement (ContainerArguments path + env delta), asks it
+        to exit (evict), or the poll window lapses (park again)."""
+        from .warm_pool import _EVICT
+
+        if self.pool is None:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no warm pool on this worker")
+        entry = self.pool.entry_for(request.pool_id, request.token)
+        if entry is None:
+            # unknown/stale entry (worker restarted, entry evicted while the
+            # RPC was in flight): tell the interpreter to exit
+            return api_pb2.PoolAwaitResponse(evict=True)
+        from .warm_pool import AWAIT_POLL_CAP_S
+
+        self.pool.note_parked(entry, request.generation)
+        timeout = min(request.timeout or (AWAIT_POLL_CAP_S - 5.0), AWAIT_POLL_CAP_S)
+        try:
+            payload = await asyncio.wait_for(entry.handoff_q.get(), timeout=timeout)
+        except asyncio.TimeoutError:
+            return api_pb2.PoolAwaitResponse()  # park again
+        if payload is _EVICT:
+            return api_pb2.PoolAwaitResponse(evict=True)
+        return payload
+
+    async def PoolAdoptAck(
+        self, request: api_pb2.PoolAdoptAckRequest, context
+    ) -> api_pb2.PoolAdoptAckResponse:
+        """Delivery commit: the interpreter holds the payload and is about to
+        run it. Only now does the worker's adoption succeed — a kill between
+        handoff and ack leaves the ack unset and the placement falls back."""
+        if self.pool is None:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no warm pool on this worker")
+        entry = self.pool.entry_for(request.pool_id, request.token)
+        if entry is None or not self.pool.ack(entry, request.handoff_id):
+            await context.abort(grpc.StatusCode.NOT_FOUND, "unknown pool entry or stale handoff")
+        return api_pb2.PoolAdoptAckResponse()
 
     # -- filesystem ---------------------------------------------------------
 

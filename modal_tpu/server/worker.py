@@ -110,6 +110,11 @@ class WorkerAgent:
         )
         self.state_dir = state_dir or config["state_dir"]
         self._procs: dict[str, asyncio.subprocess.Process] = {}
+        # task_id -> warm-pool entry serving it: stop events for these tasks
+        # drain in-band (kill switch) instead of SIGTERM — the signal would
+        # evict a reusable interpreter, and the stop escalation would SIGKILL
+        # it AFTER it re-parked (pool procs outlive their tasks by design)
+        self._pool_tasks: dict[str, object] = {}
         # task_id -> (cwd, env) of a running sandbox: sidecars launch into the
         # same filesystem/env (the local analogue of sharing the pod)
         self._sandbox_runtime: dict[str, tuple[str, dict]] = {}
@@ -122,6 +127,7 @@ class WorkerAgent:
         self._early_stops_max = 1024
         self._channel = None
         self._stub: Optional[ModalTPUStub] = None
+        self.pool = None  # WarmPool, created in start() once the router is up
         self._tasks: list[asyncio.Task] = []
         self._escalations: set[asyncio.Task] = set()
         self._stopped = False
@@ -155,6 +161,13 @@ class WorkerAgent:
         router_port = self._router_server.add_insecure_port("127.0.0.1:0")
         await self._router_server.start()
         self.router_address = f"127.0.0.1:{router_port}"
+        # warm pool: pre-forked parked interpreters served handoffs over the
+        # router plane above (server/warm_pool.py, docs/COLDSTART.md)
+        from .warm_pool import WarmPool
+
+        self.pool = WarmPool(self)
+        self.router.pool = self.pool
+        await self.pool.start()
         await self._register()
         self._tasks.append(asyncio.create_task(self._poll_loop(), name=f"worker-poll-{self.worker_id}"))
         self._tasks.append(asyncio.create_task(self._heartbeat_loop(), name=f"worker-hb-{self.worker_id}"))
@@ -194,6 +207,8 @@ class WorkerAgent:
         for task in self._tasks:
             task.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        if getattr(self, "pool", None) is not None:
+            await self.pool.stop()
         for task_id, proc in list(self._procs.items()):
             await self._kill_proc(proc)
         if getattr(self, "router", None) is not None:
@@ -225,6 +240,7 @@ class WorkerAgent:
                         active_task_ids=list(self._procs.keys()),
                         draining=self.draining,
                         drain_grace_s=self._drain_grace_s if self.draining else 0.0,
+                        warm_pool_ready=self.pool.ready_count() if self.pool is not None else 0,
                     ),
                     max_retries=2,
                 )
@@ -259,6 +275,10 @@ class WorkerAgent:
         self.draining = True
         self._drain_grace_s = grace_s
         logger.warning(f"worker {self.worker_id} preempted (grace {grace_s}s); draining")
+        if self.pool is not None:
+            # parked interpreters hold no work: evict them immediately so the
+            # host can terminate inside its grace window
+            self.pool.drain()
         try:
             await retry_transient_errors(
                 self._stub.WorkerHeartbeat,
@@ -308,6 +328,8 @@ class WorkerAgent:
                     proc.kill()
                 except ProcessLookupError:
                     pass
+        if self.pool is not None:
+            self.pool.kill_parked()
 
     async def _poll_loop(self) -> None:
         while not self._stopped:
@@ -325,6 +347,12 @@ class WorkerAgent:
                         await self._stop_task(event.stop)
                     elif which == "sidecar":
                         asyncio.create_task(self._run_sidecar(event.sidecar))
+                    elif event.HasField("pool_directive") and self.pool is not None:
+                        # scheduler-driven warm-pool sizing (outside the
+                        # event oneof — see api.proto PoolDirective)
+                        self.pool.set_directive(
+                            event.pool_directive.image_id, event.pool_directive.target
+                        )
             except asyncio.CancelledError:
                 return
             except Exception as exc:
@@ -373,6 +401,30 @@ class WorkerAgent:
                 self._early_stops.pop(next(iter(self._early_stops)))
             return
         logger.debug(f"stopping task {stop.task_id}")
+        pool_entry = self._pool_tasks.get(stop.task_id)
+        if pool_entry is not None and not stop.force and not stop.preempt:
+            # pooled placement: the control plane's task.terminate already
+            # surfaces as a kill switch on the next FunctionGetInputs (the
+            # input condition is notified), so the input loop drains and the
+            # interpreter RE-PARKS. Escalate to SIGKILL only if the placement
+            # doesn't end inside the grace window.
+            grace = float(os.environ.get("MODAL_TPU_STOP_GRACE", "10"))
+
+            async def _escalate_pool(e=pool_entry, p=proc, task_id=stop.task_id) -> None:
+                try:
+                    if e.task_done is not None:
+                        await asyncio.wait_for(asyncio.shield(e.task_done), timeout=grace)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    logger.warning(f"pooled task {task_id} ignored kill switch for {grace}s; killing")
+                    try:
+                        p.kill()
+                    except ProcessLookupError:
+                        pass
+
+            esc = asyncio.create_task(_escalate_pool())
+            self._escalations.add(esc)
+            esc.add_done_callback(self._escalations.discard)
+            return
         if stop.preempt and not stop.force:
             # scheduler-initiated preemption (e.g. a gang peer's host is
             # draining): give the container its checkpoint-flush window
@@ -988,24 +1040,79 @@ class WorkerAgent:
         stderr_path = os.path.join(task_dir, "stderr.log")
         container_python = built_image.python_bin if built_image is not None else sys.executable
         container_cwd = (built_image.workdir if built_image is not None else "") or globals_path or None
-        with open(stdout_path, "wb") as out_f, open(stderr_path, "wb") as err_f:
-            proc = await asyncio.create_subprocess_exec(
-                container_python,
-                "-u",
-                "-m",
-                "modal_tpu.runtime.container_entrypoint",
-                env=env,
-                stdout=out_f,
-                stderr=err_f,
-                cwd=container_cwd,
+
+        # Warm-pool handoff first (server/warm_pool.py): a parked interpreter
+        # matching this task's image/platform takes the placement in-process —
+        # no exec, no imports. Chip pinning / device-count flags apply at
+        # adoption (jax is imported but no backend is initialized while
+        # parked). Gangs are excluded: jax.distributed state must never leak
+        # across placements. Any failure falls back to the fresh spawn below.
+        pool_entry = None
+        err_offset = 0
+        if (
+            self.pool is not None
+            and not self.draining
+            and args.world_size <= 1
+            and (args.function_def.group_size or 0) <= 1
+        ):
+            # trivial image chains materialize to the host venv: their
+            # placements match the host-venv ("") pool key
+            effective_image = args.function_def.image_id if built_image is not None else ""
+            pool_entry = await self.pool.adopt(
+                effective_image, env, task_id, args_path, cwd=container_cwd or ""
             )
+        if pool_entry is not None:
+            proc = pool_entry.proc
+            stdout_path, stderr_path = pool_entry.stdout_path, pool_entry.stderr_path
+            try:
+                out_offset = os.path.getsize(stdout_path)
+                err_offset = os.path.getsize(stderr_path)
+            except OSError:
+                out_offset = err_offset = 0
+            tracing.record_span(
+                "coldstart.handoff",
+                start=t_launch0,
+                end=time.time(),
+                parent=tracing.parse_context(task_trace_ctx),
+                attrs={
+                    "task_id": task_id,
+                    "worker_id": self.worker_id,
+                    "pool_id": pool_entry.pool_id,
+                    "pid": proc.pid,
+                    "generation": pool_entry.generation,
+                    "image_id": args.function_def.image_id,
+                },
+            )
+            logger.debug(
+                f"task {task_id} handed to warm interpreter {pool_entry.pool_id} (pid={proc.pid})"
+            )
+        else:
+            out_offset = 0
+            with open(stdout_path, "wb") as out_f, open(stderr_path, "wb") as err_f:
+                proc = await asyncio.create_subprocess_exec(
+                    container_python,
+                    "-u",
+                    "-m",
+                    "modal_tpu.runtime.container_entrypoint",
+                    env=env,
+                    stdout=out_f,
+                    stderr=err_f,
+                    cwd=container_cwd,
+                )
         self._procs[task_id] = proc
+        if pool_entry is not None:
+            self._pool_tasks[task_id] = pool_entry
         tracing.record_span(
             "worker.launch_task",
             start=t_launch0,
             end=time.time(),
             parent=tracing.parse_context(task_trace_ctx),
-            attrs={"task_id": task_id, "worker_id": self.worker_id, "pid": proc.pid},
+            attrs={
+                "task_id": task_id,
+                "worker_id": self.worker_id,
+                "pid": proc.pid,
+                "warm_pool_hit": pool_entry is not None,
+            },
         )
         logger.debug(f"task {task_id} started pid={proc.pid}")
         if self._consume_early_stop(task_id):  # stop raced in during spawn
@@ -1017,9 +1124,29 @@ class WorkerAgent:
             # deadline force-reaps it
             self._signal_preempt(task_id, proc, self._drain_grace_s)
         self.router.register_task(task_id, env, container_cwd or os.getcwd(), token=assignment.router_token)
-        tail_task = asyncio.create_task(self._stream_logs(task_id, stdout_path, stderr_path, proc))
-        returncode = await proc.wait()
+        tail_task = asyncio.create_task(
+            self._stream_logs(
+                task_id, stdout_path, stderr_path, proc,
+                stdout_offset=out_offset, stderr_offset=err_offset,
+            )
+        )
+        if pool_entry is not None:
+            # resolved by the router when the interpreter re-parks (next
+            # generation's PoolAwaitArguments) or by the pool watcher when
+            # the process dies mid-serve
+            try:
+                outcome, returncode = await pool_entry.task_done
+            except asyncio.CancelledError:
+                outcome, returncode = "exited", -1
+            if outcome == "reparked":
+                returncode = 0
+                # the process lives on: give the tailer one beat to flush the
+                # final log bytes before detaching from the shared files
+                await asyncio.sleep(0.25)
+        else:
+            returncode = await proc.wait()
         del self._procs[task_id]
+        self._pool_tasks.pop(task_id, None)
         self.router.unregister_task(task_id)
         tail_task.cancel()
         try:
@@ -1031,7 +1158,7 @@ class WorkerAgent:
             # report failure for containers that died before TaskResult
             try:
                 with open(stderr_path, "rb") as f:
-                    f.seek(max(0, os.path.getsize(stderr_path) - 4096))
+                    f.seek(max(err_offset, os.path.getsize(stderr_path) - 4096))
                     tail = f.read().decode(errors="replace")
                 await retry_transient_errors(
                     self._stub.TaskResult,
@@ -1061,13 +1188,21 @@ class WorkerAgent:
                 pass
 
     async def _stream_logs(
-        self, task_id: str, stdout_path: str, stderr_path: str, proc: asyncio.subprocess.Process
+        self,
+        task_id: str,
+        stdout_path: str,
+        stderr_path: str,
+        proc: asyncio.subprocess.Process,
+        stdout_offset: int = 0,
+        stderr_offset: int = 0,
     ) -> None:
         """Tail container stdout/stderr into the control plane's app logs
-        (client reads them via AppGetLogs)."""
+        (client reads them via AppGetLogs). Non-zero offsets: warm-pool
+        handoffs share the interpreter's log files across placements — tail
+        only the bytes this task produced."""
         import codecs
 
-        offsets = {stdout_path: 0, stderr_path: 0}
+        offsets = {stdout_path: stdout_offset, stderr_path: stderr_offset}
         fds = {stdout_path: 1, stderr_path: 2}
         decoders = {
             path: codecs.getincrementaldecoder("utf-8")(errors="replace") for path in offsets

@@ -110,6 +110,7 @@ def test_clustered_jax_distributed_psum(supervisor):
         assert out["sum"] == 3.0 * out["global_devices"]
 
 
+@pytest.mark.slow  # re-tier: multi-proc gang recovery ~15s; the psum gang test covers the area in the default tier
 def test_gang_elastic_recovery(supervisor, tmp_path):
     """Elastic slice recovery (SURVEY §5, net-new): rank 1 dies mid-training
     → the whole gang tears down (peers surfaced PREEMPTED) → the input

@@ -77,6 +77,7 @@ def test_train_demo_mesh():
     assert m["loss"] > 0 and m["step"] == 2
 
 
+@pytest.mark.slow  # re-tier: convergence run ~7s; test_train_demo_mesh covers the area in the default tier
 def test_train_loss_decreases():
     from modal_tpu.parallel.train import train_demo
 

@@ -199,6 +199,7 @@ def _first_step_loss(cfg_name: str, axes: dict, tokens_key: int = 1, batch: int 
         return float(metrics["loss"])
 
 
+@pytest.mark.slow  # re-tier: heavy parity step ~5s; pipeline_demo/moe cover the area in the default tier
 def test_train_step_fsdp_pp_parity():
     """FSDP+PP through create_sharded_state: identical first-step loss to
     the dense FSDP step (pipelining is scheduling, not approximation)."""
@@ -207,6 +208,7 @@ def test_train_step_fsdp_pp_parity():
     assert abs(dense - pp) < 1e-3, (dense, pp)
 
 
+@pytest.mark.slow  # re-tier: heavy parity step ~7s; moe forward/loss covers the area in the default tier
 def test_train_step_fsdp_ep_parity():
     """FSDP+EP (llama MoE config) vs the same MoE model without expert
     sharding: same math, different placement."""

@@ -28,7 +28,53 @@ from google.protobuf import descriptor_pb2  # noqa: E402
 
 F = descriptor_pb2.FieldDescriptorProto
 
-# (message, field_name, field_number, field_type)
+# New messages: name -> [(field_name, number, type, label, type_name)].
+# type_name is only used for TYPE_MESSAGE fields (fully-qualified, leading
+# dot). Idempotent like PATCHES: an existing message of the same name is
+# verified field-by-field instead of re-added.
+NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
+    # Warm-pool cold starts (server/warm_pool.py, docs/COLDSTART.md):
+    # scheduler→worker directive to keep N pre-forked interpreters parked
+    # for an image (rides WorkerPollResponse outside the event oneof).
+    "PoolDirective": [
+        ("image_id", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("target", 2, F.TYPE_INT32, F.LABEL_OPTIONAL, ""),
+    ],
+    # Parked interpreter → worker router long-poll: "give me my next
+    # ContainerArguments". Token is per pool entry (issued at spawn).
+    "PoolAwaitRequest": [
+        ("pool_id", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("token", 2, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("generation", 3, F.TYPE_INT32, F.LABEL_OPTIONAL, ""),
+        ("pid", 4, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
+        ("timeout", 5, F.TYPE_FLOAT, F.LABEL_OPTIONAL, ""),
+    ],
+    # The handoff payload: args path + env delta to apply in-process (the
+    # restore-without-re-exec contract; env_set_json replaces/extends,
+    # env_unset removes pool-spawn-only keys).
+    "PoolAwaitResponse": [
+        ("has_task", 1, F.TYPE_BOOL, F.LABEL_OPTIONAL, ""),
+        ("task_id", 2, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("args_path", 3, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("env_set_json", 4, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("env_unset", 5, F.TYPE_STRING, F.LABEL_REPEATED, ""),
+        ("evict", 6, F.TYPE_BOOL, F.LABEL_OPTIONAL, ""),
+        ("handoff_id", 7, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+    ],
+    # Interpreter-side delivery ack: the worker only commits the adoption
+    # (and skips the fresh-spawn fallback) once this lands — a parked
+    # process killed mid-handoff never acks, so the task falls back.
+    "PoolAdoptAckRequest": [
+        ("pool_id", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("token", 2, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("handoff_id", 3, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("task_id", 4, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+    ],
+    "PoolAdoptAckResponse": [],
+}
+
+# (message, field_name, field_number, field_type) — optionally a 5-tuple with
+# a fully-qualified type_name for TYPE_MESSAGE fields.
 PATCHES: list[tuple[str, str, int, int]] = [
     ("FunctionGetInputsItem", "resume_token", 7, F.TYPE_STRING),
     ("ContainerCheckpointRequest", "input_id", 3, F.TYPE_STRING),
@@ -52,6 +98,18 @@ PATCHES: list[tuple[str, str, int, int]] = [
     # answers with reannounce=true — the worker re-registers under its old id
     # instead of hammering an id that will never exist again
     ("WorkerHeartbeatResponse", "reannounce", 1, F.TYPE_BOOL),
+    # Warm-pool cold starts (ISSUE 5): the entrypoint marks a placement that
+    # was served by a pre-forked parked interpreter (handoff, no re-exec)
+    ("ContainerHelloRequest", "warm_pool_hit", 3, F.TYPE_BOOL),
+    # surfaced on the timeline so bench.py can PROVE the measured cold start
+    # went through the warm pool (acceptance: warm_pool_hit field)
+    ("TaskTimeline", "warm_pool_hit", 7, F.TYPE_BOOL),
+    # workers report parked-interpreter inventory; the scheduler prefers
+    # warm workers on placement ties
+    ("WorkerHeartbeatRequest", "warm_pool_ready", 5, F.TYPE_INT32),
+    # scheduler→worker pool-sizing directive (outside the event oneof; the
+    # worker checks HasField)
+    ("WorkerPollResponse", "pool_directive", 4, F.TYPE_MESSAGE, ".modal.tpu.api.PoolDirective"),
 ]
 
 HEADER = '''\
@@ -90,7 +148,38 @@ def main() -> None:
     fdp = descriptor_pb2.FileDescriptorProto.FromString(api_pb2.DESCRIPTOR.serialized_pb)
     by_name = {m.name: m for m in fdp.message_type}
     changed = 0
-    for msg_name, field_name, number, ftype in PATCHES:
+    for msg_name, fields in NEW_MESSAGES.items():
+        msg = by_name.get(msg_name)
+        if msg is None:
+            msg = fdp.message_type.add(name=msg_name)
+            by_name[msg_name] = msg
+            changed += 1
+        existing = {f.name: f for f in msg.field}
+        for field_name, number, ftype, label, type_name in fields:
+            if field_name in existing:
+                f = existing[field_name]
+                if f.number != number or f.type != ftype:
+                    raise SystemExit(
+                        f"{msg_name}.{field_name} exists with number={f.number} type={f.type}; "
+                        f"patch wants number={number} type={ftype}"
+                    )
+                continue
+            if any(f.number == number for f in msg.field):
+                raise SystemExit(f"{msg_name} field number {number} already taken")
+            kwargs = dict(
+                name=field_name,
+                number=number,
+                type=ftype,
+                label=label,
+                json_name=_json_name(field_name),
+            )
+            if type_name:
+                kwargs["type_name"] = type_name
+            msg.field.add(**kwargs)
+            changed += 1
+    for patch in PATCHES:
+        msg_name, field_name, number, ftype = patch[:4]
+        type_name = patch[4] if len(patch) > 4 else ""
         msg = by_name.get(msg_name)
         if msg is None:
             raise SystemExit(f"message {msg_name} not found in descriptor")
@@ -105,13 +194,16 @@ def main() -> None:
             continue
         if any(f.number == number for f in msg.field):
             raise SystemExit(f"{msg_name} field number {number} already taken")
-        msg.field.add(
+        kwargs = dict(
             name=field_name,
             number=number,
             type=ftype,
             label=F.LABEL_OPTIONAL,
             json_name=_json_name(field_name),
         )
+        if type_name:
+            kwargs["type_name"] = type_name
+        msg.field.add(**kwargs)
         changed += 1
     if not changed:
         print("descriptor already up to date")
