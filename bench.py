@@ -893,6 +893,13 @@ def _run_recovery_bench(timeout_s: float) -> dict | None:
     return _run_microbench("recovery", "bench_recovery.py", "RECOVERY_BENCH_RESULT", timeout_s)
 
 
+def _run_dispatch_bench(timeout_s: float) -> dict | None:
+    """tools/bench_dispatch.py: no-op dispatch p50 + per-segment critical-path
+    attribution + profiler-overhead A/B (ISSUE 7; the ROADMAP item 3 baseline
+    the follow-up latency PR must beat)."""
+    return _run_microbench("dispatch", "bench_dispatch.py", "DISPATCH_BENCH_RESULT", timeout_s)
+
+
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--mode":
         child_main(sys.argv[2])
@@ -970,6 +977,15 @@ def _orchestrate() -> None:
         if cold is not None and _BANK["best"] is not None:
             for k, v in cold.items():
                 _BANK["best"][f"coldstart_{k}"] = v
+    # Phase 2.8: dispatch-latency microbench (tools/bench_dispatch.py): no-op
+    # call p50, per-segment critical-path attribution (gap explicit), and the
+    # sampling-profiler overhead A/B — dispatch_* fields are the ISSUE 7
+    # baseline the hot-path latency PR (ROADMAP item 3) must beat.
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_DISPATCH", "1") == "1" and _remaining() > 150:
+        disp = _run_dispatch_bench(min(240.0, _remaining()))
+        if disp is not None and _BANK["best"] is not None:
+            for k, v in disp.items():
+                _BANK["best"][f"dispatch_{k}"] = v
     # Phase 3: poll the relay for a bounded window (never against our own
     # total deadline — the round-3 killer), attempting TPU whenever it answers.
     while (

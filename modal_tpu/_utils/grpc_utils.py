@@ -197,7 +197,11 @@ class _TracingInterceptorUnary(grpc.aio.UnaryUnaryClientInterceptor):
             call = await continuation(client_call_details, request)
             return await call
         finally:
-            CLIENT_RPC_LATENCY.observe(time.perf_counter() - t0, method=short)
+            CLIENT_RPC_LATENCY.observe(
+                time.perf_counter() - t0,
+                method=short,
+                exemplar=ctx.trace_id if ctx is not None else None,
+            )
 
 
 class _TracingInterceptorStream(grpc.aio.UnaryStreamClientInterceptor):

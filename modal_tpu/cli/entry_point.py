@@ -352,6 +352,18 @@ def app_imports(task_id: str, top: int, state_dir: Optional[str]) -> None:
         click.echo(f"{event['duration_s']*1000:10.1f} ms  {event['module']}")
 
 
+def _trace_store(state_dir: Optional[str]) -> tuple[str, str]:
+    """(state_root, span_store_dir) resolution shared by the trace commands."""
+    from ..config import config as _config
+
+    root = state_dir or _config["state_dir"]
+    if state_dir is not None:
+        store = os.path.join(state_dir, "traces")
+    else:
+        store = _config.get("trace_dir") or os.path.join(root, "traces")
+    return root, store
+
+
 @app_group.command("trace")
 @click.argument("needle")
 @click.option(
@@ -362,7 +374,12 @@ def app_imports(task_id: str, top: int, state_dir: Optional[str]) -> None:
     "<state-dir>/tasks/<task-id>/imports.jsonl.",
 )
 @click.option("--last", default=1, help="Render only the N most recent matching traces.")
-def app_trace(needle: str, state_dir: Optional[str], last: int) -> None:
+@click.option(
+    "--critical-path",
+    is_flag=True,
+    help="Append each trace's per-segment critical-path attribution table.",
+)
+def app_trace(needle: str, state_dir: Optional[str], last: int, critical_path: bool) -> None:
     """Render the distributed-trace waterfall for an app / call / input /
     task / trace id: where every input spent its time — client RPC, queue
     wait, placement, worker launch, container boot + imports, user code.
@@ -370,14 +387,9 @@ def app_trace(needle: str, state_dir: Optional[str], last: int) -> None:
     NEEDLE matches a trace-id prefix or any span's app_id /
     function_call_id / input_id / task_id attribute.
     """
-    from ..config import config as _config
     from ..observability import tracing
 
-    root = state_dir or _config["state_dir"]
-    if state_dir is not None:
-        store = os.path.join(state_dir, "traces")
-    else:
-        store = _config.get("trace_dir") or os.path.join(root, "traces")
+    root, store = _trace_store(state_dir)
     traces = tracing.find_traces(store, needle)
     if not traces:
         raise click.ClickException(
@@ -387,37 +399,90 @@ def app_trace(needle: str, state_dir: Optional[str], last: int) -> None:
     ordered = sorted(traces.items(), key=lambda kv: min(s["start"] for s in kv[1]))
     for trace_id, spans in ordered[-max(1, last):]:
         _render_waterfall(trace_id, spans, root)
+        if critical_path:
+            _render_critical_path(spans)
+
+
+def _render_critical_path(spans: list) -> None:
+    from ..observability import critical_path as cp
+
+    attr = cp.attribute_trace(spans)
+    if attr is None:
+        click.echo("  (no function.call root span — cannot attribute)")
+        return
+    agg = cp.aggregate_attributions([attr])
+    click.echo("critical path:")
+    for line in cp.format_attribution_table(agg).splitlines():
+        click.echo(f"  {line}")
+
+
+@app_group.command("attribute")
+@click.argument("needle")
+@click.option("--state-dir", default=None, help="Supervisor state dir (see `app trace`).")
+@click.option("--last", default=0, help="Aggregate only the N most recent matching traces (0 = all).")
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable aggregate.")
+def app_attribute(needle: str, state_dir: Optional[str], last: int, as_json: bool) -> None:
+    """Aggregate critical-path attribution across every matching `.remote()`:
+    p50/p95/p99 per segment (queue_wait, place, handoff, serialize, rpc,
+    user.execute, output delivery) plus the unaccounted `gap` share —
+    the honest answer to "where does dispatch latency go?" (ROADMAP item 3).
+    """
+    from ..observability import critical_path as cp
+
+    _root, store = _trace_store(state_dir)
+    agg, _per_trace = cp.attribute_store(store, needle, last=last)
+    if not agg.get("calls"):
+        raise click.ClickException(
+            f"no attributable trace matching {needle!r} under {store} "
+            "(traces need a function.call root span; is tracing on?)"
+        )
+    if as_json:
+        click.echo(json.dumps(agg, indent=2, sort_keys=True))
+        return
+    click.echo(cp.format_attribution_table(agg))
 
 
 def _render_waterfall(trace_id: str, spans: list, state_dir: str) -> None:
     """One trace as an indented waterfall: offset from trace start, duration,
     and a proportional bar. Boot spans with an import trace on disk expand
-    into their slowest modules (the existing `app imports` data)."""
+    into their slowest modules (the existing `app imports` data).
+
+    Ordering: (normalized start, tree depth, wall start, monotonic stamp) via
+    critical_path.order_spans — children never render before their parents
+    even when cross-process clock skew or equal timestamps would reorder a
+    naive wall-clock sort."""
+    from ..observability import critical_path as cp
     from ..runtime.telemetry import summarize
 
-    spans = sorted(spans, key=lambda s: (s["start"], s.get("end", 0.0)))
+    # one tree reconstruction: sort locally with the same key order_spans
+    # uses rather than paying normalize/depth twice
+    depths = cp.span_depth(spans)
+    norm = cp.normalize_starts(spans)
+    spans = sorted(
+        spans,
+        key=lambda s: (
+            norm.get(s.get("span_id", ""), float(s.get("start") or 0.0)),
+            depths.get(s.get("span_id", ""), 0),
+            float(s.get("start") or 0.0),
+            float(s.get("mono") or 0.0),
+        ),
+    )
     t0 = min(s["start"] for s in spans)
     t_end = max((s.get("end") or s["start"]) for s in spans)
     total = max(t_end - t0, 1e-9)
-    by_id = {s["span_id"]: s for s in spans}
-
-    def _depth(s: dict) -> int:
-        d, seen = 0, set()
-        while s.get("parent_id") and s["parent_id"] in by_id and s["parent_id"] not in seen:
-            seen.add(s["parent_id"])
-            s = by_id[s["parent_id"]]
-            d += 1
-        return d
 
     width = 28
     click.echo(f"trace {trace_id}  ({total*1000:.1f} ms, {len(spans)} spans)")
     for s in spans:
-        start_ms = (s["start"] - t0) * 1000
+        start = norm.get(s.get("span_id", ""), s["start"])
+        start_ms = (start - t0) * 1000
         dur_ms = max(0.0, ((s.get("end") or s["start"]) - s["start"]) * 1000)
-        lo = int(width * (s["start"] - t0) / total)
-        hi = max(lo + 1, int(width * ((s.get("end") or s["start"]) - t0) / total))
+        lo = int(width * (start - t0) / total)
+        hi = max(lo + 1, int(width * (max(s.get("end") or s["start"], start) - t0) / total))
+        hi = min(hi, width)
+        lo = min(lo, hi - 1)
         bar = " " * lo + "▇" * (hi - lo) + " " * (width - hi)
-        indent = "  " * _depth(s)
+        indent = "  " * depths.get(s.get("span_id", ""), 0)
         flag = " !" if s.get("status") == "error" else ""
         name = f"{indent}{s['name']}"
         click.echo(f"  {name:<42.42} {start_ms:>9.1f}ms +{dur_ms:>9.1f}ms |{bar}|{flag}")
@@ -479,6 +544,38 @@ def metrics_cmd(url: Optional[str], state_dir: Optional[str], as_json: bool) -> 
         click.echo(json.dumps(_parse_prometheus(text), indent=2, sort_keys=True))
     else:
         click.echo(text, nl=False)
+
+
+# ---------------------------------------------------------------------------
+# trace store maintenance (observability/tracing.py retention)
+# ---------------------------------------------------------------------------
+
+
+@cli.group("trace")
+def trace_group() -> None:
+    """Maintain the span store (`<state_dir>/traces`)."""
+
+
+@trace_group.command("gc")
+@click.option("--state-dir", default=None, help="Supervisor state dir (default: configured).")
+@click.option("--max-mb", default=256, help="Total span-store size cap (MiB).")
+@click.option("--max-age-hours", default=168.0, help="Drop span files older than this.")
+def trace_gc(state_dir: Optional[str], max_mb: int, max_age_hours: float) -> None:
+    """Prune the span store: age out old files, then evict oldest-first
+    (rotated generations before live files) until under the size cap. The
+    supervisor runs the same prune on every boot; this is the offline knob."""
+    from ..observability import tracing
+
+    _root, store = _trace_store(state_dir)
+    if not os.path.isdir(store):
+        raise click.ClickException(f"no span store at {store}")
+    report = tracing.gc_trace_dir(
+        store, max_total_bytes=max_mb * 1024 * 1024, max_age_s=max_age_hours * 3600.0
+    )
+    click.echo(
+        f"removed {report['removed']} file(s) ({report['removed_bytes']} bytes); "
+        f"kept {report['kept']} ({report['kept_bytes']} bytes)"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -572,11 +669,13 @@ def journal_compact(state_dir: Optional[str], force: bool) -> None:
 
 
 def _parse_prometheus(text: str) -> dict:
-    """Minimal exposition-format parse for --json (sample name+labels → value)."""
+    """Minimal exposition-format parse for --json (sample name+labels → value).
+    OpenMetrics exemplar suffixes (`... # {trace_id="…"} v ts`) are stripped."""
     out: dict = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        line = line.split(" # ", 1)[0]  # drop exemplar
         name_labels, _, value = line.rpartition(" ")
         try:
             out[name_labels] = float(value)
@@ -892,7 +991,9 @@ def config_show() -> None:
 
 @cli.group("profile")
 def profile_group() -> None:
-    """Switch config profiles."""
+    """Config profiles (list/activate) + continuous profiling (start/stop/
+    show): the sampling profiler in the supervisor and its live containers
+    (observability/profiler.py, docs/OBSERVABILITY.md)."""
 
 
 @profile_group.command("list")
@@ -906,6 +1007,77 @@ def profile_list() -> None:
 def profile_activate(name: str) -> None:
     config_set_active_profile(name)
     click.echo(f"activated profile {name}")
+
+
+def _profile_control(action: str, hz: float = 0.0):
+    from .._utils.grpc_utils import retry_transient_errors
+    from ..proto import api_pb2
+
+    client = _client()
+
+    async def go(c):
+        return await retry_transient_errors(
+            c.stub.ProfileControl, api_pb2.ProfileControlRequest(action=action, hz=hz)
+        )
+
+    return synchronizer.run(go(client))
+
+
+@profile_group.command("start")
+@click.option("--hz", default=0.0, help="Sampling rate (default 19 Hz; see profiler.py on GIL cost).")
+def profile_start(hz: float) -> None:
+    """Start continuous profiling: the supervisor samples immediately, and
+    every live container picks the command up on its next heartbeat."""
+    resp = _profile_control("start", hz)
+    click.echo(
+        f"profiling started (supervisor: {resp.supervisor_profile_path}); "
+        "containers join on their next heartbeat"
+    )
+
+
+@profile_group.command("stop")
+def profile_stop() -> None:
+    """Stop continuous profiling everywhere and flush folded-stack files."""
+    resp = _profile_control("stop")
+    click.echo(f"profiling stopped; {len(resp.profile_paths)} profile file(s) on disk")
+    for p in resp.profile_paths:
+        click.echo(f"  {p}")
+
+
+@profile_group.command("show")
+@click.option("--top", default=20, help="Rows in the top table.")
+@click.option("--state-dir", default=None, help="Supervisor state dir (default: configured).")
+@click.option(
+    "--match", default="", help="Only profiles whose filename contains this (e.g. a task id)."
+)
+@click.option("--file", "file_", default=None, help="Render ONE folded file instead of the store.")
+def profile_show(top: int, state_dir: Optional[str], match: str, file_: Optional[str]) -> None:
+    """Render the folded-stack top table (self/cumulative samples per frame)
+    from `<state_dir>/observability/profiles/` — live profiles flush every
+    couple of seconds, so this works while profiling is still running."""
+    from ..config import config as _config
+    from ..observability import profiler as obs_profiler
+
+    if file_:
+        paths = [file_]
+    else:
+        root = state_dir or _config["state_dir"]
+        profiles_dir = os.path.join(root, "observability", "profiles")
+        paths = obs_profiler.list_profiles(profiles_dir)
+        if match:
+            paths = [p for p in paths if match in os.path.basename(p)]
+        if not paths:
+            raise click.ClickException(
+                f"no profiles under {profiles_dir} (start one: `modal_tpu profile start`, "
+                "or set MODAL_TPU_PROFILE=1)"
+            )
+    stacks = obs_profiler.merge_folded(paths)
+    if not stacks:
+        raise click.ClickException(f"no samples in {len(paths)} profile file(s) yet")
+    click.echo(f"{len(paths)} profile file(s):")
+    for p in paths:
+        click.echo(f"  {p}")
+    click.echo(obs_profiler.format_top_table(stacks, top=top))
 
 
 # ---------------------------------------------------------------------------

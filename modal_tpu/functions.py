@@ -452,20 +452,41 @@ class _Function(_Object, type_prefix="fu"):
         # client RPCs, queue wait, placement, container boot, user execution —
         # stitches under this trace id (observability/tracing.py)
         from .observability import tracing
+        from .observability.catalog import DISPATCH_LATENCY
 
+        t_dispatch0 = time.perf_counter()
         with tracing.span(
             "function.call",
             attrs={"function_id": self.object_id or "", "function": self.tag},
-        ):
-            if self._use_input_plane():
-                # region-local data plane: AttemptStart/Await/Retry with JWT
-                # auth (reference _functions.py:394)
-                ip_invocation = await _InputPlaneInvocation.create(self, args, kwargs, client=self.client)
-                return await ip_invocation.run_function()
-            invocation = await _Invocation.create(
-                self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
-            )
-            return await invocation.run_function()
+        ) as root:
+            try:
+                # client.prepare / client.await_output: name the SDK's own
+                # wall time (stub/token prep, retry-wrapper overhead, result
+                # waiting) so the critical-path attribution reports library
+                # overhead as itself instead of gap (critical_path.py); inner
+                # serialize/rpc spans carve out their share by priority
+                if self._use_input_plane():
+                    # region-local data plane: AttemptStart/Await/Retry with JWT
+                    # auth (reference _functions.py:394)
+                    with tracing.span("client.prepare"):
+                        ip_invocation = await _InputPlaneInvocation.create(
+                            self, args, kwargs, client=self.client
+                        )
+                    with tracing.span("client.await_output"):
+                        return await ip_invocation.run_function()
+                with tracing.span("client.prepare"):
+                    invocation = await _Invocation.create(
+                        self, args, kwargs, client=self.client, invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_SYNC
+                    )
+                with tracing.span("client.await_output"):
+                    return await invocation.run_function()
+            finally:
+                # dispatch-latency histogram with the trace id as an
+                # OpenMetrics exemplar: a slow bucket on GET /metrics links
+                # straight to `modal_tpu app trace <trace_id>`
+                DISPATCH_LATENCY.observe(
+                    time.perf_counter() - t_dispatch0, exemplar=root.trace_id
+                )
 
     @live_method_gen
     async def _call_function_generator(self, args: tuple, kwargs: dict) -> AsyncGenerator[Any, None]:
@@ -607,6 +628,10 @@ async def _create_input(
     per-input: the container deserializes by this format and echoes it on
     the result (reference _serialization.py:359 — CBOR is how non-Python
     SDKs call deployed functions)."""
+    from .observability import tracing
+
+    ser_ctx = tracing.current_context()
+    t_ser = time.time()
     if data_format == api_pb2.DATA_FORMAT_CBOR:
         payload = serialize_payload_data_format([list(args), kwargs], data_format)
     else:
@@ -618,29 +643,51 @@ async def _create_input(
         input_pb.args_blob_id = await blob_upload(payload, stub)
     else:
         input_pb.args = payload.join()
+    if ser_ctx is not None:
+        # the serialize segment of the dispatch critical path
+        # (observability/critical_path.py); blob offload time included
+        tracing.record_span(
+            "client.serialize",
+            start=t_ser,
+            end=time.time(),
+            parent=ser_ctx,
+            attrs={"bytes": payload.nbytes, "blob": bool(input_pb.args_blob_id)},
+        )
     return api_pb2.FunctionPutInputsItem(idx=idx, input=input_pb)
 
 
 async def _process_result(result: api_pb2.GenericResult, data_format: int, stub, client) -> Any:
     """Decode a GenericResult into a value or raise (reference
     _process_result, _functions.py)."""
-    data = await resolve_blob_data(result, stub)
+    from .observability import tracing
 
-    if result.status == api_pb2.GENERIC_STATUS_TIMEOUT:
-        raise FunctionTimeoutError(result.exception)
-    elif result.status == api_pb2.GENERIC_STATUS_TERMINATED:
-        raise RemoteError(f"function terminated: {result.exception or 'container stopped'}")
-    elif result.status == api_pb2.GENERIC_STATUS_INTERNAL_FAILURE:
-        raise ExecutionError(result.exception)
-    elif result.status != api_pb2.GENERIC_STATUS_SUCCESS:
-        if data:
-            exc = deserialize_exception(
-                data, result.exception, result.traceback, client, result.serialized_tb
+    des_ctx = tracing.current_context()
+    t_des = time.time()
+    try:
+        data = await resolve_blob_data(result, stub)
+
+        if result.status == api_pb2.GENERIC_STATUS_TIMEOUT:
+            raise FunctionTimeoutError(result.exception)
+        elif result.status == api_pb2.GENERIC_STATUS_TERMINATED:
+            raise RemoteError(f"function terminated: {result.exception or 'container stopped'}")
+        elif result.status == api_pb2.GENERIC_STATUS_INTERNAL_FAILURE:
+            raise ExecutionError(result.exception)
+        elif result.status != api_pb2.GENERIC_STATUS_SUCCESS:
+            if data:
+                exc = deserialize_exception(
+                    data, result.exception, result.traceback, client, result.serialized_tb
+                )
+                raise exc
+            raise RemoteError(result.exception or "remote function failed")
+
+        return deserialize_data_format(data, data_format or api_pb2.DATA_FORMAT_PICKLE, client)
+    finally:
+        if des_ctx is not None:
+            # the deserialize tail of the dispatch critical path (blob fetch
+            # for spilled results included; exception decode too)
+            tracing.record_span(
+                "client.deserialize", start=t_des, end=time.time(), parent=des_ctx
             )
-            raise exc
-        raise RemoteError(result.exception or "remote function failed")
-
-    return deserialize_data_format(data, data_format or api_pb2.DATA_FORMAT_PICKLE, client)
 
 
 class _Invocation:

@@ -173,6 +173,14 @@ def benchmark_decode(
     jax.device_get(logits2[:, :8])
     prefill_s = time.perf_counter() - t0
 
+    # device telemetry (observability/device_telemetry.py): steady-state
+    # step-time histograms + a post-run HBM sample ride the metrics plane
+    from ..observability.device_telemetry import observe_step_time, sample_device_memory
+
+    observe_step_time(decode_s / max(1, gen_len), "decode")
+    observe_step_time(prefill_s, "prefill")
+    sample_device_memory()
+
     return {
         "prefill_compile_s": prefill_compile_s,
         "decode_compile_s": decode_compile_s,

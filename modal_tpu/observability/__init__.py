@@ -20,8 +20,18 @@ instrumentation-parity test (tests/test_api_parity.py) checks it against the
 RPCs `server/services.py` actually implements.
 """
 
-from . import metrics, tracing
-from .catalog import METRIC_CATALOG, instrumented_rpc_names
+from . import critical_path, device_telemetry, metrics, profiler, tracing
+from .catalog import METRIC_CATALOG, SPAN_CATALOG, instrumented_rpc_names
 from .metrics import REGISTRY
 
-__all__ = ["tracing", "metrics", "REGISTRY", "METRIC_CATALOG", "instrumented_rpc_names"]
+__all__ = [
+    "tracing",
+    "metrics",
+    "critical_path",
+    "profiler",
+    "device_telemetry",
+    "REGISTRY",
+    "METRIC_CATALOG",
+    "SPAN_CATALOG",
+    "instrumented_rpc_names",
+]

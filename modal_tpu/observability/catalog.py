@@ -209,6 +209,51 @@ IDEMPOTENT_REPLAYS = REGISTRY.counter(
     ("method",),
 )
 
+# -- dispatch attribution + profiling (ISSUE 7; observability/critical_path.py,
+# observability/profiler.py, docs/OBSERVABILITY.md) ---------------------------
+
+DISPATCH_LATENCY = REGISTRY.histogram(
+    "modal_tpu_dispatch_latency_seconds",
+    "Client-observed end-to-end `.remote()` wall time (the function.call root span); "
+    "observations carry the trace_id as an OpenMetrics exemplar, so a p99 bucket "
+    "links to `modal_tpu app trace <trace_id>`.",
+)
+PROFILER_SAMPLES = REGISTRY.counter(
+    "modal_tpu_profiler_samples_total",
+    "Stack samples taken by the in-process sampling profiler.",
+)
+PROFILER_RUNNING = REGISTRY.gauge(
+    "modal_tpu_profiler_running",
+    "1 while the process's sampling profiler is active.",
+)
+
+# -- device / compile telemetry (observability/device_telemetry.py) -----------
+
+DEVICE_MEMORY_BYTES = REGISTRY.gauge(
+    "modal_tpu_device_memory_bytes",
+    "Live per-device memory from jax Device.memory_stats() (bytes_in_use | "
+    "bytes_limit | peak_bytes_in_use); CPU backends fall back to host RSS.",
+    ("device", "kind"),
+)
+COMPILE_EVENTS = REGISTRY.counter(
+    "modal_tpu_compile_events_total",
+    "XLA compilation-cache events via jax.monitoring (cache_hit | cache_miss | "
+    "compile | cache_disabled | other), attributed to runtime vs Image.prewarm bake.",
+    ("event", "source"),
+)
+COMPILE_SECONDS = REGISTRY.histogram(
+    "modal_tpu_compile_seconds",
+    "XLA compile/lowering/cache-io durations via jax.monitoring, by phase.",
+    ("phase",),
+    buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 15, 30, 60, 120, 300, 600),
+)
+STEP_SECONDS = REGISTRY.histogram(
+    "modal_tpu_step_seconds",
+    "Train/decode step wall time (post-compile steady state), by loop kind.",
+    ("kind",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60),
+)
+
 # -- chaos --------------------------------------------------------------------
 
 CHAOS_SEED = REGISTRY.gauge(
@@ -239,6 +284,50 @@ def observe_peak_rss() -> float:
 
 
 METRIC_CATALOG: dict[str, str] = {m: REGISTRY.get(m).help for m in REGISTRY.names()}
+
+
+# -- span catalog (ISSUE 7 satellite) -----------------------------------------
+# Every span name the tree emits, declared here; entries ending in ".*" cover
+# a dynamic family (e.g. one rpc.client.<Method> span per RPC). The parity
+# test (tests/test_api_parity.py::test_every_emitted_span_is_in_catalog)
+# extracts the literal first argument of every tracing.span/open_span/
+# record_span call in the source tree and fails names that aren't declared —
+# so new code can't ship span names the attribution/waterfall tooling has
+# never heard of.
+SPAN_CATALOG: dict[str, str] = {
+    "function.call": "client root of one .remote(): everything stitches under it",
+    "client.serialize": "client-side argument serialization (+ blob offload)",
+    "client.deserialize": "client-side result decode (+ blob fetch for spilled results)",
+    "client.prepare": "SDK prep around invocation create: stub/token setup, retry wrapper",
+    "client.await_output": "SDK output-wait loop around the GetOutputs/AttemptAwait polls",
+    "rpc.client.*": "client-observed unary RPC (interceptor, _utils/grpc_utils.py)",
+    "rpc.server.*": "server handler span for a traced caller (proto/rpc.py)",
+    "scheduler.queue_wait": "enqueue→claim wait, recorded retroactively at claim",
+    "scheduler.place": "worker pick + chip pin + assignment",
+    "worker.launch_task": "image prep + container spawn/handoff on the worker",
+    "image.build": "image materialization (cache hits are fast)",
+    "container.boot": "spawn decision → ready for inputs (MODAL_TPU_TRACE_T0)",
+    "container.imports": "user-code import inside the container",
+    "container.enter_hooks": "@enter lifecycle hooks",
+    "container.input_deliver": "input delivery hop: fetch response → user.execute (deserialize + spawn)",
+    "user.execute": "one input's user-code execution (cold_call marks jit)",
+    "coldstart.handoff": "warm-pool adoption: handoff enqueue → interpreter ack",
+    "coldstart.preimport": "warm-pool parked pre-import of a configured module",
+    "coldstart.preinit": "warm-pool opt-in jax backend pre-initialization",
+    "recovery.replay": "journal replay into a fresh ServerState",
+    "recovery.crash_restart": "chaos supervisor crash + same-port rebuild",
+}
+
+
+def declared_span_name(name: str) -> bool:
+    """Is `name` (an exact span name or an f-string prefix like
+    'rpc.server.') covered by the span catalog?"""
+    if name in SPAN_CATALOG:
+        return True
+    for entry in SPAN_CATALOG:
+        if entry.endswith(".*") and name.startswith(entry[:-1]):
+            return True
+    return False
 
 
 def instrumented_rpc_names() -> frozenset:

@@ -69,6 +69,12 @@ def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    # HELP text escaping per the exposition format: backslash and newline
+    # only (quotes are legal in help text)
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class Counter(_Metric):
     kind = "counter"
 
@@ -119,12 +125,15 @@ class Gauge(_Metric):
 
 
 class _HistSeries:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets
         self.sum = 0.0
         self.count = 0
+        # bucket index (len(buckets) = +Inf) -> (trace_id, value, unix_ts):
+        # the most recent exemplar-carrying observation landing in the bucket
+        self.exemplars: dict[int, tuple[str, float, float]] = {}
 
 
 class Histogram(_Metric):
@@ -140,18 +149,25 @@ class Histogram(_Metric):
         super().__init__(name, help, labelnames)
         self.buckets = tuple(sorted(buckets))
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None, **labels: str) -> None:
+        """Record one observation. `exemplar` is an optional trace_id: the
+        bucket keeps the latest one, and the OpenMetrics exposition renders
+        it so a p99 bucket links to a fetchable trace (`app trace <id>`)."""
         key = self._key(labels)
         with self._lock:
             series = self._series.get(key)
             if series is None:
                 series = self._series[key] = _HistSeries(len(self.buckets))
+            idx = len(self.buckets)  # +Inf unless a bound catches it
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     series.counts[i] += 1
+                    idx = i
                     break
             series.sum += value
             series.count += 1
+            if exemplar:
+                series.exemplars[idx] = (str(exemplar), float(value), time.time())
 
     def count_total(self) -> int:
         with self._lock:
@@ -175,20 +191,61 @@ class Histogram(_Metric):
                     return self.buckets[i]
             return self.buckets[-1]
 
-    def render(self) -> list[str]:
+    def render(self, exemplars: bool = False) -> list[str]:
+        """Exposition lines; with ``exemplars=True`` bucket samples carry the
+        OpenMetrics exemplar suffix (`... # {trace_id="…"} value timestamp`)."""
         lines = []
         with self._lock:
             for key, series in sorted(self._series.items()):
                 cumulative = 0
-                for bound, c in zip(self.buckets, series.counts):
+                for i, (bound, c) in enumerate(zip(self.buckets, series.counts)):
                     cumulative += c
                     le = 'le="%s"' % bound
-                    lines.append(f"{self.name}_bucket{self._fmt_labels(key, le)} {cumulative}")
+                    line = f"{self.name}_bucket{self._fmt_labels(key, le)} {cumulative}"
+                    lines.append(line + self._exemplar_suffix(series, i, exemplars))
                 inf = 'le="+Inf"'
-                lines.append(f"{self.name}_bucket{self._fmt_labels(key, inf)} {series.count}")
+                line = f"{self.name}_bucket{self._fmt_labels(key, inf)} {series.count}"
+                lines.append(line + self._exemplar_suffix(series, len(self.buckets), exemplars))
                 lines.append(f"{self.name}_sum{self._fmt_labels(key)} {round(series.sum, 6)}")
                 lines.append(f"{self.name}_count{self._fmt_labels(key)} {series.count}")
         return lines
+
+    def _merge_series(self, key: tuple[str, ...], state: dict, prev_state: Optional[dict]) -> None:
+        """Apply a pushed series' DELTA vs its previous push (cross-process
+        merge, `merge_families`). Bucket lists of a different length are
+        dropped — the pusher compiled against different bucket bounds."""
+        counts = state.get("counts")
+        if not isinstance(counts, list) or len(counts) != len(self.buckets):
+            return
+        prev_counts = (prev_state or {}).get("counts") or [0] * len(self.buckets)
+        if len(prev_counts) != len(self.buckets):
+            prev_counts = [0] * len(self.buckets)
+        d_count = int(state.get("count", 0)) - int((prev_state or {}).get("count", 0))
+        d_sum = float(state.get("sum", 0.0)) - float((prev_state or {}).get("sum", 0.0))
+        if d_count <= 0:
+            return
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                if key not in self._series and len(self._series) >= MAX_SERIES:
+                    key = tuple(OVERFLOW for _ in self.labelnames)
+                series = self._series.setdefault(key, _HistSeries(len(self.buckets)))
+            for i, (c, p) in enumerate(zip(counts, prev_counts)):
+                delta = int(c) - int(p)
+                if delta > 0:
+                    series.counts[i] += delta
+            series.count += d_count
+            series.sum += d_sum
+
+    @staticmethod
+    def _exemplar_suffix(series: _HistSeries, idx: int, enabled: bool) -> str:
+        if not enabled:
+            return ""
+        ex = series.exemplars.get(idx)
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return f' # {{trace_id="{_escape(trace_id)}"}} {round(value, 9)} {round(ts, 3)}'
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -256,9 +313,35 @@ class MetricsRegistry:
             metrics = [self._metrics[name] for name in sorted(self._metrics)]
         out: list[str] = []
         for m in metrics:
-            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# HELP {m.name} {_escape_help(m.help)}")
             out.append(f"# TYPE {m.name} {m.kind}")
             out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+    def render_openmetrics(self) -> str:
+        """The OpenMetrics flavor of the exposition: same families, but
+        histogram buckets carry exemplars (`# {trace_id="…"} value ts`) and
+        the body terminates with `# EOF`. Served by `GET /metrics` when the
+        scraper accepts ``application/openmetrics-text`` — a p99 dispatch
+        bucket then links straight to `modal_tpu app trace <trace_id>`."""
+        with self._lock:
+            metrics = [self._metrics[name] for name in sorted(self._metrics)]
+        out: list[str] = []
+        for m in metrics:
+            # OpenMetrics names the counter FAMILY without the _total suffix
+            # (samples keep it): '# TYPE x counter' + 'x_total{...} v'. Our
+            # counters are all declared as ..._total, so strip it here or a
+            # strict openmetrics parser fails the entire scrape.
+            family = m.name
+            if m.kind == "counter" and family.endswith("_total"):
+                family = family[: -len("_total")]
+            out.append(f"# HELP {family} {_escape_help(m.help)}")
+            out.append(f"# TYPE {family} {m.kind}")
+            if isinstance(m, Histogram):
+                out.extend(m.render(exemplars=True))
+            else:
+                out.extend(m.render())
+        out.append("# EOF")
         return "\n".join(out) + "\n"
 
     def snapshot(self) -> dict:
@@ -283,6 +366,14 @@ class MetricsRegistry:
             summary["rpc_count"] = lat.count_total()
             summary["rpc_latency_p50_s"] = lat.quantile(0.5)
             summary["rpc_latency_p99_s"] = lat.quantile(0.99)
+        disp = self.get("modal_tpu_dispatch_latency_seconds")
+        if isinstance(disp, Histogram) and disp.count_total():
+            summary["dispatch_count"] = disp.count_total()
+            summary["dispatch_latency_p50_s"] = disp.quantile(0.5)
+        steps = self.get("modal_tpu_step_seconds")
+        if isinstance(steps, Histogram) and steps.count_total():
+            summary["step_p50_s"] = steps.quantile(0.5)
+        _tot("modal_tpu_compile_events_total", "compile_events")
         _tot("modal_tpu_scheduler_tasks_launched_total", "tasks_launched")
         _tot("modal_tpu_blob_bytes_total", "blob_bytes")
         _tot("modal_tpu_client_rpc_retries_total", "client_rpc_retries")
@@ -303,3 +394,69 @@ class MetricsRegistry:
 
 
 REGISTRY = MetricsRegistry()
+
+
+# -- cross-process push (container → control plane over ContainerHeartbeat) ---
+#
+# Containers are separate processes with their own REGISTRY, and they run no
+# scrape endpoint — so whitelisted families ride the heartbeat as JSON
+# (`ContainerHeartbeatRequest.telemetry_json`) and merge into the
+# supervisor's registry: gauges are set (latest wins), counters and
+# histogram buckets apply the DELTA against the task's previous report, so
+# repeated pushes of cumulative totals never double count.
+
+
+def export_families(names: Iterable[str], registry: MetricsRegistry = REGISTRY) -> dict:
+    """JSON-ready snapshot of the named families (full bucket state for
+    histograms — quantiles survive the merge)."""
+    out: dict = {}
+    for name in names:
+        m = registry.get(name)
+        if m is None:
+            continue
+        if isinstance(m, Histogram):
+            with m._lock:
+                series = {
+                    ",".join(k): {"counts": list(s.counts), "sum": s.sum, "count": s.count}
+                    for k, s in m._series.items()
+                }
+            if series:
+                out[name] = {"kind": "histogram", "series": series}
+        elif isinstance(m, (Counter, Gauge)):
+            series = m.snapshot()
+            if series:
+                out[name] = {"kind": m.kind, "series": series}
+    return out
+
+
+def merge_families(
+    report: dict, prev: Optional[dict] = None, registry: MetricsRegistry = REGISTRY
+) -> None:
+    """Merge one pushed report into `registry`. `prev` is the same source's
+    previous report (for counter/histogram deltas); malformed entries are
+    skipped — a telemetry bug must never break the heartbeat path."""
+    prev = prev or {}
+    for name, family in (report or {}).items():
+        m = registry.get(name)
+        if m is None or not isinstance(family, dict):
+            continue
+        kind = family.get("kind")
+        series = family.get("series")
+        if kind != m.kind or not isinstance(series, dict):
+            continue
+        prev_series = (prev.get(name) or {}).get("series") or {}
+        for key_s, value in series.items():
+            key = tuple(str(key_s).split(",")) if key_s else ()
+            if len(key) != len(m.labelnames):
+                continue
+            try:
+                if isinstance(m, Gauge):
+                    m.set(float(value), **dict(zip(m.labelnames, key)))
+                elif isinstance(m, Counter):
+                    delta = float(value) - float(prev_series.get(key_s, 0.0))
+                    if delta > 0:
+                        m.inc(delta, **dict(zip(m.labelnames, key)))
+                elif isinstance(m, Histogram):
+                    m._merge_series(key, value, prev_series.get(key_s))
+            except (TypeError, ValueError):
+                continue

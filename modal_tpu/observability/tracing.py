@@ -70,6 +70,11 @@ class Span:
     attrs: dict[str, Any] = field(default_factory=dict)
     events: list[dict] = field(default_factory=list)
     status: str = "ok"
+    # monotonic stamp paired with the wall-clock start: within one process
+    # it preserves true creation order even when wall timestamps collide or
+    # step backwards (NTP) — the waterfall orders by (normalized start,
+    # tree depth, mono) so children never render before parents
+    mono: float = field(default_factory=time.monotonic)
 
     @property
     def context(self) -> SpanContext:
@@ -92,6 +97,7 @@ class Span:
             "status": self.status,
             "attrs": self.attrs,
             "events": self.events,
+            "mono": self.mono,
         }
 
 
@@ -100,6 +106,27 @@ class Span:
 _sink_lock = threading.Lock()
 _sink_file = None
 _sink_dir: Optional[str] = None
+_sink_bytes = 0
+
+# retention (ISSUE 7 satellite): spans files rotate at this size so a
+# long-lived supervisor can't grow one file without bound; ONE rotated
+# generation (.jsonl.1) is kept per pid, and gc_trace_dir prunes the store
+# (supervisor boot + `modal_tpu trace gc`)
+TRACE_MAX_BYTES_ENV = "MODAL_TPU_TRACE_MAX_BYTES"
+DEFAULT_SINK_MAX_BYTES = 64 * 1024 * 1024
+DEFAULT_STORE_MAX_BYTES = 256 * 1024 * 1024
+DEFAULT_STORE_MAX_AGE_S = 7 * 24 * 3600.0
+# gc never evicts a LIVE (non-rotated) file written within this window: the
+# pid in the filename may belong to ANOTHER process (a running supervisor or
+# container) whose open sink an unlink would silently sever
+LIVE_SINK_GRACE_S = 300.0
+
+
+def _sink_max_bytes() -> int:
+    try:
+        return int(os.environ.get(TRACE_MAX_BYTES_ENV, DEFAULT_SINK_MAX_BYTES))
+    except ValueError:
+        return DEFAULT_SINK_MAX_BYTES
 
 
 def configure(trace_dir: str) -> None:
@@ -108,7 +135,7 @@ def configure(trace_dir: str) -> None:
     the operator's config override (config.py `trace_dir`), so exporting it
     here would pin every later supervisor in this process to the first
     sink. The worker passes the dir to container processes explicitly."""
-    global _sink_file, _sink_dir
+    global _sink_file, _sink_dir, _sink_bytes
     with _sink_lock:
         if _sink_dir == trace_dir and _sink_file is not None:
             return
@@ -121,7 +148,34 @@ def configure(trace_dir: str) -> None:
         os.makedirs(trace_dir, exist_ok=True)
         path = os.path.join(trace_dir, f"spans-{os.getpid()}.jsonl")
         _sink_file = open(path, "a", buffering=1)
+        try:
+            _sink_bytes = os.path.getsize(path)
+        except OSError:
+            _sink_bytes = 0
         _sink_dir = trace_dir
+
+
+def _rotate_locked() -> None:
+    """Size-capped rotation (called with _sink_lock held): the open file
+    becomes `spans-<pid>.jsonl.1` (replacing the previous generation) and a
+    fresh file takes appends — bounded disk, at most one generation lost."""
+    global _sink_file, _sink_bytes
+    if _sink_file is None or _sink_dir is None:
+        return
+    path = os.path.join(_sink_dir, f"spans-{os.getpid()}.jsonl")
+    try:
+        _sink_file.close()
+    except OSError:
+        pass
+    try:
+        os.replace(path, path + ".1")
+    except OSError:
+        pass
+    try:
+        _sink_file = open(path, "a", buffering=1)
+        _sink_bytes = 0
+    except OSError:
+        _sink_file = None
 
 
 def maybe_configure_from_env() -> None:
@@ -158,6 +212,7 @@ atexit.register(_shutdown)
 
 
 def _write(span: Span) -> None:
+    global _sink_bytes
     if _sink_file is None:
         return
     try:
@@ -168,6 +223,9 @@ def _write(span: Span) -> None:
         if _sink_file is not None:
             try:
                 _sink_file.write(line + "\n")
+                _sink_bytes += len(line) + 1
+                if _sink_bytes >= _sink_max_bytes():
+                    _rotate_locked()
             except (OSError, ValueError):
                 pass
 
@@ -357,7 +415,8 @@ def read_spans(trace_dir_path: str) -> list[dict]:
     except OSError:
         return spans
     for fname in names:
-        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+        # rotated generations (.jsonl.1) read the same as live files
+        if not (fname.startswith("spans-") and (fname.endswith(".jsonl") or fname.endswith(".jsonl.1"))):
             continue
         try:
             with open(os.path.join(trace_dir_path, fname)) as f:
@@ -371,6 +430,68 @@ def read_spans(trace_dir_path: str) -> list[dict]:
         except OSError:
             continue
     return spans
+
+
+def gc_trace_dir(
+    trace_dir_path: str,
+    max_total_bytes: int = DEFAULT_STORE_MAX_BYTES,
+    max_age_s: float = DEFAULT_STORE_MAX_AGE_S,
+) -> dict:
+    """Prune the span store: drop files older than `max_age_s`, then drop
+    oldest-first (rotated generations before live files) until the store is
+    under `max_total_bytes`. The current process's open sink file is never
+    deleted. Called by the supervisor on boot and `modal_tpu trace gc`."""
+    report = {"removed": 0, "removed_bytes": 0, "kept": 0, "kept_bytes": 0}
+    try:
+        names = os.listdir(trace_dir_path)
+    except OSError:
+        return report
+    own = f"spans-{os.getpid()}.jsonl"
+    now = time.time()
+    entries = []  # (mtime, is_rotated, path, size)
+    for fname in names:
+        if not (fname.startswith("spans-") and (fname.endswith(".jsonl") or fname.endswith(".jsonl.1"))):
+            continue
+        path = os.path.join(trace_dir_path, fname)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        entries.append((st.st_mtime, fname.endswith(".1"), path, st.st_size, fname))
+
+    def _remove(path: str, size: int) -> None:
+        try:
+            os.unlink(path)
+            report["removed"] += 1
+            report["removed_bytes"] += size
+        except OSError:
+            pass
+
+    def _protected(is_rotated: bool, mtime: float, fname: str) -> bool:
+        # our own open sink, or any recently-written live file (possibly an
+        # open sink of another process — unlinking it would silently sever
+        # that process's span stream); rotated generations are never open
+        return fname == own or (not is_rotated and now - mtime < LIVE_SINK_GRACE_S)
+
+    keep = []
+    for mtime, is_rotated, path, size, fname in entries:
+        if not _protected(is_rotated, mtime, fname) and now - mtime > max_age_s:
+            _remove(path, size)
+        else:
+            keep.append((mtime, is_rotated, path, size, fname))
+    # over the cap: evict rotated generations first, then oldest live files
+    keep.sort(key=lambda e: (not e[1], e[0]))  # rotated first, oldest first
+    total = sum(e[3] for e in keep)
+    kept = []
+    for e in keep:
+        if total > max_total_bytes and not _protected(e[1], e[0], e[4]):
+            _remove(e[2], e[3])
+            total -= e[3]
+        else:
+            kept.append(e)
+    report["kept"] = len(kept)
+    report["kept_bytes"] = sum(e[3] for e in kept)
+    return report
 
 
 def find_traces(trace_dir_path: str, needle: str) -> dict[str, list[dict]]:

@@ -187,7 +187,16 @@ def train_demo(
             jax.random.randint(jax.random.PRNGKey(1), (n_batch, seq_len), 0, cfg.vocab_size, jnp.int32),
             token_sharding,
         )
+        from ..observability.device_telemetry import StepTimer, sample_device_memory
+
         metrics = {}
+        timer = StepTimer("train")
         for _ in range(steps):
             state, metrics = step_fn(state, tokens)
+            # jax dispatch is async: block on the step's outputs so the mark
+            # records step wall time, not enqueue latency (the first mark
+            # still includes trace+compile — that's the honest cold step)
+            jax.block_until_ready(metrics)
+            timer.mark()
+        sample_device_memory()
         return {k: float(v) for k, v in metrics.items()}

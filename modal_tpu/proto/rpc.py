@@ -201,6 +201,10 @@ _RPC_NAMES = [
     "ClientHello",
     "TokenFlowCreate",
     "TokenFlowWait",
+    # Continuous profiling (observability/profiler.py): toggle the sampling
+    # profiler in the supervisor and fan out to live containers via
+    # ContainerHeartbeatResponse.profile_command
+    "ProfileControl",
     # Workspace (identity/membership/settings; billing is NG)
     "WorkspaceNameLookup",
     "WorkspaceMemberList",
@@ -336,7 +340,11 @@ def _instrument_unary(name: str, impl: Any) -> Any:
             code = "error"
             raise
         finally:
-            RPC_LATENCY.observe(_time.perf_counter() - t0, method=_name)
+            RPC_LATENCY.observe(
+                _time.perf_counter() - t0,
+                method=_name,
+                exemplar=ctx.trace_id if ctx is not None else None,
+            )
             RPC_TOTAL.inc(method=_name, code=code)
 
     return instrumented

@@ -247,6 +247,18 @@ async def run_input_loop(service: Service, io: ContainerIOManager) -> None:
                 parent = tracing.parse_context(
                     io.input_trace_contexts.get(ctx.input_ids[0], "")
                 ) or tracing.context_from_env()
+                if ctx.fetched_at and parent is not None:
+                    # the delivery hop between the scheduler's claim and user
+                    # execution: args deserialize + runner-task spawn — a
+                    # dispatch-latency segment the attribution would
+                    # otherwise report as gap (critical_path.py)
+                    tracing.record_span(
+                        "container.input_deliver",
+                        start=ctx.fetched_at,
+                        end=time.time(),
+                        parent=parent,
+                        attrs={"input_id": ctx.input_ids[0], "task_id": io.task_id},
+                    )
                 with tracing.span(
                     "user.execute",
                     parent=parent,
@@ -424,6 +436,14 @@ async def main_async() -> int:
     io._function_id = container_args.function_id
     heartbeat_task = asyncio.create_task(io.heartbeat_loop(), name="heartbeat")
 
+    # continuous profiling (observability/profiler.py): the env toggle starts
+    # the sampler at boot; the heartbeat applies runtime start/stop commands
+    from ..observability import device_telemetry, profiler as obs_profiler
+
+    obs_profiler.maybe_start_from_env(
+        os.environ.get(obs_profiler.PROFILE_DIR_ENV, ""), tag=task_id
+    )
+
     # Container boot span: starts at the worker's spawn decision
     # (MODAL_TPU_TRACE_T0) and ends when the container is ready for inputs —
     # the cold-start segment of the launching input's trace. Children
@@ -474,6 +494,10 @@ async def main_async() -> int:
                 "import_trace": bool(os.environ.get("MODAL_TPU_TELEMETRY_PATH")),
             },
         )
+        # compile/device telemetry: attach jax.monitoring listeners NOW (user
+        # imports just ran, so if the function uses jax it is in sys.modules)
+        # — the first-call jit compile must be counted, not just later ones
+        device_telemetry.install_compile_hooks()
 
         # lifecycle: enter hooks (pre-snapshot = warm weight load). With
         # memory snapshots enabled, later cold boots SKIP the snap-enter

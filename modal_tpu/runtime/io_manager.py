@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import os
 import time
 import traceback
 from dataclasses import dataclass
@@ -44,6 +45,10 @@ class IOContext:
     # per-input wire format (pickle/cbor), echoed on results so a CBOR
     # caller gets a CBOR answer (reference _serialization.py:359)
     data_format: int = 0  # api_pb2.DATA_FORMAT_* (0 = unspecified -> pickle)
+    # server claim stamp (FunctionGetInputsItem.claimed_at; response-arrival
+    # fallback): the container.input_deliver span starts here, covering the
+    # claim→execute hop (delivery + args deserialize + runner spawn)
+    fetched_at: float = 0.0
     _cancelled: bool = False
 
     @property
@@ -112,18 +117,31 @@ class ContainerIOManager:
 
     async def heartbeat_loop(self) -> None:
         """Heartbeat doubles as the cancellation channel (reference
-        container_io_manager.py:577-643)."""
+        container_io_manager.py:577-643) — and as the telemetry/profiling
+        plane: each beat pushes the container's device/compile metric
+        families up (ContainerHeartbeatRequest.telemetry_json) and applies
+        the control plane's profiling command coming back down."""
+        from ..observability import device_telemetry, profiler
+
         interval = float(config.get("heartbeat_interval")) / 3
         while not self.terminate:
             try:
                 resp = await retry_transient_errors(
                     self.stub.ContainerHeartbeat,
                     api_pb2.ContainerHeartbeatRequest(
-                        task_id=self.task_id, supports_graceful_input_cancellation=True
+                        task_id=self.task_id,
+                        supports_graceful_input_cancellation=True,
+                        telemetry_json=device_telemetry.container_report(),
                     ),
                     attempt_timeout=10.0,
                     max_retries=2,
                 )
+                if resp.profile_command:
+                    profiler.apply_command(
+                        resp.profile_command,
+                        os.environ.get(profiler.PROFILE_DIR_ENV, ""),
+                        tag=self.task_id,
+                    )
                 if resp.HasField("cancel_input_event"):
                     event = resp.cancel_input_event
                     if event.terminate_containers:
@@ -204,6 +222,13 @@ class ContainerIOManager:
                         return
                     continue
                 idle_since = time.monotonic()
+                # delivery-span anchor: the server's claim stamp when carried
+                # (claim→execute is exactly the delivery hop); a server that
+                # predates the field falls back to response arrival — never
+                # the poll's ISSUE time, which in steady state predates the
+                # call itself and would swallow the client's prep/RPC window
+                claim_stamps = [i.claimed_at for i in items if i.claimed_at > 0]
+                fetched_at = min(claim_stamps) if claim_stamps else time.time()
                 # deserialize up front (blob-aware)
                 ctx_inputs: list[tuple[tuple, dict]] = []
                 method_name = ""
@@ -240,6 +265,7 @@ class ContainerIOManager:
                     inputs=ctx_inputs,
                     method_name=method_name,
                     data_format=ctx_format,
+                    fetched_at=fetched_at,
                 )
                 for item in items:
                     if item.resume_token:

@@ -85,6 +85,18 @@ class BlobServer:
         return url
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text by default; the OpenMetrics flavor — histogram
+        buckets carrying trace-id exemplars + `# EOF` — when the scraper asks
+        for it (`Accept: application/openmetrics-text`, the standard
+        Prometheus negotiation, or `?format=openmetrics`). A p99 dispatch
+        bucket's exemplar resolves via `modal_tpu app trace <trace_id>`."""
+        accept = request.headers.get("Accept", "")
+        if "openmetrics" in accept or request.query.get("format") == "openmetrics":
+            return web.Response(
+                text=REGISTRY.render_openmetrics(),
+                content_type="application/openmetrics-text",
+                charset="utf-8",
+            )
         return web.Response(
             text=REGISTRY.render_prometheus(),
             content_type="text/plain",

@@ -420,7 +420,8 @@ class ImageBuilder:
         the function runs: the jit entry points it traces are compiled at
         BUILD time, and the cache dir is recorded as image env so every
         container launched from this image starts with a warm cache."""
-        if any(c.strip() == "#PREWARM" for c in image.dockerfile_commands):
+        prewarm = any(c.strip() == "#PREWARM" for c in image.dockerfile_commands)
+        if prewarm:
             cache_dir = os.path.join(built.rootfs, "cache", "jax")
             os.makedirs(cache_dir, exist_ok=True)
             built.env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
@@ -431,17 +432,72 @@ class ImageBuilder:
         with open(payload, "wb") as f:
             f.write(image.build_function_serialized)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        telemetry_out = os.path.join(build_dir, "prewarm_compile_events.json")
+        # compile-telemetry attribution (observability/device_telemetry.py):
+        # the bake's compiles happen in THIS subprocess, whose registry dies
+        # with it — so a prewarm runner installs the jax.monitoring hooks up
+        # front (source="prewarm" via MODAL_TPU_PREWARM_BUILD below) and
+        # dumps the counts for the builder to merge into the live registry
+        prewarm_prelude = (
+            "try:  # hooks need jax imported; a jax-less bake just skips them\n"
+            "    import jax\n"
+            "    from modal_tpu.observability import device_telemetry as _dt\n"
+            "    _dt.install_compile_hooks()\n"
+            "except Exception:\n"
+            "    pass\n"
+        ) if prewarm else ""
+        prewarm_epilogue = (
+            "try:\n"
+            "    import json as _json\n"
+            "    from modal_tpu.observability.catalog import COMPILE_EVENTS as _ce\n"
+            f"    open({telemetry_out!r}, 'w').write(_json.dumps(_ce.snapshot()))\n"
+            "except Exception:\n"
+            "    pass\n"
+        ) if prewarm else ""
         runner = (
             "import sys\n"
             f"sys.path.insert(0, {pkg_root!r})\n"
-            "from modal_tpu.serialization import deserialize\n"
+            + prewarm_prelude
+            + "from modal_tpu.serialization import deserialize\n"
             f"fn, (args, kwargs) = deserialize(open({payload!r}, 'rb').read(), None)\n"
             "fn(*args, **kwargs)\n"
+            + prewarm_epilogue
         )
         script = os.path.join(build_dir, "build_fn.py")
         with open(script, "w") as f:
             f.write(runner)
-        await run_shell(f"{shlex.quote(built.python_bin)} {shlex.quote(script)}", shell_env(), built.workdir)
+        env = shell_env()
+        if prewarm:
+            # build-subprocess env only, never image env: compiles under the
+            # bake count as source="prewarm", not runtime serving cost
+            env["MODAL_TPU_PREWARM_BUILD"] = "1"
+        await run_shell(f"{shlex.quote(built.python_bin)} {shlex.quote(script)}", env, built.workdir)
+        if prewarm:
+            self._merge_prewarm_compile_events(telemetry_out)
+
+    @staticmethod
+    def _merge_prewarm_compile_events(path: str) -> None:
+        """Fold the bake subprocess's compile-event counts into this
+        process's registry: GET /metrics then shows how much compilation the
+        prewarm paid (source="prewarm") next to what serving pays at
+        runtime. Best-effort — a bake without jax writes nothing."""
+        import json
+
+        from ..observability.catalog import COMPILE_EVENTS
+
+        try:
+            with open(path) as f:
+                snapshot = json.load(f)
+        except (OSError, ValueError):
+            return
+        for key, count in snapshot.items():
+            parts = str(key).split(",")
+            if len(parts) != 2:
+                continue
+            try:
+                COMPILE_EVENTS.inc(float(count), event=parts[0], source=parts[1])
+            except (TypeError, ValueError):
+                continue
 
 
 def _unquote(v: str) -> str:

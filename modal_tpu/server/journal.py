@@ -135,6 +135,7 @@ EXEMPT_RPCS: dict[str, str] = {
     "ContainerLog": "log streams are best-effort; documented as lost on crash",
     "FunctionCallPutData": "generator data chunks are an ephemeral stream (can be GiB-scale)",
     "FunctionSetWebUrl": "runtime-transient; the serving container re-reports it",
+    "ProfileControl": "profiling toggle is runtime-transient; an operator re-issues it after a restart",
     # on-disk content-addressed stores are already durable
     "MountPutFile": "content-addressed block store on disk is already durable",
     "MountGetOrCreate": "manifest is stored as an on-disk block",

@@ -48,6 +48,11 @@ from .state import (
     make_id,
 )
 
+# how long a ProfileControl "stop" keeps broadcasting on heartbeats before
+# expiring (long enough for every live container's next few beats; short
+# enough that future env-enabled profilers aren't killed at boot)
+PROFILE_STOP_TTL_S = 60.0
+
 CREATE_IF_MISSING = api_pb2.OBJECT_CREATION_TYPE_CREATE_IF_MISSING
 FAIL_IF_EXISTS = api_pb2.OBJECT_CREATION_TYPE_CREATE_FAIL_IF_EXISTS
 EPHEMERAL = api_pb2.OBJECT_CREATION_TYPE_EPHEMERAL
@@ -1079,13 +1084,66 @@ class ModalTPUServicer:
         if task is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "task not found")
         task.last_heartbeat = time.time()
+        if request.telemetry_json:
+            # device/compile telemetry push (observability/device_telemetry.py):
+            # merge the container's whitelisted metric families into this
+            # process's registry so GET /metrics shows live HBM + compile
+            # activity; deltas are computed against the task's previous push
+            from ..observability.device_telemetry import merge_container_report
+
+            task.telemetry_prev_json = merge_container_report(
+                request.telemetry_json,
+                getattr(task, "telemetry_prev_json", ""),
+                task_id=task.task_id,
+            )
         resp = api_pb2.ContainerHeartbeatResponse()
+        if (
+            self.s.profile_command == "stop"
+            and time.time() - self.s.profile_command_set_at > PROFILE_STOP_TTL_S
+        ):
+            # expire a stale stop: every container live at stop time has had
+            # many heartbeats to apply it; a permanent broadcast would also
+            # kill future containers' env-enabled profilers at first beat
+            self.s.profile_command = ""
+        if self.s.profile_command:
+            # repeat the active profiling command every heartbeat; containers
+            # apply it idempotently (observability/profiler.py)
+            resp.profile_command = self.s.profile_command
         if task.cancelled_input_ids:
             resp.cancel_input_event.input_ids.extend(task.cancelled_input_ids)
             task.cancelled_input_ids = []
         if task.terminate:
             resp.cancel_input_event.terminate_containers = True
         return resp
+
+    async def ProfileControl(self, request, context) -> api_pb2.ProfileControlResponse:
+        """Runtime toggle for the sampling profiler (observability/profiler.py):
+        applies to the supervisor process immediately and fans out to live
+        containers via the heartbeat's profile_command."""
+        from ..observability import profiler
+
+        profiles_dir = os.path.join(self.s.state_dir, "observability", "profiles")
+        action = request.action or "status"
+        if action == "start":
+            hz = request.hz or profiler.DEFAULT_HZ
+            self.s.profile_command = f"start:{hz:g}"
+            self.s.profile_command_set_at = time.time()
+            profiler.start(profiles_dir, tag="supervisor", hz=hz)
+        elif action == "stop":
+            self.s.profile_command = "stop"
+            self.s.profile_command_set_at = time.time()
+            profiler.stop()
+        elif action != "status":
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"unknown profile action {action!r}"
+            )
+        prof = profiler.current()
+        return api_pb2.ProfileControlResponse(
+            running=profiler.running(),
+            supervisor_profile_path=prof.path if prof is not None else "",
+            n_samples=prof.n_samples if prof is not None else 0,
+            profile_paths=profiler.list_profiles(profiles_dir),
+        )
 
     def _scaledown_blocked(self, fn, task) -> bool:
         """Is this container one of the `min_containers` oldest live ones for
@@ -1188,6 +1246,7 @@ class ModalTPUServicer:
                             retry_count=inp.retry_count,
                             resume_token=inp.resume_token,
                             trace_context=inp.trace_context,
+                            claimed_at=inp.claimed_at,
                         )
                     )
             else:
@@ -1215,6 +1274,7 @@ class ModalTPUServicer:
                                 retry_count=inp.retry_count,
                                 resume_token=inp.resume_token,
                                 trace_context=inp.trace_context,
+                                claimed_at=inp.claimed_at,
                             )
                         )
                     if not items or len(items) >= batch_size or not request.batch_linger_ms:
@@ -1724,6 +1784,12 @@ class ModalTPUServicer:
             for chip, tid in list(worker.chips_in_use.items()):
                 if tid == task.task_id:
                     del worker.chips_in_use[chip]
+        # drop the task's pushed device-memory gauge series: stale HBM values
+        # must not render forever, and per-task keys would otherwise leak the
+        # family into __overflow__ (observability/device_telemetry.py)
+        from ..observability.device_telemetry import drop_task_device_series
+
+        drop_task_device_series(task.task_id)
         fn = self.s.functions.get(task.function_id)
         if fn is not None:
             fn.task_ids.discard(task.task_id)

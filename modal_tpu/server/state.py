@@ -165,6 +165,9 @@ class TaskState_:
     # served by a pre-forked warm-pool interpreter (ContainerHello stamp;
     # surfaced on TaskGetTimeline so bench.py can prove the warm path)
     warm_pool_hit: bool = False
+    # the container's previous telemetry push (raw JSON) — counter/histogram
+    # merges are delta'd against it (observability/device_telemetry.py)
+    telemetry_prev_json: str = ""
 
 
 @dataclass
@@ -381,6 +384,13 @@ class ServerState:
         # TokenFlowCreate + blob_server auth route)
         self.pending_token_flows: dict[str, dict] = {}
         self.blob_url_base: str = ""  # set by supervisor once blob server is up
+        # active profiling command ("start:<hz>" | "stop" | ""): repeated on
+        # every container heartbeat while set (ProfileControl, profiler.py).
+        # "stop" expires after PROFILE_STOP_TTL_S — it only needs to reach
+        # containers live at stop time; broadcast forever it would also kill
+        # every FUTURE container's env-enabled (MODAL_TPU_PROFILE) profiler
+        self.profile_command: str = ""
+        self.profile_command_set_at: float = 0.0
         # input plane (region-local data plane): url advertised in
         # ClientHello; HS256 secret shared between AuthTokenGet (control
         # plane) and the input-plane servicer's verifier; attempt_token ->

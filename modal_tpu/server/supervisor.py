@@ -150,7 +150,19 @@ class LocalSupervisor:
         if config["trace"]:
             # span sink under the supervisor dir; exported to containers via
             # MODAL_TPU_TRACE_DIR (observability/tracing.py)
-            tracing.configure(config.get("trace_dir") or os.path.join(self.state_dir, "traces"))
+            trace_dir = config.get("trace_dir") or os.path.join(self.state_dir, "traces")
+            # retention: prune dead-run span files before opening this run's
+            # sink (size/age caps; `modal_tpu trace gc` does the same offline)
+            tracing.gc_trace_dir(trace_dir)
+            tracing.configure(trace_dir)
+        # continuous profiling (observability/profiler.py): MODAL_TPU_PROFILE
+        # starts the supervisor's sampler at boot; the ProfileControl RPC
+        # toggles it (and every container's) at runtime
+        from ..observability import profiler as obs_profiler
+
+        obs_profiler.maybe_start_from_env(
+            os.path.join(self.state_dir, "observability", "profiles"), tag="supervisor"
+        )
         # journal + recovery BEFORE the gRPC server binds: the first client
         # retry after a restart must already see the replayed state (and the
         # dedupe wrapper captures state.idempotency at handler-build time)

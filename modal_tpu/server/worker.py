@@ -993,6 +993,14 @@ class WorkerAgent:
         env[tracing.TRACE_T0_ENV] = str(t_launch0)
         if tracing.trace_dir():
             env[tracing.TRACE_DIR_ENV] = tracing.trace_dir()
+        # profiling sink (observability/profiler.py): where this container
+        # drops its folded-stack files — both for the MODAL_TPU_PROFILE env
+        # toggle (inherited via os.environ above) and the runtime
+        # profile_command delivered on its heartbeats
+        env.setdefault(
+            "MODAL_TPU_PROFILE_DIR",
+            os.path.join(self.state_dir, "observability", "profiles"),
+        )
         env["MODAL_TPU_SERVER_URL"] = self.server_url
         env["MODAL_TPU_TASK_ID"] = task_id
         env["MODAL_TPU_TASK_DIR"] = task_dir

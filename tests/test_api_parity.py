@@ -269,6 +269,78 @@ def test_every_mutating_rpc_is_journal_covered():
 
 
 @pytest.mark.observability
+def test_every_emitted_span_is_in_catalog():
+    """Span-catalog parity (ISSUE 7 satellite): every span name emitted
+    anywhere in the tree must be declared in observability/catalog.py's
+    SPAN_CATALOG, so new code can't ship span names the attribution /
+    waterfall tooling has never heard of. Literal first arguments of
+    tracing.span/open_span/record_span calls are extracted by AST walk;
+    f-strings reduce to their literal prefix (matched against the catalog's
+    `prefix.*` entries)."""
+    import ast
+    import os
+
+    from modal_tpu.observability.catalog import SPAN_CATALOG, declared_span_name
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src_root = os.path.join(pkg_root, "modal_tpu")
+    emitted: dict[str, list[str]] = {}
+    for dirpath, _dirs, files in os.walk(src_root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                name = getattr(func, "attr", None) or getattr(func, "id", None)
+                if name not in ("span", "open_span", "record_span"):
+                    continue
+                # only tracing.* calls (skip unrelated same-named methods)
+                if isinstance(func, ast.Attribute):
+                    owner = func.value
+                    owner_name = getattr(owner, "attr", None) or getattr(owner, "id", None)
+                    if owner_name not in ("tracing", "_tracing"):
+                        continue
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    emitted.setdefault(first.value, []).append(path)
+                elif isinstance(first, ast.JoinedStr):
+                    # f"rpc.server.{name}" → prefix "rpc.server."
+                    prefix = ""
+                    for part in first.values:
+                        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                            prefix += part.value
+                        else:
+                            break
+                    emitted.setdefault(prefix, []).append(path)
+    assert emitted, "AST walk found no span emissions — extractor broken?"
+    # sanity: the walker sees the well-known sites
+    assert "function.call" in emitted and "user.execute" in emitted
+    undeclared = {
+        name: paths for name, paths in emitted.items() if not declared_span_name(name)
+    }
+    assert not undeclared, (
+        f"span names emitted but not declared in SPAN_CATALOG "
+        f"(observability/catalog.py): { {n: p[0] for n, p in undeclared.items()} }"
+    )
+    # and the catalog has no dead entries that nothing emits
+    def _covers(entry: str) -> bool:
+        if entry.endswith(".*"):
+            return any(n.startswith(entry[:-1]) for n in emitted)
+        return entry in emitted
+
+    dead = [entry for entry in SPAN_CATALOG if not _covers(entry)]
+    assert not dead, f"SPAN_CATALOG declares spans nothing emits: {dead}"
+
+
+@pytest.mark.observability
 def test_blob_http_routes_chaos_and_metrics_parity(tmp_path):
     """Instrumentation parity for the HTTP data plane, extended to the
     Range/streaming routes this repo grew (block GET, volfile GET): every

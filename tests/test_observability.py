@@ -373,6 +373,80 @@ def test_metrics_label_sets_are_bounded():
 
 
 @pytest.mark.observability
+def test_metrics_overflow_series_across_kinds():
+    """Bounded-label-set overflow (ISSUE 7 satellite): histograms and gauges
+    collapse past MAX_SERIES like counters do, the overflow series renders in
+    the exposition, and pre-existing series keep updating after overflow."""
+    from modal_tpu.observability.metrics import MAX_SERIES, OVERFLOW, MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("t_ovf_seconds", "h", ("key",), buckets=(1.0,))
+    g = reg.gauge("t_ovf_gauge", "g", ("key",))
+    for i in range(MAX_SERIES + 10):
+        h.observe(0.5, key=f"k{i}")
+        g.set(float(i), key=f"k{i}")
+    assert h.snapshot()[OVERFLOW]["count"] == 10
+    assert g.snapshot()[OVERFLOW] == float(MAX_SERIES + 9)
+    # an established series still takes samples after the cap is hit
+    h.observe(0.5, key="k0")
+    assert h.snapshot()["k0"]["count"] == 2
+    text = reg.render_prometheus()
+    assert f'key="{OVERFLOW}"' in text
+
+
+@pytest.mark.observability
+def test_exposition_escapes_label_values_and_help():
+    """Exposition escaping (ISSUE 7 satellite): label values carrying
+    quotes, newlines, and backslashes must render escaped per the format
+    spec — a hostile label value (e.g. a user-controlled method string) must
+    not corrupt the scrape."""
+    from modal_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("t_esc_total", 'help with \\ backslash\nand newline', ("val",))
+    c.inc(val='say "hi"')
+    c.inc(val="line1\nline2")
+    c.inc(val="back\\slash")
+    text = reg.render_prometheus()
+    assert 'val="say \\"hi\\""' in text
+    assert 'val="line1\\nline2"' in text
+    assert 'val="back\\\\slash"' in text
+    # HELP escapes backslash + newline; every body line is sample or comment
+    assert "# HELP t_esc_total help with \\\\ backslash\\nand newline" in text
+    for line in text.splitlines():
+        assert line.startswith("#") or " " in line
+    # and the --json parser round-trips the escaped sample lines
+    from modal_tpu.cli.entry_point import _parse_prometheus
+
+    parsed = _parse_prometheus(text)
+    assert any("say" in k for k in parsed)
+
+
+@pytest.mark.observability
+def test_histogram_bucket_boundary_observations():
+    """Bucket boundaries (ISSUE 7 satellite): `le` is inclusive — a value
+    exactly on a bound counts in that bucket; above the top bound only +Inf;
+    negative values land in the first bucket; cumulative counts monotone."""
+    from modal_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("t_bound_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.1)   # exactly on the first bound → le="0.1"
+    h.observe(1.0)   # exactly on the second → le="1.0"
+    h.observe(10.0)  # exactly on the top → le="10.0"
+    h.observe(10.000001)  # past the top → +Inf only
+    h.observe(-5.0)  # negative → first bucket
+    text = "\n".join(h.render())
+    assert 't_bound_seconds_bucket{le="0.1"} 2' in text       # 0.1 and -5.0
+    assert 't_bound_seconds_bucket{le="1.0"} 3' in text
+    assert 't_bound_seconds_bucket{le="10.0"} 4' in text
+    assert 't_bound_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_bound_seconds_count 5" in text
+    # sum reflects the raw values, not bucket bounds
+    assert f"t_bound_seconds_sum {round(0.1 + 1.0 + 10.0 + 10.000001 - 5.0, 6)}" in text
+
+
+@pytest.mark.observability
 def test_histogram_quantile_and_bench_summary():
     from modal_tpu.observability.catalog import RPC_LATENCY
     from modal_tpu.observability.metrics import REGISTRY

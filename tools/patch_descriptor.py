@@ -71,6 +71,20 @@ NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
         ("task_id", 4, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
     ],
     "PoolAdoptAckResponse": [],
+    # Continuous profiling (ISSUE 7, observability/profiler.py): runtime
+    # toggle for the in-process sampling profiler. action: start|stop|status;
+    # the response lists the folded-stack files currently on disk so the CLI
+    # can render `profile show` right after a stop.
+    "ProfileControlRequest": [
+        ("action", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("hz", 2, F.TYPE_FLOAT, F.LABEL_OPTIONAL, ""),
+    ],
+    "ProfileControlResponse": [
+        ("running", 1, F.TYPE_BOOL, F.LABEL_OPTIONAL, ""),
+        ("supervisor_profile_path", 2, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("n_samples", 3, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
+        ("profile_paths", 4, F.TYPE_STRING, F.LABEL_REPEATED, ""),
+    ],
 }
 
 # (message, field_name, field_number, field_type) — optionally a 5-tuple with
@@ -110,6 +124,21 @@ PATCHES: list[tuple[str, str, int, int]] = [
     # scheduler→worker pool-sizing directive (outside the event oneof; the
     # worker checks HasField)
     ("WorkerPollResponse", "pool_directive", 4, F.TYPE_MESSAGE, ".modal.tpu.api.PoolDirective"),
+    # Continuous profiling (ISSUE 7): the supervisor repeats the active
+    # profile command ("start:<hz>" | "stop") on every container heartbeat —
+    # idempotent apply in io_manager, so no ack protocol is needed
+    ("ContainerHeartbeatResponse", "profile_command", 2, F.TYPE_STRING),
+    # Device/compile telemetry push (observability/device_telemetry.py): the
+    # container's whitelisted metric families (device memory gauges, compile
+    # events/durations, step times) ride the heartbeat as compact JSON; the
+    # control plane merges deltas into its own registry so GET /metrics
+    # shows LIVE per-device HBM and compile activity
+    ("ContainerHeartbeatRequest", "telemetry_json", 3, F.TYPE_STRING),
+    # Critical-path attribution (observability/critical_path.py): the server
+    # stamps claim time on each delivered input so the container's
+    # container.input_deliver span starts at the CLAIM — anchoring at the
+    # long-poll's issue time would swallow the client's prep/RPC window
+    ("FunctionGetInputsItem", "claimed_at", 9, F.TYPE_DOUBLE),
 ]
 
 HEADER = '''\
@@ -141,9 +170,22 @@ def _json_name(name: str) -> str:
     return parts[0] + "".join(p.capitalize() for p in parts[1:])
 
 
+def _load_pb2(pb2_path: str):
+    """Load api_pb2 straight from its file, NOT through the modal_tpu
+    package: the package import builds the RPC registry, which validates
+    every registered RPC against the descriptor — a registry entry for the
+    very message this tool is about to add would deadlock the regen."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_patch_descriptor_api_pb2", pb2_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main() -> None:
     pb2_path = os.path.join(REPO_ROOT, "modal_tpu", "proto", "api_pb2.py")
-    from modal_tpu.proto import api_pb2
+    api_pb2 = _load_pb2(pb2_path)
 
     fdp = descriptor_pb2.FileDescriptorProto.FromString(api_pb2.DESCRIPTOR.serialized_pb)
     by_name = {m.name: m for m in fdp.message_type}
