@@ -900,6 +900,63 @@ def _run_dispatch_bench(timeout_s: float) -> dict | None:
     return _run_microbench("dispatch", "bench_dispatch.py", "DISPATCH_BENCH_RESULT", timeout_s)
 
 
+# dispatch-regression tolerance (ISSUE 8 satellite): the floor may wobble
+# with host noise, but a p50 >1.5x the recorded baseline (or calls/s below
+# baseline/1.5) flags dispatch_regression=true in the banked result.
+DISPATCH_REGRESSION_FACTOR = 1.5
+
+
+def _dispatch_regression_guard(disp: dict) -> None:
+    """ISSUE 8 satellite: dispatch_p50_s / dispatch_calls_per_s are recorded
+    in BENCH_dispatch.json and tolerance-checked against the previous
+    baseline, so later PRs can't silently regress the dispatch floor. On a
+    clean (non-regressed) run the file is rewritten with the new numbers; on
+    a regression the OLD baseline is kept, so the flag stays red until the
+    floor is actually recovered."""
+    path = os.path.join(REPO_ROOT, "BENCH_dispatch.json")
+    baseline = None
+    try:
+        with open(path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        pass
+    p50 = disp.get("p50_s")
+    cps = disp.get("calls_per_s")
+    regression = False
+    if baseline is not None:
+        base_p50 = baseline.get("dispatch_p50_s")
+        base_cps = baseline.get("dispatch_calls_per_s")
+        if base_p50 and p50 and p50 > base_p50 * DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[dispatch]: REGRESSION p50 {p50:.4f}s vs baseline {base_p50:.4f}s\n"
+            )
+        if base_cps and cps and cps < base_cps / DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[dispatch]: REGRESSION calls/s {cps:.1f} vs baseline {base_cps:.1f}\n"
+            )
+    if _BANK["best"] is not None:
+        _BANK["best"]["dispatch_regression"] = regression
+    if not regression:
+        try:
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "dispatch_p50_s": p50,
+                        "dispatch_calls_per_s": cps,
+                        "dispatch_max_calls_per_s": disp.get("max_calls_per_s"),
+                        "sweep": disp.get("sweep"),
+                        "written_at": time.time(),
+                    },
+                    f,
+                    indent=1,
+                )
+                f.write("\n")
+        except OSError as exc:
+            sys.stderr.write(f"bench[dispatch]: baseline write failed: {exc}\n")
+
+
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--mode":
         child_main(sys.argv[2])
@@ -986,6 +1043,9 @@ def _orchestrate() -> None:
         if disp is not None and _BANK["best"] is not None:
             for k, v in disp.items():
                 _BANK["best"][f"dispatch_{k}"] = v
+            # ISSUE 8 satellite: floor guard — record + tolerance-check the
+            # dispatch baseline so later PRs can't silently regress it
+            _dispatch_regression_guard(disp)
     # Phase 3: poll the relay for a bounded window (never against our own
     # total deadline — the round-3 killer), attempting TPU whenever it answers.
     while (

@@ -590,6 +590,15 @@ class ConcurrencySemaphore:
                 raise
         self.active += 1
 
+    def try_acquire(self) -> bool:
+        """Non-blocking acquire: take a free slot now or report none. Used by
+        the claim-coalescing input fetch (io_manager) to size one GetInputs
+        at however many inputs this container could run immediately."""
+        if self._closed or self.active >= self.value:
+            return False
+        self.active += 1
+        return True
+
     def release(self) -> None:
         self.active -= 1
         self._wake()

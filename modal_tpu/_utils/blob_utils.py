@@ -23,6 +23,7 @@ import random
 import tempfile
 from typing import AsyncIterator, BinaryIO, Optional, Union
 
+from ..config import logger
 from ..exception import ExecutionError
 from ..proto import api_pb2
 from .grpc_utils import retry_transient_errors
@@ -329,13 +330,56 @@ async def _get_range(url: str, start: int, stop: int) -> bytes:
     raise ExecutionError("unreachable")
 
 
+def _blob_local_dir(stub) -> str:
+    """Co-located blob store advertised by the server (ClientHello →
+    FastPathStub._blob_local_dir, docs/DISPATCH.md). Empty when the store is
+    remote, the fast path is off, or MODAL_TPU_FASTPATH_BLOB=0."""
+    from .local_transport import blob_local_enabled
+
+    if not blob_local_enabled():
+        return ""
+    path = getattr(stub, "_blob_local_dir", "")
+    return path if path and os.path.isdir(path) else ""
+
+
+async def _blob_local_write(local_dir: str, blob_id: str, source) -> None:
+    """Path handoff: the payload's zero-copy segments land straight in the
+    server's content store (tmp + rename; the server only ever sees complete
+    blobs) — no HTTP hop, no re-copy through a channel. `source` is a segment
+    list or a seekable file object."""
+    path = os.path.join(local_dir, blob_id)
+    tmp = f"{path}.tmp-{os.getpid()}-{id(source):x}"
+
+    def _write() -> None:
+        with open(tmp, "wb") as f:
+            if isinstance(source, list):
+                for seg in source:
+                    f.write(seg)
+            else:
+                source.seek(0)
+                import shutil
+
+                shutil.copyfileobj(source, f, 8 * 1024 * 1024)
+        os.replace(tmp, path)
+
+    try:
+        await asyncio.to_thread(_write)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 async def blob_upload(payload: Union[bytes, bytearray, memoryview, BinaryIO, "object"], stub) -> str:
     """Upload a payload, returning its blob_id (reference blob_utils.py:364).
 
     Accepts bytes, a seekable file object, or anything with a ``.segments``
     list (serialization.Payload). Segment payloads hash and stream without
     ever being joined; file objects stream part-by-part under the same
-    budget."""
+    budget. Co-located clients write the file straight into the server's
+    store (path handoff) instead of PUTting it over HTTP."""
     def _as_byte_seg(seg):
         # memoryviews may carry a multi-byte format (e.g. a float32 array
         # view) where len() counts ELEMENTS; cast to "B" so hashing,
@@ -355,6 +399,21 @@ async def blob_upload(payload: Union[bytes, bytearray, memoryview, BinaryIO, "ob
         content_sha256_base64=hashes.sha256_base64, content_length=hashes.content_length
     )
     resp = await retry_transient_errors(stub.BlobCreate, req)
+    local_dir = _blob_local_dir(stub)
+    if local_dir:
+        try:
+            await _blob_local_write(
+                local_dir, resp.blob_id, segments if segments is not None else payload
+            )
+            from ..observability.catalog import FASTPATH_CALLS
+
+            FASTPATH_CALLS.inc(transport="blob_local")
+            return resp.blob_id
+        except OSError as exc:
+            # store not actually writable from here (permissions, stale
+            # advertisement): degrade to the HTTP path for good
+            logger.warning(f"local blob write failed ({exc}); using HTTP upload")
+            stub._blob_local_dir = ""
     which = resp.WhichOneof("upload_type_oneof")
     if which == "multipart":
         await _multipart_upload(payload if segments is None else segments, resp.multipart)
@@ -475,7 +534,31 @@ async def blob_download(blob_id: str, stub) -> Union[bytes, memoryview]:
     """Download a blob. Payloads at/above the spill threshold stream to disk
     via parallel Range GETs and come back as an mmap-backed memoryview (the
     deserializer reads tensors straight out of it, zero-copy); smaller ones
-    return plain bytes as before — in a single request."""
+    return plain bytes as before — in a single request. Co-located clients
+    skip both: the blob file is opened in place and large payloads arrive as
+    an mmap view over the server's own store (page-cache handoff, zero HTTP
+    bytes)."""
+    local_dir = _blob_local_dir(stub)
+    if local_dir:
+        path = os.path.join(local_dir, blob_id)
+        try:
+            size = os.path.getsize(path)
+            threshold = download_spill_threshold()
+
+            def _read():
+                with open(path, "rb") as f:
+                    if threshold > 0 and size >= threshold:
+                        mm = mmap.mmap(f.fileno(), size, access=mmap.ACCESS_READ)
+                        return memoryview(mm)
+                    return f.read()
+
+            data = await asyncio.to_thread(_read)
+            from ..observability.catalog import FASTPATH_CALLS
+
+            FASTPATH_CALLS.inc(transport="blob_local")
+            return data
+        except OSError:
+            pass  # not there / unreadable: the HTTP path below is the truth
     resp = await retry_transient_errors(stub.BlobGet, api_pb2.BlobGetRequest(blob_id=blob_id))
     url = resp.download_url
     threshold = download_spill_threshold()

@@ -56,7 +56,10 @@ HEARTBEAT_RPCS = frozenset({"ContainerHeartbeat", "WorkerHeartbeat"})
 # target). warm_kill_handoff: the warm pool SIGKILLs the parked interpreter
 # right after the handoff payload is queued — the ack never lands and the
 # placement must fall back to a fresh spawn (docs/COLDSTART.md).
-LIFECYCLE_KNOBS = frozenset({"warm_kill_handoff"})
+# stream_reset: FunctionStreamOutputs aborts UNAVAILABLE mid-stream — the
+# client must degrade to the unary poll rung with the call completing
+# exactly-once (docs/DISPATCH.md).
+LIFECYCLE_KNOBS = frozenset({"warm_kill_handoff", "stream_reset"})
 
 # HTTP blob routes are injected under pseudo-RPC names so one policy and one
 # rate table cover the gRPC and HTTP planes alike. BlockGet is the volume
@@ -139,6 +142,9 @@ class ChaosPolicy:
         - MODAL_TPU_CHAOS_WARM_KILL_HANDOFF (int N: kill the next N warm-pool
           interpreters mid-handoff; the placements must fall back to fresh
           spawns — server/warm_pool.py)
+        - MODAL_TPU_CHAOS_STREAM_RESETS (int N: abort the next N
+          FunctionStreamOutputs streams mid-flight; clients must degrade to
+          the unary poll rung — docs/DISPATCH.md)
         """
         if os.environ.get("MODAL_TPU_CHAOS", "") not in ("1", "true", "yes"):
             return None
@@ -180,6 +186,13 @@ class ChaosPolicy:
             logger.warning("ignoring malformed MODAL_TPU_CHAOS_WARM_KILL_HANDOFF")
         if warm_kill > 0:
             policy.fail_counts["warm_kill_handoff"] = warm_kill
+        try:
+            stream_resets = int(os.environ.get("MODAL_TPU_CHAOS_STREAM_RESETS", "0") or 0)
+        except ValueError:
+            stream_resets = 0
+            logger.warning("ignoring malformed MODAL_TPU_CHAOS_STREAM_RESETS")
+        if stream_resets > 0:
+            policy.fail_counts["stream_reset"] = stream_resets
         return policy
 
     # -- deterministic decision engine --------------------------------------

@@ -48,6 +48,18 @@ class _Client:
         self.image_builder_version: Optional[str] = None
         self.input_plane_url: Optional[str] = None
         self._auth_token_manager: Optional[Any] = None
+        # local fast-path coordinates by server URL (learned at hello();
+        # env-provided for containers) — consumed by _wrap_fastpath
+        self._uds_by_url: dict[str, str] = {}
+        self._stub_tcp: Optional[ModalTPUStub] = None
+        # coalesced dispatch (_utils/coalescer.py): per-plane micro-batchers
+        # for FunctionMap / AttemptStart submissions
+        from ._utils.coalescer import BatcherRegistry
+
+        self._batchers = BatcherRegistry()
+        self._map_batch_unsupported = False
+        self._attempt_batch_unsupported = False
+        self._stream_outputs_unsupported = False
 
     def _metadata(self) -> dict[str, str]:
         md = {
@@ -62,9 +74,53 @@ class _Client:
             md["x-modal-tpu-task-id"] = config.get("task_id")
         return md
 
+    def _wrap_fastpath(
+        self, server_url: str, tcp_stub: ModalTPUStub, uds_path: str = "", blob_local_dir: str = ""
+    ) -> Any:
+        """Upgrade a TCP stub to the local fast-path ladder (inproc → UDS →
+        TCP, _utils/local_transport.py) when any local rung is usable. The
+        co-location check is a stat: a path the server advertised that this
+        process can actually see. Anything non-local returns the TCP stub
+        unchanged."""
+        from ._utils import local_transport
+
+        if not local_transport.fastpath_enabled():
+            return tcp_stub
+        uds_ok = (
+            local_transport.uds_enabled()
+            and local_transport.usable_uds_path(uds_path)
+            and os.path.exists(uds_path)
+        )
+        blob_ok = bool(blob_local_dir) and os.path.isdir(blob_local_dir)
+        inproc_ok = local_transport.resolve_local_server(server_url) is not None
+        if not (uds_ok or blob_ok or inproc_ok):
+            return tcp_stub
+        uds_stub = None
+        if uds_ok:
+            uds_url = f"unix://{uds_path}"
+            if uds_url not in self._channel_cache:
+                self._channel_cache[uds_url] = create_channel(uds_url, metadata=self._metadata())
+            uds_stub = ModalTPUStub(self._channel_cache[uds_url])
+        return local_transport.FastPathStub(
+            server_url,
+            tcp_stub,
+            uds_path=uds_path if uds_ok else "",
+            uds_stub=uds_stub,
+            base_metadata=self._metadata(),
+            blob_local_dir=blob_local_dir if blob_ok else "",
+        )
+
     async def _open(self) -> None:
         self._channel = create_channel(self.server_url, metadata=self._metadata())
-        self._stub = ModalTPUStub(self._channel)
+        # containers learn their local coordinates from the worker's env
+        # (they never call hello()); plain clients upgrade at hello() time
+        self._stub_tcp = ModalTPUStub(self._channel)
+        self._stub = self._wrap_fastpath(
+            self.server_url,
+            self._stub_tcp,
+            uds_path=os.environ.get("MODAL_TPU_SERVER_UDS", ""),
+            blob_local_dir=os.environ.get("MODAL_TPU_BLOB_LOCAL_DIR", ""),
+        )
 
     async def _close(self) -> None:
         self._closed = True
@@ -84,11 +140,16 @@ class _Client:
 
     async def get_stub(self, server_url: str) -> ModalTPUStub:
         """Stub for an alternate server URL (input plane / worker data plane),
-        cached per URL (reference client.py:135)."""
+        cached per URL (reference client.py:135). Fast-path-upgraded when the
+        URL has known local coordinates (ClientHello advertisement / env)."""
         if server_url not in self._stub_cache:
             channel = create_channel(server_url, metadata=self._metadata())
             self._channel_cache[server_url] = channel
-            self._stub_cache[server_url] = ModalTPUStub(channel)
+            self._stub_cache[server_url] = self._wrap_fastpath(
+                server_url,
+                ModalTPUStub(channel),
+                uds_path=self._uds_by_url.get(server_url, ""),
+            )
         return self._stub_cache[server_url]
 
     async def get_input_plane_metadata(self) -> list[tuple[str, str]]:
@@ -110,6 +171,20 @@ class _Client:
             logger.warning(resp.warning)
         self.image_builder_version = resp.image_builder_version or None
         self.input_plane_url = resp.input_plane_url or None
+        # transport upgrade (docs/DISPATCH.md): the server just told us its
+        # local coordinates — a stat-able socket/blob dir means co-location,
+        # so re-point the stub at the fast-path ladder. Unverifiable paths
+        # leave the TCP stub untouched (the false-negative case degrades to
+        # today's behavior by construction).
+        if resp.input_plane_url and resp.input_plane_uds_path:
+            self._uds_by_url[resp.input_plane_url] = resp.input_plane_uds_path
+        if self._stub_tcp is not None and (resp.uds_path or resp.blob_local_dir):
+            self._stub = self._wrap_fastpath(
+                self.server_url,
+                self._stub_tcp,
+                uds_path=resp.uds_path,
+                blob_local_dir=resp.blob_local_dir,
+            )
 
     async def __aenter__(self) -> "_Client":
         await self._open()

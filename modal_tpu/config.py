@@ -201,3 +201,22 @@ def _store_user_config(new_settings: dict, profile: Optional[str] = None) -> Non
     user_config = _read_user_config()
     user_config.setdefault(profile, {}).update(**new_settings)
     _write_user_config(user_config)
+
+
+def tune_switch_interval() -> None:
+    """Dispatch-critical processes (supervisor, containers) lower the GIL
+    switch interval from CPython's default 5 ms: every `.remote()` crosses
+    threads several times (sync caller ↔ synchronizer loop; container serving
+    loop ↔ main-thread executor), and each handoff can stall a full switch
+    interval when both threads are runnable — at the default that is most of
+    the sub-10 ms dispatch budget (ISSUE 8, docs/DISPATCH.md).
+    MODAL_TPU_SWITCH_INTERVAL overrides; 0 (or malformed) leaves the
+    interpreter default untouched."""
+    import sys as _sys
+
+    try:
+        interval = float(os.environ.get("MODAL_TPU_SWITCH_INTERVAL", "0.001"))
+    except ValueError:
+        return
+    if interval > 0:
+        _sys.setswitchinterval(interval)

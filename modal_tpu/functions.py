@@ -16,8 +16,12 @@ from __future__ import annotations
 import asyncio
 import inspect
 import os
+import random
 import time
 import typing
+
+import grpc
+import grpc.aio
 from dataclasses import dataclass, field
 from typing import Any, AsyncGenerator, Callable, Optional, Sequence, Union
 
@@ -614,6 +618,109 @@ class _Function(_Object, type_prefix="fu"):
 # ---------------------------------------------------------------------------
 
 
+async def _flush_coalesced_batch(
+    client: _Client,
+    requests: list,
+    *,
+    batch_call,
+    batch_request,
+    single_sends,
+    unsupported_flag: str,
+    empty_response_ok,
+    batch_metadata: Optional[list] = None,
+) -> list:
+    """Shared flush for the coalesced submit planes (docs/DISPATCH.md):
+    one batch RPC for the window; per-item degradation ONLY on errors that
+    guarantee the batch executed nothing — UNIMPLEMENTED (legacy server,
+    remembered client-wide) and NOT_FOUND (the server validates every
+    sub-request before executing any). Anything else (transport loss after
+    the retry budget, INTERNAL) may have committed server-side, so it
+    propagates to every waiter instead of silently re-dispatching the
+    window. Per-item not-found arrives as an EMPTY sub-response (the server
+    never aborts after partial execution) and is raised on that waiter
+    alone."""
+    from .observability.catalog import FASTPATH_FALLBACKS
+
+    resend = True
+    if len(requests) > 1 and not getattr(client, unsupported_flag, False):
+        resend = False
+        try:
+            resp = await retry_transient_errors(batch_call, batch_request, metadata=batch_metadata)
+            return [
+                r if empty_response_ok(r) else NotFoundError("function not found (removed mid-dispatch)")
+                for r in resp.responses
+            ]
+        except grpc.aio.AioRpcError as exc:
+            if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
+                setattr(client, unsupported_flag, True)
+                FASTPATH_FALLBACKS.inc(rung="batch", reason="unimplemented")
+                resend = True
+            elif exc.code() == grpc.StatusCode.NOT_FOUND:
+                # upfront validation abort: nothing executed — safe to
+                # re-send per item so only the stale caller fails
+                FASTPATH_FALLBACKS.inc(rung="batch", reason="validation")
+                resend = True
+            else:
+                raise
+        except NotFoundError:
+            # retry_transient_errors converts NOT_FOUND: the server's upfront
+            # validation aborted BEFORE executing anything — per-item resend
+            # is safe and isolates the stale caller
+            FASTPATH_FALLBACKS.inc(rung="batch", reason="validation")
+            resend = True
+    assert resend  # every surviving path re-sends per item
+    # per-item sends with per-item outcomes: one bad sub-request must fail
+    # ITS caller only — returned exceptions are raised on the matching
+    # waiter by the MicroBatcher
+    return await asyncio.gather(*single_sends(), return_exceptions=True)
+
+
+async def _flush_function_maps(client: _Client, requests: list) -> list:
+    """Coalesced FunctionMap flush — see _flush_coalesced_batch."""
+    stub = client.stub
+    return await _flush_coalesced_batch(
+        client,
+        requests,
+        batch_call=stub.FunctionMapBatch,
+        batch_request=api_pb2.FunctionMapBatchRequest(requests=requests),
+        single_sends=lambda: (retry_transient_errors(stub.FunctionMap, r) for r in requests),
+        unsupported_flag="_map_batch_unsupported",
+        empty_response_ok=lambda r: bool(r.function_call_id),
+    )
+
+
+async def _submit_function_map(client: _Client, request: api_pb2.FunctionMapRequest) -> api_pb2.FunctionMapResponse:
+    """Submit one FunctionMap through the client's coalescing window, or
+    directly when coalescing is disabled (MODAL_TPU_DISPATCH_COALESCE=0)."""
+    from ._utils.coalescer import coalescing_enabled
+
+    if not coalescing_enabled():
+        return await retry_transient_errors(client.stub.FunctionMap, request)
+    batcher = client._batchers.get(
+        "FunctionMap", lambda reqs: _flush_function_maps(client, reqs)
+    )
+    return await batcher.submit(request)
+
+
+async def _flush_attempt_starts(client: _Client, stub, requests: list) -> list:
+    """Coalesced AttemptStart flush on the input plane — see
+    _flush_coalesced_batch. A tokenless sub-response means the function
+    vanished mid-dispatch (per-item not-found)."""
+    metadata = await client.get_input_plane_metadata()
+    return await _flush_coalesced_batch(
+        client,
+        requests,
+        batch_call=stub.AttemptStartBatch,
+        batch_request=api_pb2.AttemptStartBatchRequest(requests=requests),
+        batch_metadata=metadata,
+        single_sends=lambda: (
+            retry_transient_errors(stub.AttemptStart, r, metadata=metadata) for r in requests
+        ),
+        unsupported_flag="_attempt_batch_unsupported",
+        empty_response_ok=lambda r: bool(r.attempt_token),
+    )
+
+
 async def _create_input(
     args: tuple,
     kwargs: dict,
@@ -690,6 +797,25 @@ async def _process_result(result: api_pb2.GenericResult, data_format: int, stub,
             )
 
 
+def _stream_outputs_enabled() -> bool:
+    return os.environ.get("MODAL_TPU_STREAM_OUTPUTS", "1") not in ("0", "false", "no")
+
+
+async def _close_stream_call(call: Any) -> None:
+    """Release a server-streaming outputs call: gRPC calls cancel, in-process
+    async generators aclose. A leaked stream would park a waiter on the
+    server's output condition forever."""
+    try:
+        call.cancel()
+    except AttributeError:
+        try:
+            await call.aclose()
+        except BaseException:  # noqa: BLE001 — best-effort release
+            pass
+    except BaseException:  # noqa: BLE001
+        pass
+
+
 class _Invocation:
     """One function call's client-side state machine (reference
     _Invocation, _functions.py:122)."""
@@ -699,6 +825,11 @@ class _Invocation:
         self.client = client
         self.function_call_id = function_call_id
         self.input_id = input_id
+        # push-streamed output delivery (docs/DISPATCH.md): tried first, and
+        # permanently downgraded to the unary poll rung for this invocation
+        # the first time the stream path proves unusable (legacy server,
+        # chaos reset, transport loss)
+        self._stream_broken = False
 
     @staticmethod
     async def create(
@@ -724,14 +855,125 @@ class _Invocation:
             pipelined_inputs=[item],
             invocation_type=invocation_type,
         )
-        response = await retry_transient_errors(stub.FunctionMap, request)
+        # coalesced dispatch: concurrent creates in one window share one RPC
+        response = await _submit_function_map(client, request)
         input_id = response.pipelined_inputs[0].input_id if response.pipelined_inputs else None
         return _Invocation(stub, response.function_call_id, client, input_id)
+
+    async def _pop_outputs_stream(
+        self, timeout: Optional[float], clear_on_success: bool, last_entry_id: str
+    ) -> api_pb2.FunctionGetOutputsResponse:
+        """Streaming rung: ONE keep-alive FunctionStreamOutputs RPC delivers
+        the output the instant the server's _append_output fires — no poll
+        re-issues, no empty windows. Raises on any stream-level failure; the
+        caller downgrades to the poll rung."""
+        from .observability import tracing
+        from .observability.catalog import OUTPUT_STREAM_EVENTS
+
+        # ALWAYS cursor reads (clear_on_success=False) on the stream rung:
+        # consuming server-side before the client has the bytes would lose
+        # the output to a reset/cancel landing in the delivery window (the
+        # caller would then wait forever on an advanced consumption cursor).
+        # Cursor reads are loss-free under resets; a post-crash re-delivery
+        # of an already-taken output is harmless to the single waiter.
+        request = api_pb2.FunctionGetOutputsRequest(
+            function_call_id=self.function_call_id,
+            timeout=OUTPUTS_TIMEOUT,
+            last_entry_id=last_entry_id,
+            max_values=1,
+            clear_on_success=False,
+            requested_at=time.time(),
+        )
+        t0 = time.monotonic()
+        stream = self.stub.FunctionStreamOutputs(request)
+        OUTPUT_STREAM_EVENTS.inc(event="open")
+        t_span = time.time()
+        ctx = tracing.current_context()
+        last_empty = None
+        try:
+            it = stream.__aiter__()
+            while True:
+                remaining = None if timeout is None else timeout - (time.monotonic() - t0)
+                if remaining is not None and remaining <= 0:
+                    return last_empty or api_pb2.FunctionGetOutputsResponse(
+                        outputs=[], last_entry_id=last_entry_id
+                    )
+                try:
+                    if remaining is None:
+                        response = await it.__anext__()
+                    else:
+                        response = await asyncio.wait_for(it.__anext__(), remaining)
+                except asyncio.TimeoutError:
+                    return last_empty or api_pb2.FunctionGetOutputsResponse(
+                        outputs=[], last_entry_id=last_entry_id
+                    )
+                except StopAsyncIteration:
+                    # server closed a stream we still needed: broken rung
+                    raise grpc.aio.AioRpcError(
+                        grpc.StatusCode.UNAVAILABLE,
+                        grpc.aio.Metadata(),
+                        grpc.aio.Metadata(),
+                        details="output stream ended early",
+                    ) from None
+                if response.outputs:
+                    OUTPUT_STREAM_EVENTS.inc(event="batch")
+                    return response
+                OUTPUT_STREAM_EVENTS.inc(event="keepalive")
+                last_empty = response
+        finally:
+            await _close_stream_call(stream)
+            if ctx is not None:
+                # the streaming wait is the output_deliver segment
+                # (critical_path.py maps client.stream_outputs there)
+                tracing.record_span(
+                    "client.stream_outputs",
+                    start=t_span,
+                    end=time.time(),
+                    parent=ctx,
+                    attrs={"function_call_id": self.function_call_id},
+                )
 
     async def pop_function_call_outputs(
         self, timeout: Optional[float], clear_on_success: bool, last_entry_id: str = ""
     ) -> api_pb2.FunctionGetOutputsResponse:
         t0 = time.monotonic()
+        # streaming serves the blocking waits; instant/sub-second checks
+        # (run_generator's "did the call end?" probe, short .get timeouts)
+        # keep the unary poll — a stream open/teardown per probe would cost
+        # more than the poll it replaces. UNIMPLEMENTED is remembered
+        # client-wide so a legacy server doesn't cost a doomed stream-open
+        # per invocation.
+        if (
+            _stream_outputs_enabled()
+            and not self._stream_broken
+            and not getattr(self.client, "_stream_outputs_unsupported", False)
+            and (timeout is None or timeout >= 1.0)
+        ):
+            try:
+                return await self._pop_outputs_stream(timeout, clear_on_success, last_entry_id)
+            except grpc.aio.AioRpcError as exc:
+                code = exc.code()
+                if code == grpc.StatusCode.NOT_FOUND:
+                    raise NotFoundError(exc.details()) from None
+                if code == grpc.StatusCode.UNAUTHENTICATED:
+                    from .exception import AuthError
+
+                    raise AuthError(exc.details()) from None
+                # anything else — UNIMPLEMENTED (legacy server), chaos
+                # UNAVAILABLE, transport loss — downgrades this invocation to
+                # the poll rung; the call still completes exactly-once there
+                from .observability.catalog import OUTPUT_STREAM_EVENTS
+
+                self._stream_broken = True
+                if code == grpc.StatusCode.UNIMPLEMENTED:
+                    self.client._stream_outputs_unsupported = True
+                    OUTPUT_STREAM_EVENTS.inc(event="fallback")
+                else:
+                    OUTPUT_STREAM_EVENTS.inc(event="reset")
+                logger.debug(f"output stream broke ({code}); polling instead")
+        # t0 predates the stream attempt: time already spent streaming counts
+        # against the caller's timeout — a reset mid-wait must not double the
+        # budget
         while True:
             remaining = None if timeout is None else timeout - (time.monotonic() - t0)
             poll_window = OUTPUTS_TIMEOUT if remaining is None else max(0.0, min(remaining, OUTPUTS_TIMEOUT))
@@ -753,6 +995,16 @@ class _Invocation:
                 return response
             if timeout is not None and (time.monotonic() - t0) >= timeout:
                 return response
+            if poll_window < 1.0:
+                # jittered backoff for sub-second windows: as `timeout`
+                # runs down the window shrinks toward 0 and the server
+                # returns instantly — without a pause the tail of the
+                # deadline becomes a hot re-issue loop (ISSUE 8 satellite)
+                remaining = None if timeout is None else timeout - (time.monotonic() - t0)
+                pause = random.uniform(0.02, 0.1)
+                if remaining is not None:
+                    pause = min(pause, max(0.0, remaining))
+                await asyncio.sleep(pause)
             last_entry_id = response.last_entry_id or last_entry_id
 
     async def run_function(self) -> Any:
@@ -861,12 +1113,17 @@ class _InputPlaneInvocation:
             method_name=method_name or function._use_method_name,
             data_format=function._data_format,
         )
-        metadata = await client.get_input_plane_metadata()
-        response = await retry_transient_errors(
-            stub.AttemptStart,
-            api_pb2.AttemptStartRequest(function_id=function.object_id, input=item),
-            metadata=metadata,
-        )
+        from ._utils.coalescer import coalescing_enabled
+
+        request = api_pb2.AttemptStartRequest(function_id=function.object_id, input=item)
+        if coalescing_enabled():
+            batcher = client._batchers.get(
+                "AttemptStart", lambda reqs: _flush_attempt_starts(client, stub, reqs)
+            )
+            response = await batcher.submit(request)
+        else:
+            metadata = await client.get_input_plane_metadata()
+            response = await retry_transient_errors(stub.AttemptStart, request, metadata=metadata)
         return _InputPlaneInvocation(
             stub, response.attempt_token, client, item, function.object_id, response.retry_policy
         )

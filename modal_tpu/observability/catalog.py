@@ -209,6 +209,33 @@ IDEMPOTENT_REPLAYS = REGISTRY.counter(
     ("method",),
 )
 
+# -- dispatch fast path (ISSUE 8; _utils/local_transport.py,
+# _utils/coalescer.py, docs/DISPATCH.md) --------------------------------------
+
+FASTPATH_CALLS = REGISTRY.counter(
+    "modal_tpu_fastpath_calls_total",
+    "RPCs by the transport rung that served them (inproc | uds | tcp).",
+    ("transport",),
+)
+FASTPATH_FALLBACKS = REGISTRY.counter(
+    "modal_tpu_fastpath_fallbacks_total",
+    "Fast-path rungs abandoned mid-flight, by rung and reason "
+    "(e.g. uds/socket_gone, stream/reset, batch/unimplemented).",
+    ("rung", "reason"),
+)
+DISPATCH_BATCH_OCCUPANCY = REGISTRY.histogram(
+    "modal_tpu_dispatch_batch_occupancy",
+    "Items per coalesced scheduling RPC flush (submit/claim/publish planes).",
+    ("rpc",),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
+OUTPUT_STREAM_EVENTS = REGISTRY.counter(
+    "modal_tpu_output_stream_events_total",
+    "Push-streamed output delivery lifecycle (open | batch | keepalive | "
+    "reconnect | reset | fallback).",
+    ("event",),
+)
+
 # -- dispatch attribution + profiling (ISSUE 7; observability/critical_path.py,
 # observability/profiler.py, docs/OBSERVABILITY.md) ---------------------------
 
@@ -300,6 +327,8 @@ SPAN_CATALOG: dict[str, str] = {
     "client.deserialize": "client-side result decode (+ blob fetch for spilled results)",
     "client.prepare": "SDK prep around invocation create: stub/token setup, retry wrapper",
     "client.await_output": "SDK output-wait loop around the GetOutputs/AttemptAwait polls",
+    "client.stream_outputs": "push-streamed output wait (FunctionStreamOutputs keep-alive rung)",
+    "dispatch.coalesce": "coalescing window: enqueue→flush wait inside a MicroBatcher",
     "rpc.client.*": "client-observed unary RPC (interceptor, _utils/grpc_utils.py)",
     "rpc.server.*": "server handler span for a traced caller (proto/rpc.py)",
     "scheduler.queue_wait": "enqueue→claim wait, recorded retroactively at claim",

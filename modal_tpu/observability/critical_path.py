@@ -47,8 +47,15 @@ SEGMENT_RULES: list[tuple[str, str, int]] = [
     ("recovery.*", "recovery", 38),
     ("rpc.server.*", "rpc.server", 30),
     ("rpc.client.FunctionGetOutputs", "output_deliver", 20),
+    ("rpc.client.FunctionStreamOutputs", "output_deliver", 20),
     ("rpc.client.AttemptAwait", "output_deliver", 20),
     ("rpc.client.MapAwait", "output_deliver", 20),
+    # push-streamed delivery (ISSUE 8): the client-side wait on the
+    # keep-alive outputs stream — same segment as the poll it replaced
+    ("client.stream_outputs", "output_deliver", 20),
+    # the coalescing window's enqueue→flush wait (_utils/coalescer.py):
+    # named so batching delay shows up as itself, not as gap/prepare
+    ("dispatch.coalesce", "coalesce", 28),
     ("rpc.client.*", "rpc.client", 25),
     # SDK residue around the RPCs: stub/token prep and the output-wait loop;
     # lowest priorities, so they claim only what nothing else explains
@@ -252,9 +259,9 @@ def aggregate_attributions(per_trace: list[dict]) -> dict:
 
 SEGMENT_ORDER = [
     "queue_wait", "place", "handoff", "image.build", "container.boot",
-    "container.imports", "container.enter_hooks", "serialize", "client.prepare",
-    "rpc.client", "rpc.server", "recovery", "input_deliver", "user.execute",
-    "output_deliver", "deserialize", GAP,
+    "container.imports", "container.enter_hooks", "serialize", "coalesce",
+    "client.prepare", "rpc.client", "rpc.server", "recovery", "input_deliver",
+    "user.execute", "output_deliver", "deserialize", GAP,
 ]
 
 

@@ -30,6 +30,7 @@ import time
 import typing
 
 import grpc
+import grpc.aio
 from typing import Any, AsyncGenerator, AsyncIterable, Iterable, Optional, Union
 
 from ._utils.async_utils import TaskContext, aclosing, queue_batch_iterator, synchronizer, sync_or_async_iter
@@ -58,11 +59,19 @@ _RESOURCE_EXHAUSTED = [grpc.StatusCode.RESOURCE_EXHAUSTED]
 
 class _ControlPlaneMapTransport:
     """Default map wire path: FunctionMap / FunctionPutInputs /
-    FunctionRetryInputs / FunctionGetOutputs on the control plane."""
+    FunctionRetryInputs on the control plane; outputs arrive on ONE
+    keep-alive FunctionStreamOutputs stream (pushed the instant the server
+    appends them), degrading to the FunctionGetOutputs poll after repeated
+    stream failures (docs/DISPATCH.md)."""
+
+    MAX_STREAM_RESETS = 3
 
     def __init__(self, client, function_id: str):
         self.stub = client.stub
         self.function_id = function_id
+        self._stream = None  # live FunctionStreamOutputs call
+        self._stream_iter = None
+        self._stream_resets = 0
 
     async def create_call(self, return_exceptions: bool) -> str:
         resp = await retry_transient_errors(
@@ -111,7 +120,53 @@ class _ControlPlaneMapTransport:
     def discard(self, idx: int) -> None:
         pass  # no per-input client state on the control plane
 
+    def _stream_enabled(self) -> bool:
+        from .functions import _stream_outputs_enabled
+
+        return _stream_outputs_enabled() and self._stream_resets < self.MAX_STREAM_RESETS
+
+    async def close(self) -> None:
+        if self._stream is not None:
+            from .functions import _close_stream_call
+
+            await _close_stream_call(self._stream)
+            self._stream = self._stream_iter = None
+
     async def get_outputs(self, call_id: str, last_entry_id: str) -> tuple[list, str]:
+        from .observability.catalog import OUTPUT_STREAM_EVENTS
+
+        if self._stream_enabled():
+            try:
+                if self._stream_iter is None:
+                    self._stream = self.stub.FunctionStreamOutputs(
+                        api_pb2.FunctionGetOutputsRequest(
+                            function_call_id=call_id,
+                            timeout=OUTPUTS_TIMEOUT,
+                            last_entry_id=last_entry_id,
+                            max_values=0,
+                            clear_on_success=False,
+                            requested_at=time.time(),
+                        )
+                    )
+                    self._stream_iter = self._stream.__aiter__()
+                    OUTPUT_STREAM_EVENTS.inc(
+                        event="open" if self._stream_resets == 0 else "reconnect"
+                    )
+                resp = await self._stream_iter.__anext__()
+                OUTPUT_STREAM_EVENTS.inc(event="batch" if resp.outputs else "keepalive")
+                return list(resp.outputs), resp.last_entry_id or last_entry_id
+            except (grpc.aio.AioRpcError, StopAsyncIteration) as exc:
+                # NOT_FOUND is real (call gone) — let the poll rung raise it
+                # through the standard converter; everything else counts a
+                # reset and reconnects (poll takes over past the budget)
+                await self.close()
+                self._stream_resets += 1
+                OUTPUT_STREAM_EVENTS.inc(event="reset")
+                code = exc.code() if isinstance(exc, grpc.aio.AioRpcError) else None
+                if code == grpc.StatusCode.UNIMPLEMENTED:
+                    self._stream_resets = self.MAX_STREAM_RESETS  # legacy server
+                    OUTPUT_STREAM_EVENTS.inc(event="fallback")
+                logger.debug(f"map output stream reset ({code}); rung retry/poll")
         resp = await retry_transient_errors(
             self.stub.FunctionGetOutputs,
             api_pb2.FunctionGetOutputsRequest(
@@ -188,6 +243,9 @@ class _InputPlaneMapTransport:
         # the map bounded by the outstanding window, not total map size
         self.token_by_idx.pop(idx, None)
 
+    async def close(self) -> None:
+        pass  # MapAwait is unary; nothing persistent to release
+
     async def get_outputs(self, call_id: str, last_entry_id: str) -> tuple[list, str]:
         metadata = await self.client.get_input_plane_metadata()
         resp = await retry_transient_errors(
@@ -255,10 +313,38 @@ async def _map_invocation(
         await transport.put_batch(function_call_id, batch)
 
     async def pump_inputs() -> None:
+        """Submit side of the dispatch coalescing window (ISSUE 8,
+        docs/DISPATCH.md): every input rides a per-map MicroBatcher (~1 ms
+        linger, ≤MAP_INPUT_BATCH_SIZE per flush), so submission pipelines
+        with the generator instead of stalling on each flush RPC, and a
+        1k-input map issues a bounded number of PutInputs regardless of how
+        the producer trickles. MODAL_TPU_DISPATCH_COALESCE=0 restores the
+        legacy flush-every-100 path."""
         nonlocal inputs_sent
+        from ._utils.coalescer import MicroBatcher, coalescing_enabled
         from .functions import _create_input
 
+        async def _flush_items(items: list[api_pb2.FunctionPutInputsItem]) -> list:
+            nonlocal inputs_sent
+            await _put_batch(items)
+            inputs_sent += len(items)
+            return [None] * len(items)
+
+        batcher = (
+            MicroBatcher(
+                _flush_items,
+                max_batch=MAP_INPUT_BATCH_SIZE,
+                window_s=0.001,
+                label="FunctionPutInputs",
+            )
+            if coalescing_enabled()
+            else None
+        )
         batch: list[api_pb2.FunctionPutInputsItem] = []
+        # in-flight coalesced submits: awaited in windows so a flush error
+        # surfaces promptly and a million-input map never holds a million
+        # pending futures
+        submits: list[asyncio.Task] = []
 
         async def _flush() -> None:
             nonlocal batch, inputs_sent
@@ -267,6 +353,10 @@ async def _map_invocation(
             await _put_batch(batch)
             inputs_sent += len(batch)
             batch = []
+
+        async def _reap_submits(limit: int) -> None:
+            while len(submits) > limit:
+                await submits.pop(0)
 
         idx = 0
         try:
@@ -282,18 +372,29 @@ async def _map_invocation(
                     )
                     nbytes = len(item.input.args) if item.input.WhichOneof("args_oneof") == "args" else 64
                     if budget is not None:
-                        if batch and budget.would_block(nbytes):
-                            # flush first so inflight inputs can produce
-                            # outputs and release budget — an unflushed
-                            # batch can't drain
+                        if batcher is None and batch and budget.would_block(nbytes):
+                            # legacy path only: flush first so inflight
+                            # inputs can produce outputs and release budget —
+                            # an unflushed local batch can't drain (the
+                            # batcher's background drainer flushes on its
+                            # own, so the coalesced path can't deadlock here)
                             await _flush()
                         await budget.acquire(nbytes)
                         unfinished[idx] = (item, nbytes)
-                    batch.append(item)
+                    if batcher is not None:
+                        submits.append(asyncio.ensure_future(batcher.submit(item)))
+                        await _reap_submits(4 * MAP_INPUT_BATCH_SIZE)
+                    else:
+                        batch.append(item)
+                        if len(batch) >= MAP_INPUT_BATCH_SIZE:
+                            await _flush()
                     idx += 1
-                    if len(batch) >= MAP_INPUT_BATCH_SIZE:
-                        await _flush()
             await _flush()
+            await _reap_submits(0)
+        except BaseException:
+            for t in submits:
+                t.cancel()
+            raise
         finally:
             # Always unblock the poll loop — on pump failure it drains what
             # was sent, then `await pump_task` surfaces the error instead of
@@ -430,6 +531,7 @@ async def _map_invocation(
         finally:
             checker_task.cancel()
             retry_task.cancel()
+            await transport.close()  # release the output stream, if any
         # surface pump errors (e.g. serialization failures)
         await pump_task
 

@@ -49,6 +49,11 @@ _OVERRIDES: dict[str, tuple[Optional[str], Optional[str], Arity]] = {
     "AppGetLogs": (None, "TaskLogsBatch", Arity.UNARY_STREAM),
     "FunctionGetCurrentStats": (None, "FunctionStats", Arity.UNARY_UNARY),
     "FunctionCallGetData": (None, "DataChunk", Arity.UNARY_STREAM),
+    # push-streamed output delivery (docs/DISPATCH.md): same request/response
+    # wire shape as the FunctionGetOutputs poll, but server-streaming — a
+    # batch is pushed the instant _append_output fires, with periodic empty
+    # keep-alives; the poll path stays as the fallback rung
+    "FunctionStreamOutputs": ("FunctionGetOutputsRequest", "FunctionGetOutputsResponse", Arity.UNARY_STREAM),
     "SandboxGetLogs": (None, "TaskLogsBatch", Arity.UNARY_STREAM),
     "SandboxSnapshotFs": (None, "SandboxSnapshotFsRequestResponse", Arity.UNARY_UNARY),
     "ContainerExecGetOutput": (None, "RuntimeOutputBatch", Arity.UNARY_STREAM),
@@ -84,10 +89,12 @@ _RPC_NAMES = [
     "FunctionGetWebUrl",
     "FunctionGetCurrentStats",
     "FunctionMap",
+    "FunctionMapBatch",
     "FunctionPutInputs",
     "FunctionRetryInputs",
     "MapCheckInputs",
     "FunctionGetOutputs",
+    "FunctionStreamOutputs",
     "FunctionCallGetData",
     "FunctionCallPutData",
     "FunctionCallList",
@@ -193,6 +200,7 @@ _RPC_NAMES = [
     # parallel_map.py:620)
     "AuthTokenGet",
     "AttemptStart",
+    "AttemptStartBatch",
     "AttemptAwait",
     "AttemptRetry",
     "MapStartOrContinue",
@@ -449,6 +457,29 @@ def build_generic_handler(servicer: Any) -> "grpc.GenericRpcHandler":
     on `servicer`. Unimplemented methods return UNIMPLEMENTED (so partial
     servicers — e.g. a worker-only control plane — are fine)."""
     return _build_handler(servicer, RPCS, SERVICE_NAME)
+
+
+def build_local_handlers(servicer: Any) -> dict[str, tuple["RPCMethod", Any]]:
+    """The in-process fast-path's handler table (_utils/local_transport.py):
+    the SAME wrapper pipeline the gRPC server gets — idempotency dedupe,
+    tracing/metrics instrumentation, chaos (when `servicer` is the chaos
+    proxy) — minus the wire. One pipeline, two transports: a call served
+    in-process is indistinguishable from one served over the socket except
+    for where the bytes travel."""
+    handlers: dict[str, tuple[RPCMethod, Any]] = {}
+    for method in RPCS.values():
+        impl = getattr(servicer, method.name, None)
+        if impl is None:
+            continue
+        if method.arity == Arity.UNARY_UNARY:
+            handlers[method.name] = (
+                method,
+                _instrument_unary(method.name, _maybe_dedupe(servicer, method, impl)),
+            )
+        elif method.arity == Arity.UNARY_STREAM:
+            handlers[method.name] = (method, _instrument_stream(method.name, impl))
+        # stream-request arities are not served on the local fast path
+    return handlers
 
 
 def build_router_handler(servicer: Any) -> "grpc.GenericRpcHandler":

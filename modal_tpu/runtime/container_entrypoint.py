@@ -35,7 +35,7 @@ from ..observability import tracing
 tracing.maybe_configure_from_env()
 
 from ..client import _Client
-from ..config import config, logger
+from ..config import config, logger, tune_switch_interval
 from ..exception import ExecutionError
 from ..proto import api_pb2
 from .._utils.grpc_utils import retry_transient_errors
@@ -413,6 +413,10 @@ async def main_async() -> int:
     config.override_locally("task_id", task_id)
     execution_context._set_container_process()
     setup_compilation_cache()
+    # dispatch-critical process: shrink the GIL switch interval — every input
+    # bounces serving loop ↔ main-thread executor, and each handoff can stall
+    # a full default 5 ms interval (ISSUE 8, docs/DISPATCH.md)
+    tune_switch_interval()
 
     client = _Client(
         container_args.server_url or config["server_url"], api_pb2.CLIENT_TYPE_CONTAINER

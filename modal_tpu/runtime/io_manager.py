@@ -107,6 +107,9 @@ class ContainerIOManager:
         self.input_slots = ConcurrencySemaphore(max_conc)
         self.average_call_time = 0.0
         self._calls_completed = 0
+        # coalesced output publication (_utils/coalescer.py), created lazily
+        # on the serving loop
+        self._out_batcher = None
         ContainerIOManager._singleton = self
 
     @classmethod
@@ -180,22 +183,90 @@ class ContainerIOManager:
 
     # -- input loop ---------------------------------------------------------
 
+    async def _assemble_context(self, items: list) -> IOContext:
+        """Deserialize a claimed item group (blob-aware) into one IOContext."""
+        # delivery-span anchor: the server's claim stamp when carried
+        # (claim→execute is exactly the delivery hop); a server that
+        # predates the field falls back to response arrival — never
+        # the poll's ISSUE time, which in steady state predates the
+        # call itself and would swallow the client's prep/RPC window
+        claim_stamps = [i.claimed_at for i in items if i.claimed_at > 0]
+        fetched_at = min(claim_stamps) if claim_stamps else time.time()
+        ctx_inputs: list[tuple[tuple, dict]] = []
+        method_name = ""
+        ctx_format = api_pb2.DATA_FORMAT_PICKLE
+        for item in items:
+            raw = item.input.args
+            if item.input.args_blob_id:
+                from .._utils.blob_utils import blob_download
+
+                # large args spill to disk and arrive as an
+                # mmap-backed view: the container never holds the
+                # serialized payload AND its deserialized tensors as
+                # two anonymous-RSS copies (tensors alias the mmap)
+                raw = await blob_download(item.input.args_blob_id, self.stub)
+            fmt = item.input.data_format or api_pb2.DATA_FORMAT_PICKLE
+            if not raw:
+                args, kwargs = (), {}
+            elif fmt == api_pb2.DATA_FORMAT_CBOR:
+                # cross-language convention: [args array, kwargs map]
+                from ..serialization import deserialize_data_format
+
+                payload = deserialize_data_format(raw, fmt, self.client)
+                args, kwargs = tuple(payload[0]), dict(payload[1])
+            else:
+                args, kwargs = deserialize(raw, self.client)
+            ctx_inputs.append((args, kwargs))
+            method_name = item.input.method_name or method_name
+            ctx_format = fmt
+        ctx = IOContext(
+            input_ids=[i.input_id for i in items],
+            function_call_ids=[i.function_call_id for i in items],
+            idxs=[i.idx for i in items],
+            retry_counts=[i.retry_count for i in items],
+            inputs=ctx_inputs,
+            method_name=method_name,
+            data_format=ctx_format,
+            fetched_at=fetched_at,
+        )
+        for item in items:
+            if item.resume_token:
+                self.delivered_resume_tokens[item.input_id] = item.resume_token
+            if item.trace_context:
+                self.input_trace_contexts[item.input_id] = item.trace_context
+        self.current_input_ids |= set(ctx.input_ids)
+        return ctx
+
     async def generate_inputs(self) -> AsyncGenerator[IOContext, None]:
         """The hot loop: acquire a slot → FunctionGetInputs (long-poll) →
         assemble IOContext (reference _generate_inputs,
         container_io_manager.py:788-843). Exits on kill_switch or after
-        scaledown_window idle."""
+        scaledown_window idle.
+
+        Coalesced claim (ISSUE 8, docs/DISPATCH.md): when this container has
+        N free concurrency slots, ONE long-poll asks for up to N inputs and
+        splits the response into per-input IOContexts — N in-flight inputs
+        cost one claim RPC per turnaround instead of N. @batched functions
+        keep their batch-assembly semantics (one ctx per fetch)."""
+        from .._utils.coalescer import coalescing_enabled
+
         scaledown = self.function_def.autoscaler_settings.scaledown_window or 60
         batch_max = self.function_def.batch_max_size or 1
+        is_batched = (self.function_def.batch_max_size or 0) > 1
         idle_since = time.monotonic()
         while not self.terminate:
             await self.input_slots.acquire()
-            slot_held = True
+            slots_held = 1
             try:
+                if not is_batched and coalescing_enabled():
+                    # claim-coalescing: soak up every currently-free slot so
+                    # the server can hand us a whole group in one response
+                    while slots_held < self.input_slots.value and self.input_slots.try_acquire():
+                        slots_held += 1
                 request = api_pb2.FunctionGetInputsRequest(
                     function_id="",  # filled below; def carries no id — use env
                     task_id=self.task_id,
-                    max_values=batch_max,
+                    max_values=batch_max if is_batched else slots_held,
                     average_call_time=self.average_call_time,
                     input_concurrency=self.input_slots.value,
                     batch_max_size=self.function_def.batch_max_size,
@@ -222,68 +293,78 @@ class ContainerIOManager:
                         return
                     continue
                 idle_since = time.monotonic()
-                # delivery-span anchor: the server's claim stamp when carried
-                # (claim→execute is exactly the delivery hop); a server that
-                # predates the field falls back to response arrival — never
-                # the poll's ISSUE time, which in steady state predates the
-                # call itself and would swallow the client's prep/RPC window
-                claim_stamps = [i.claimed_at for i in items if i.claimed_at > 0]
-                fetched_at = min(claim_stamps) if claim_stamps else time.time()
-                # deserialize up front (blob-aware)
-                ctx_inputs: list[tuple[tuple, dict]] = []
-                method_name = ""
-                ctx_format = api_pb2.DATA_FORMAT_PICKLE
-                for item in items:
-                    raw = item.input.args
-                    if item.input.args_blob_id:
-                        from .._utils.blob_utils import blob_download
-
-                        # large args spill to disk and arrive as an
-                        # mmap-backed view: the container never holds the
-                        # serialized payload AND its deserialized tensors as
-                        # two anonymous-RSS copies (tensors alias the mmap)
-                        raw = await blob_download(item.input.args_blob_id, self.stub)
-                    fmt = item.input.data_format or api_pb2.DATA_FORMAT_PICKLE
-                    if not raw:
-                        args, kwargs = (), {}
-                    elif fmt == api_pb2.DATA_FORMAT_CBOR:
-                        # cross-language convention: [args array, kwargs map]
-                        from ..serialization import deserialize_data_format
-
-                        payload = deserialize_data_format(raw, fmt, self.client)
-                        args, kwargs = tuple(payload[0]), dict(payload[1])
-                    else:
-                        args, kwargs = deserialize(raw, self.client)
-                    ctx_inputs.append((args, kwargs))
-                    method_name = item.input.method_name or method_name
-                    ctx_format = fmt
-                ctx = IOContext(
-                    input_ids=[i.input_id for i in items],
-                    function_call_ids=[i.function_call_id for i in items],
-                    idxs=[i.idx for i in items],
-                    retry_counts=[i.retry_count for i in items],
-                    inputs=ctx_inputs,
-                    method_name=method_name,
-                    data_format=ctx_format,
-                    fetched_at=fetched_at,
-                )
-                for item in items:
-                    if item.resume_token:
-                        self.delivered_resume_tokens[item.input_id] = item.resume_token
-                    if item.trace_context:
-                        self.input_trace_contexts[item.input_id] = item.trace_context
-                self.current_input_ids |= set(ctx.input_ids)
-                slot_held = False  # transferred to the runner
-                yield ctx
+                if is_batched:
+                    groups = [items]  # one ctx: the @batched user call
+                else:
+                    groups = [[item] for item in items]  # one ctx per input
+                for group in groups:
+                    try:
+                        ctx = await self._assemble_context(group)
+                    except Exception as exc:  # noqa: BLE001 — poison input
+                        # a coalesced claim must not strand SIBLING inputs
+                        # behind one undeserializable payload: answer THIS
+                        # group with a failure result and keep going (the
+                        # per-poll claim shape failed only itself too)
+                        logger.warning(
+                            f"input assembly failed for {[i.input_id for i in group]}: {exc}"
+                        )
+                        await self._fail_assembly(group, exc)
+                        self.input_slots.release()
+                        slots_held -= 1
+                        continue
+                    slots_held -= 1  # transferred to the runner
+                    yield ctx
             finally:
-                if slot_held:
+                for _ in range(max(0, slots_held)):
                     self.input_slots.release()
+                slots_held = 0
 
     _function_id: str = ""
 
+    async def _fail_assembly(self, items: list, exc: BaseException) -> None:
+        """Report an assembly (deserialize/blob-fetch) failure for one
+        claimed group as that group's result — siblings of a coalesced claim
+        proceed untouched."""
+        result = self.format_exception(exc)
+        await retry_transient_errors(
+            self.stub.FunctionPutOutputs,
+            api_pb2.FunctionPutOutputsRequest(
+                outputs=[
+                    api_pb2.FunctionPutOutputsItem(
+                        input_id=i.input_id,
+                        result=result,
+                        idx=i.idx,
+                        function_call_id=i.function_call_id,
+                        data_format=result.data_format,
+                        output_created_at=time.time(),
+                        retry_count=i.retry_count,
+                    )
+                    for i in items
+                ],
+                task_id=self.task_id,
+            ),
+            max_retries=None,
+            additional_status_codes=[],
+        )
+
     # -- outputs ------------------------------------------------------------
 
+    async def _flush_output_batch(self, items: list[api_pb2.FunctionPutOutputsItem]) -> list:
+        """One coalesced FunctionPutOutputs flush (≤ MAX_OUTPUT_BATCH_SIZE
+        items by construction). The server dedupes by (input_id, retry_count)
+        and group-commits the batch's journal records, so regrouping outputs
+        across concurrent inputs cannot double-deliver."""
+        await retry_transient_errors(
+            self.stub.FunctionPutOutputs,
+            api_pb2.FunctionPutOutputsRequest(outputs=items, task_id=self.task_id),
+            max_retries=None,
+            additional_status_codes=[],
+        )
+        return [None] * len(items)
+
     async def push_outputs(self, ctx: IOContext, results: list[api_pb2.GenericResult]) -> None:
+        from .._utils.coalescer import coalescing_enabled
+
         items = []
         for i, result in enumerate(results):
             items.append(
@@ -297,15 +378,30 @@ class ContainerIOManager:
                     retry_count=ctx.retry_counts[i],
                 )
             )
-        for start in range(0, len(items), MAX_OUTPUT_BATCH_SIZE):
-            await retry_transient_errors(
-                self.stub.FunctionPutOutputs,
-                api_pb2.FunctionPutOutputsRequest(
-                    outputs=items[start : start + MAX_OUTPUT_BATCH_SIZE], task_id=self.task_id
-                ),
-                max_retries=None,
-                additional_status_codes=[],
-            )
+        if coalescing_enabled():
+            # coalesced publication (ISSUE 8): concurrent inputs finishing
+            # within one window share one RPC. The submit still completes
+            # before the slot is released — delivery stays on the critical
+            # path, only the RPC count shrinks.
+            if self._out_batcher is None:
+                from .._utils.coalescer import MicroBatcher
+
+                self._out_batcher = MicroBatcher(
+                    self._flush_output_batch,
+                    max_batch=MAX_OUTPUT_BATCH_SIZE,
+                    label="FunctionPutOutputs",
+                )
+            await asyncio.gather(*(self._out_batcher.submit(item) for item in items))
+        else:
+            for start in range(0, len(items), MAX_OUTPUT_BATCH_SIZE):
+                await retry_transient_errors(
+                    self.stub.FunctionPutOutputs,
+                    api_pb2.FunctionPutOutputsRequest(
+                        outputs=items[start : start + MAX_OUTPUT_BATCH_SIZE], task_id=self.task_id
+                    ),
+                    max_retries=None,
+                    additional_status_codes=[],
+                )
         self.current_input_ids -= set(ctx.input_ids)
         for iid in ctx.input_ids:
             self.delivered_resume_tokens.pop(iid, None)

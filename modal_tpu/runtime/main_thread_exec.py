@@ -113,12 +113,19 @@ class MainThreadExecutor:
 
     def run_until(self, done: "Future | Any") -> None:
         """Main-thread loop: execute jobs until `done` (a concurrent Future)
-        resolves. Polling via queue timeout keeps signal delivery prompt."""
+        resolves. The loop parks in a blocking queue.get — a submitted job's
+        put() wakes it immediately, and `done` resolving enqueues a sentinel
+        via its callback, so neither arrival pays a poll interval. (The old
+        0.1 s timeout poll put an avg ~50 ms floor under every sync input's
+        start; SIGUSR1 cancellation never needed the poll — it only targets a
+        RUNNING job, and queue.get on the main thread is signal-interruptible
+        anyway.) The short timeout stays as a belt-and-suspenders backstop."""
         self._running = True
+        done.add_done_callback(lambda _f: self._queue.put(None))
         try:
             while not done.done():
                 try:
-                    job = self._queue.get(timeout=0.1)
+                    job = self._queue.get(timeout=5.0)
                 except queue.Empty:
                     continue
                 except InputCancellation:

@@ -23,6 +23,7 @@ an ops concern on top of the same service, not a code change.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from typing import Optional
 
@@ -125,6 +126,43 @@ class InputPlaneServicer:
         resp = api_pb2.AttemptStartResponse(attempt_token=self._mint_attempt(call.function_call_id, input_id))
         if fn.definition.HasField("retry_policy"):
             resp.retry_policy.CopyFrom(fn.definition.retry_policy)
+        return resp
+
+    async def AttemptStartBatch(
+        self, request: api_pb2.AttemptStartBatchRequest, context
+    ) -> api_pb2.AttemptStartBatchResponse:
+        """Coalesced unary dispatch on the input plane (_utils/coalescer.py):
+        N concurrent `.remote()`s share one RPC; each sub-request mints its
+        own call + attempt token exactly as a lone AttemptStart would, and
+        the journal group-commits the batch's records in one flush."""
+        await self._require_auth(context)
+        self._count("AttemptStartBatch")
+        # validate before executing anything: an abort mid-batch would leave
+        # a dispatched prefix the client's per-item fallback re-dispatches
+        for sub in request.requests:
+            if sub.function_id not in self.s.functions:
+                await context.abort(
+                    grpc.StatusCode.NOT_FOUND, f"function {sub.function_id} not found"
+                )
+        resp = api_pb2.AttemptStartBatchResponse()
+        with self.control._journal_group():
+            for sub in request.requests:
+                fn = self.s.functions.get(sub.function_id)
+                if fn is None:
+                    # vanished between validation and execution: answer THIS
+                    # item empty (no attempt token = not found) — the batch
+                    # must never abort after partial execution
+                    resp.responses.append(api_pb2.AttemptStartResponse())
+                    continue
+                call = self._start_call(sub.function_id, api_pb2.FUNCTION_CALL_TYPE_UNARY)
+                input_id = await self._enqueue(fn, call, sub.input)
+                one = api_pb2.AttemptStartResponse(
+                    attempt_token=self._mint_attempt(call.function_call_id, input_id)
+                )
+                if fn.definition.HasField("retry_policy"):
+                    one.retry_policy.CopyFrom(fn.definition.retry_policy)
+                resp.responses.append(one)
+                await self._notify(fn)
         return resp
 
     async def AttemptAwait(self, request: api_pb2.AttemptAwaitRequest, context) -> api_pb2.AttemptAwaitResponse:
@@ -294,6 +332,8 @@ class InputPlaneServer:
         self._server: Optional[grpc.aio.Server] = None
 
     async def start(self) -> None:
+        from .._utils import local_transport
+
         self._server = grpc.aio.server(
             options=[
                 ("grpc.max_receive_message_length", 128 * 1024 * 1024),
@@ -314,10 +354,35 @@ class InputPlaneServer:
             # the old URL lose input-plane locality but the plane stays up
             logger.warning(f"input plane port {requested} unavailable; binding ephemeral")
             self.port = self._server.add_insecure_port("127.0.0.1:0")
+        # local fast-path (docs/DISPATCH.md): UDS rung for co-located
+        # cross-process peers, advertised on ClientHello next to the TCP url
+        self.uds_path = ""
+        uds = os.path.join(self.state.state_dir, "input_plane.sock")
+        if local_transport.uds_enabled() and local_transport.usable_uds_path(uds):
+            try:
+                os.unlink(uds)
+            except FileNotFoundError:
+                pass
+            try:
+                self._server.add_insecure_port(f"unix:{uds}")
+                self.uds_path = uds
+            except Exception as exc:  # noqa: BLE001 — UDS is an optimization
+                logger.warning(f"input-plane UDS bind failed ({exc}); TCP only")
         self.state.input_plane_url = f"grpc://127.0.0.1:{self.port}"
+        self.state.input_plane_uds = self.uds_path
         await self._server.start()
+        # in-process rung for same-process clients (default local mode)
+        local_transport.register_local_server(self.state.input_plane_url, handler_target)
         logger.debug(f"input plane up at {self.state.input_plane_url}")
 
     async def stop(self) -> None:
+        from .._utils import local_transport
+
+        local_transport.unregister_local_server(self.state.input_plane_url)
+        if getattr(self, "uds_path", ""):
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
         if self._server is not None:
             await self._server.stop(grace=0.5)

@@ -37,7 +37,9 @@ power loss at a per-append fsync cost.
 
 from __future__ import annotations
 
+import asyncio
 import base64
+import contextlib
 import json
 import os
 import time
@@ -86,9 +88,11 @@ JOURNALED_RPCS = frozenset(
         "FunctionBindParams",
         "FunctionUpdateSchedulingParams",
         "FunctionMap",
+        "FunctionMapBatch",  # coalesced FunctionMaps; group-committed
         "FunctionPutInputs",
         "FunctionRetryInputs",
         "FunctionGetOutputs",  # journals consumption (clear_on_success takes)
+        "FunctionStreamOutputs",  # journals consumption, same as the poll twin
         "FunctionPutOutputs",
         "FunctionCallCancel",
         "ContainerCheckpoint",  # resume tokens survive the restart
@@ -173,6 +177,7 @@ EXEMPT_RPCS: dict[str, str] = {
 IDEMPOTENT_RPCS = frozenset(
     {
         "FunctionMap",
+        "FunctionMapBatch",
         "FunctionPutInputs",
         "FunctionRetryInputs",
         "FunctionPutOutputs",
@@ -216,6 +221,16 @@ class Journal:
         self._fh = None
         self._pending_appends: dict[str, int] = {}
         self._pending_bytes = 0
+        # group commit (ISSUE 8): inside a group() block, appends skip their
+        # per-record flush/fsync and commit once at exit — a coalesced RPC's
+        # N records cost one flush but are NEVER skipped, and the flush still
+        # happens before the handler returns, so the durability contract at
+        # the RPC boundary is unchanged (docs/RECOVERY.md). Scoped to the
+        # OPENING TASK: a concurrent handler that interleaves at one of the
+        # group body's awaits still flushes its own appends per record.
+        self._group_depth = 0
+        self._group_dirty = False
+        self._group_owner = None  # asyncio task (or None-sentinel) holding the group
         # segment name -> max seq it holds (maintained as segments roll so
         # compaction's prune decision never re-reads segment files on the
         # supervisor's event loop)
@@ -293,9 +308,16 @@ class Journal:
         payload["t"] = t
         line = json.dumps(payload, separators=(",", ":")) + "\n"
         self._fh.write(line)
-        self._fh.flush()
-        if self.fsync:
-            os.fsync(self._fh.fileno())
+        if self._group_depth > 0 and self._current_task() is self._group_owner:
+            self._group_dirty = True  # group exit commits the batch
+        else:
+            # either no group is open, or a CONCURRENT handler interleaved at
+            # one of the group body's awaits: ITS record must not ride the
+            # group's (later) commit — flush now. This also flushes any
+            # group-buffered lines already in the file buffer; harmless.
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
         self._segment_records += 1
         self._records_since_snapshot += 1
         self._note_seq()
@@ -312,6 +334,45 @@ class Journal:
 
     def records_since_snapshot(self) -> int:
         return self._records_since_snapshot
+
+    @staticmethod
+    def _current_task():
+        """The asyncio task (or None outside a loop) used to scope a group
+        to its opener — a group must never defer OTHER handlers' flushes."""
+        try:
+            return asyncio.current_task()
+        except RuntimeError:
+            return None
+
+    @contextlib.contextmanager
+    def group(self):
+        """Group-commit scope: the OPENING TASK's appends buffer their flush;
+        exit commits once. Re-entrant within that task (nested groups commit
+        at the outermost exit); appends from concurrently-interleaved tasks
+        keep their per-record flush. Segment rotation mid-group is safe —
+        close() flushes the old file handle. Exceptions still commit whatever
+        was appended: a record written must never be less durable because its
+        batch died."""
+        opener = self._current_task()
+        if self._group_depth > 0 and opener is not self._group_owner:
+            # a different task opening a group while one is held: don't
+            # entangle the scopes — this task's appends just flush per record
+            yield self
+            return
+        self._group_owner = opener
+        self._group_depth += 1
+        try:
+            yield self
+        finally:
+            self._group_depth -= 1
+            if self._group_depth == 0:
+                self._group_owner = None
+                if self._group_dirty:
+                    self._group_dirty = False
+                    if self._fh is not None:
+                        self._fh.flush()
+                        if self.fsync:
+                            os.fsync(self._fh.fileno())
 
     # -- read / replay ------------------------------------------------------
 

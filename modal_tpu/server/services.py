@@ -88,6 +88,15 @@ class ModalTPUServicer:
         if j is not None:
             j.append(t, **payload)
 
+    def _journal_group(self):
+        """Group-commit scope for coalesced handlers (journal.group()): N
+        records, one flush, committed before the RPC returns — batched
+        appends group-commit but never skip (docs/RECOVERY.md)."""
+        import contextlib
+
+        j = self.s.journal
+        return j.group() if j is not None else contextlib.nullcontext()
+
     def _append_output(self, call: FunctionCallState, item: api_pb2.FunctionGetOutputsItem) -> bool:
         """The one funnel every delivered output goes through: dedupe by
         (input_id, retry_count) so a requeued input whose dead attempt
@@ -132,6 +141,12 @@ class ModalTPUServicer:
             # build default — clients pick this up at handshake
             image_builder_version=self.s.workspace_settings.get("image_builder_version", "2026.07"),
             input_plane_url=self.s.input_plane_url,
+            # local fast-path coordinates (docs/DISPATCH.md): a client that
+            # can stat these paths is co-located and upgrades its transport;
+            # anyone else ignores them
+            uds_path=self.s.uds_path,
+            input_plane_uds_path=self.s.input_plane_uds,
+            blob_local_dir=self.s.blob_local_dir,
         )
 
     def _resolve_environment(self, name: str) -> str:
@@ -820,15 +835,44 @@ class ModalTPUServicer:
         self.s.schedule_event.set()
         return resp
 
+    async def FunctionMapBatch(self, request: api_pb2.FunctionMapBatchRequest, context) -> api_pb2.FunctionMapBatchResponse:
+        """Coalesced dispatch (ISSUE 8, _utils/coalescer.py): N unary
+        `.remote()`s submitted within one client-side window arrive as one
+        RPC. Each sub-request runs the exact FunctionMap path (own call id,
+        own journal records); the journal group-commits the batch — one
+        flush, no skipped records."""
+        # validate EVERY sub-request before executing ANY: an abort must mean
+        # "nothing happened", or the client's per-item fallback would re-run
+        # the successful prefix (double dispatch)
+        for sub in request.requests:
+            if sub.function_id not in self.s.functions:
+                await context.abort(
+                    grpc.StatusCode.NOT_FOUND, f"function {sub.function_id} not found"
+                )
+        resp = api_pb2.FunctionMapBatchResponse()
+        with self._journal_group():
+            for sub in request.requests:
+                if sub.function_id not in self.s.functions:
+                    # vanished BETWEEN validation and execution (app-stop
+                    # racing one of the loop's awaits): an abort here would
+                    # leave a dispatched prefix — answer THIS item with an
+                    # empty response (no call id = not found) instead, so the
+                    # batch never aborts after partial execution
+                    resp.responses.append(api_pb2.FunctionMapResponse())
+                    continue
+                resp.responses.append(await self.FunctionMap(sub, context))
+        return resp
+
     async def FunctionPutInputs(self, request, context) -> api_pb2.FunctionPutInputsResponse:
         fn = self.s.functions.get(request.function_id)
         call = self.s.function_calls.get(request.function_call_id)
         if fn is None or call is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "function or call not found")
         resp = api_pb2.FunctionPutInputsResponse()
-        for item in request.inputs:
-            inp = self._enqueue_input(fn, call, item)
-            resp.inputs.append(api_pb2.FunctionPutInputsResponseItem(idx=item.idx, input_id=inp.input_id))
+        with self._journal_group():
+            for item in request.inputs:
+                inp = self._enqueue_input(fn, call, item)
+                resp.inputs.append(api_pb2.FunctionPutInputsResponseItem(idx=item.idx, input_id=inp.input_id))
         async with fn.input_condition:
             fn.input_condition.notify_all()
         self.s.schedule_event.set()
@@ -926,6 +970,59 @@ class ModalTPUServicer:
                     )
                 except asyncio.TimeoutError:
                     pass
+
+    async def FunctionStreamOutputs(self, request: api_pb2.FunctionGetOutputsRequest, context):
+        """Push-streamed output delivery (ISSUE 8, docs/DISPATCH.md): the
+        keep-alive server-streaming twin of FunctionGetOutputs. A batch is
+        pushed the instant ``_append_output`` fires (same cursor semantics,
+        same journaled consumption for clear_on_success takes); empty
+        keep-alive responses every few seconds let the client distinguish a
+        quiet call from a dead stream. The poll RPC stays as the fallback
+        rung — chaos `stream_reset` charges abort the stream mid-flight to
+        prove the client degrades to it."""
+        call = self.s.function_calls.get(request.function_call_id)
+        if call is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"call {request.function_call_id} not found")
+        keepalive_s = 5.0
+        cursor = int(request.last_entry_id or 0)
+        while True:
+            if self.chaos is not None and self.chaos.consume_knob("stream_reset"):
+                await context.abort(grpc.StatusCode.UNAVAILABLE, "chaos: output stream reset")
+            start = call.outputs_consumed if request.clear_on_success else cursor
+            available = call.outputs[start:]
+            if available:
+                n = len(available) if request.max_values <= 0 else min(len(available), request.max_values)
+                taken = available[:n]
+                if request.clear_on_success:
+                    call.outputs_consumed += n
+                    # same durability contract as the poll path: the client's
+                    # consumption survives a supervisor restart
+                    self._j(
+                        "consumed", function_call_id=call.function_call_id, n=call.outputs_consumed
+                    )
+                cursor = start + n
+                yield api_pb2.FunctionGetOutputsResponse(
+                    outputs=taken,
+                    last_entry_id=str(cursor),
+                    num_unfinished_inputs=call.num_inputs - call.num_done,
+                )
+                continue
+            timed_out = False
+            async with call.output_condition:
+                try:
+                    await asyncio.wait_for(call.output_condition.wait(), timeout=keepalive_s)
+                except asyncio.TimeoutError:
+                    timed_out = True
+            if timed_out:
+                # keep-alive OUTSIDE the condition lock: the yield suspends
+                # for the whole gRPC write (flow control included) — holding
+                # the lock there would let one stalled consumer block every
+                # producer's notify_all for this call
+                yield api_pb2.FunctionGetOutputsResponse(
+                    outputs=[],
+                    last_entry_id=str(start),
+                    num_unfinished_inputs=call.num_inputs - call.num_done,
+                )
 
     async def FunctionCallGetData(self, request: api_pb2.FunctionCallGetDataRequest, context):
         call = self.s.function_calls.get(request.function_call_id)
@@ -1308,6 +1405,13 @@ class ModalTPUServicer:
                     pass
 
     async def FunctionPutOutputs(self, request: api_pb2.FunctionPutOutputsRequest, context) -> api_pb2.FunctionPutOutputsResponse:
+        with self._journal_group():
+            return await self._put_outputs(request)
+
+    async def _put_outputs(self, request: api_pb2.FunctionPutOutputsRequest) -> api_pb2.FunctionPutOutputsResponse:
+        # coalesced publication (io_manager's output MicroBatcher) delivers
+        # many inputs' outputs in one RPC; the journal group above commits
+        # their records with one flush — group-committed, never skipped
         touched: set[str] = set()
         pushing_task = self.s.tasks.get(request.task_id) if request.task_id else None
         for item in request.outputs:

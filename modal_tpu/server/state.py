@@ -396,6 +396,12 @@ class ServerState:
         # plane) and the input-plane servicer's verifier; attempt_token ->
         # (function_call_id, input_id)
         self.input_plane_url: str = ""
+        # local fast-path coordinates advertised on ClientHello (ISSUE 8,
+        # docs/DISPATCH.md): the control/input-plane Unix sockets and the
+        # on-disk blob store a co-located client can touch directly
+        self.uds_path: str = ""
+        self.input_plane_uds: str = ""
+        self.blob_local_dir: str = ""
         self.auth_secret: bytes = os.urandom(32)
         self.attempts: dict[str, tuple[str, str, float]] = {}  # token -> (call_id, input_id, minted_at)
 

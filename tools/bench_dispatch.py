@@ -167,6 +167,46 @@ def main() -> None:
         result["profiler_hz"] = profiler.DEFAULT_HZ
         result["profiler_overhead_pct"] = round(100.0 * (prof_p50 - base_p50) / base_p50, 2)
         result["profiler_samples"] = profiler.current().n_samples if profiler.current() else 0
+
+        # --- concurrency sweep (ISSUE 8 satellite) -------------------------
+        # 1/8/64 in-flight callers against one concurrent container: the
+        # coalesced submit/claim/publish planes should hold calls/s roughly
+        # flat per RPC while concurrency grows
+        from concurrent.futures import ThreadPoolExecutor
+
+        import modal_tpu
+
+        app4 = modal_tpu.App("dispatch-bench-sweep")
+
+        def noop_c(x: int) -> int:
+            return x
+
+        noop_c = modal_tpu.concurrent(max_inputs=64)(noop_c)
+        noop_c = app4.function(serialized=True, timeout=120)(noop_c)
+        sweep: dict = {}
+        with app4.run():
+            _timed_calls(noop_c, args.warmup)
+            for conc in (1, 8, 64):
+                n_calls = max(16, conc * 3)
+
+                def _one(i: int) -> float:
+                    t0 = time.perf_counter()
+                    assert noop_c.remote(i) == i
+                    return time.perf_counter() - t0
+
+                t_sw0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=conc) as pool:
+                    call_walls = list(pool.map(_one, range(n_calls)))
+                wall = time.perf_counter() - t_sw0
+                sweep[str(conc)] = {
+                    "calls": n_calls,
+                    "calls_per_s": round(n_calls / wall, 2),
+                    "p50_s": round(_quantile(sorted(call_walls), 0.5), 4),
+                    "p95_s": round(_quantile(sorted(call_walls), 0.95), 4),
+                }
+                print(f"sweep conc={conc}: {sweep[str(conc)]}", file=sys.stderr)
+        result["sweep"] = sweep
+        result["max_calls_per_s"] = max(v["calls_per_s"] for v in sweep.values())
     finally:
         synchronizer.run(sup.stop())
 

@@ -85,6 +85,24 @@ NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
         ("n_samples", 3, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
         ("profile_paths", 4, F.TYPE_STRING, F.LABEL_REPEATED, ""),
     ],
+    # Coalesced dispatch (ISSUE 8, _utils/coalescer.py): N concurrent
+    # `.remote()`s submitted within one adaptive window share ONE RPC — each
+    # sub-request is handled exactly like a standalone FunctionMap (own call
+    # id, own journal records), the batch is just the wire vehicle.
+    "FunctionMapBatchRequest": [
+        ("requests", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED, ".modal.tpu.api.FunctionMapRequest"),
+    ],
+    "FunctionMapBatchResponse": [
+        ("responses", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED, ".modal.tpu.api.FunctionMapResponse"),
+    ],
+    # Same coalescing vehicle for the input-plane unary path: N concurrent
+    # AttemptStarts share one RPC, each minting its own call + attempt token.
+    "AttemptStartBatchRequest": [
+        ("requests", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED, ".modal.tpu.api.AttemptStartRequest"),
+    ],
+    "AttemptStartBatchResponse": [
+        ("responses", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED, ".modal.tpu.api.AttemptStartResponse"),
+    ],
 }
 
 # (message, field_name, field_number, field_type) — optionally a 5-tuple with
@@ -139,6 +157,14 @@ PATCHES: list[tuple[str, str, int, int]] = [
     # container.input_deliver span starts at the CLAIM — anchoring at the
     # long-poll's issue time would swallow the client's prep/RPC window
     ("FunctionGetInputsItem", "claimed_at", 9, F.TYPE_DOUBLE),
+    # Local fast-path transport (ISSUE 8, docs/DISPATCH.md): co-located
+    # clients learn the control/input-plane Unix-domain sockets and the
+    # on-disk blob store at handshake; a client that can stat the paths dials
+    # UDS (or reads blobs straight from page cache) instead of TCP/HTTP, and
+    # falls back the moment the paths stop resolving
+    ("ClientHelloResponse", "uds_path", 5, F.TYPE_STRING),
+    ("ClientHelloResponse", "input_plane_uds_path", 6, F.TYPE_STRING),
+    ("ClientHelloResponse", "blob_local_dir", 7, F.TYPE_STRING),
 ]
 
 HEADER = '''\
