@@ -900,6 +900,63 @@ def _run_dispatch_bench(timeout_s: float) -> dict | None:
     return _run_microbench("dispatch", "bench_dispatch.py", "DISPATCH_BENCH_RESULT", timeout_s)
 
 
+def _run_serving_bench(timeout_s: float) -> dict | None:
+    """tools/bench_serving.py: 32-concurrent-SSE-client load against the
+    continuous-batching engine vs the sequential greedy baseline (ISSUE 9:
+    tokens/s/chip, p50/p99 TTFT, first-token-before-completion)."""
+    return _run_microbench("serving", "bench_serving.py", "SERVING_BENCH_RESULT", timeout_s)
+
+
+def _serving_regression_guard(srv: dict) -> None:
+    """ISSUE 9 satellite: tokens_per_s_per_chip / p99 TTFT recorded in
+    BENCH_serving.json, tolerance-checked like the dispatch floor — a clean
+    run rewrites the baseline, a regressed one keeps the old numbers and
+    flags serving_regression until the throughput is actually recovered."""
+    path = os.path.join(REPO_ROOT, "BENCH_serving.json")
+    baseline = None
+    try:
+        with open(path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        pass
+    tps = srv.get("tokens_per_s_per_chip")
+    p99 = srv.get("p99_ttft_s")
+    regression = False
+    if baseline is not None:
+        base_tps = baseline.get("serving_tokens_per_s_per_chip")
+        base_p99 = baseline.get("serving_p99_ttft_s")
+        if base_tps and tps and tps < base_tps / DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[serving]: REGRESSION tokens/s {tps:.1f} vs baseline {base_tps:.1f}\n"
+            )
+        if base_p99 and p99 and p99 > base_p99 * DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[serving]: REGRESSION p99 TTFT {p99:.3f}s vs baseline {base_p99:.3f}s\n"
+            )
+    if _BANK["best"] is not None:
+        _BANK["best"]["serving_regression"] = regression
+    if not regression:
+        try:
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "serving_tokens_per_s_per_chip": tps,
+                        "serving_p99_ttft_s": p99,
+                        "serving_p50_ttft_s": srv.get("p50_ttft_s"),
+                        "serving_speedup_vs_sequential": srv.get("speedup_vs_sequential"),
+                        "serving_requests_per_s": srv.get("requests_per_s"),
+                        "written_at": time.time(),
+                    },
+                    f,
+                    indent=1,
+                )
+                f.write("\n")
+        except OSError as exc:
+            sys.stderr.write(f"bench[serving]: baseline write failed: {exc}\n")
+
+
 # dispatch-regression tolerance (ISSUE 8 satellite): the floor may wobble
 # with host noise, but a p50 >1.5x the recorded baseline (or calls/s below
 # baseline/1.5) flags dispatch_regression=true in the banked result.
@@ -1046,6 +1103,16 @@ def _orchestrate() -> None:
             # ISSUE 8 satellite: floor guard — record + tolerance-check the
             # dispatch baseline so later PRs can't silently regress it
             _dispatch_regression_guard(disp)
+    # Phase 2.9: serving-tier microbench (tools/bench_serving.py): 32
+    # concurrent SSE clients vs the sequential greedy baseline — serving_*
+    # fields (ISSUE 9 acceptance: >=2x tokens/s/chip, p99 TTFT, first token
+    # streamed before completion) + BENCH_serving.json regression guard.
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_SERVING", "1") == "1" and _remaining() > 150:
+        srv = _run_serving_bench(min(300.0, _remaining()))
+        if srv is not None and _BANK["best"] is not None:
+            for k, v in srv.items():
+                _BANK["best"][f"serving_{k}"] = v
+            _serving_regression_guard(srv)
     # Phase 3: poll the relay for a bounded window (never against our own
     # total deadline — the round-3 killer), attempting TPU whenever it answers.
     while (

@@ -188,4 +188,12 @@ def __getattr__(name: str):
         from .sandbox_fs import FileIO
 
         return FileIO
+    if name == "serving":
+        # serving tier (docs/SERVING.md): modal_tpu.serving.llm_service /
+        # ServingEngine / serving_asgi_app (jax loads lazily inside).
+        # importlib, not `from . import`: the fromlist path re-enters this
+        # __getattr__ before sys.modules is populated and recurses
+        import importlib
+
+        return importlib.import_module(".serving", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -178,6 +178,8 @@ class _App:
         max_containers: int = 0,
         buffer_containers: int = 0,
         scaledown_window: int = 60,
+        target_ttft_ms: float = 0.0,
+        target_tokens_per_replica: float = 0.0,
         cloud: Optional[str] = None,
         region: Optional[Union[str, Sequence[str]]] = None,
         scheduler_placement: Optional[SchedulerPlacement] = None,
@@ -232,6 +234,8 @@ class _App:
                 max_containers=max_containers,
                 buffer_containers=buffer_containers,
                 scaledown_window=scaledown_window,
+                target_ttft_ms=target_ttft_ms,
+                target_tokens_per_replica=target_tokens_per_replica,
                 max_concurrent_inputs=params.max_concurrent_inputs or 0,
                 target_concurrent_inputs=params.target_concurrent_inputs or 0,
                 batch_max_size=params.batch_max_size or 0,

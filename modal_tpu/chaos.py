@@ -43,8 +43,11 @@ from .config import logger
 # control-plane pump and the input-plane equivalents are one logical fault
 # surface (satellite: the old knobs only covered the control-plane pump).
 KNOB_RPCS: dict[str, frozenset] = {
-    "fail_get_inputs": frozenset({"FunctionGetInputs"}),
-    "fail_put_outputs": frozenset({"FunctionPutOutputs"}),
+    # FunctionExchange IS GetInputs+PutOutputs merged (docs/DISPATCH.md §4),
+    # so both turnaround knobs cover it — the container's claim/publish
+    # retry behavior stays chaos-testable whichever rung serves it
+    "fail_get_inputs": frozenset({"FunctionGetInputs", "FunctionExchange"}),
+    "fail_put_outputs": frozenset({"FunctionPutOutputs", "FunctionExchange"}),
     "fail_put_inputs": frozenset({"FunctionPutInputs", "FunctionMap", "MapStartOrContinue", "AttemptStart"}),
     "fail_get_outputs": frozenset({"FunctionGetOutputs", "MapAwait", "AttemptAwait"}),
 }

@@ -266,6 +266,22 @@ class _Cls(_Object, type_prefix="cs"):
         # lifecycle partials get marked too so __del__ doesn't warn
         for pf in find_partial_methods_for_user_cls(user_cls, _PartialFunctionFlags.all()).values():
             pf.wrapped = True
+        # web-endpoint method (serving tier, docs/SERVING.md): ONE method may
+        # carry @asgi_app/@wsgi_app/@web_endpoint/@web_server — the class's
+        # service function adopts its webhook params, so the container serves
+        # HTTP (with @enter-loaded state) instead of polling the input queue
+        web_partials = {
+            name: pf
+            for name, pf in find_partial_methods_for_user_cls(
+                user_cls, _PartialFunctionFlags.WEB_ENDPOINT
+            ).items()
+        }
+        if len(web_partials) > 1:
+            raise InvalidError(
+                f"class {user_cls.__name__} has multiple web-endpoint methods "
+                f"({sorted(web_partials)}); a class serves at most one"
+            )
+        web_method_name, web_pf = next(iter(web_partials.items()), (None, None))
 
         # Batched/concurrent settings can come from method decorators: the
         # service function adopts them (one service function per class).
@@ -291,10 +307,26 @@ class _Cls(_Object, type_prefix="cs"):
         # share parameter validation, then adjust class-specific fields.
         function_kwargs.pop("serialized", None)  # classes always serialize
         function_kwargs.pop("name", None)
+        service_stub: Any = _class_service_stub(user_cls)
+        if web_pf is not None:
+            # hand the web method's webhook params to app.function via the
+            # partial-function vehicle it already understands
+            import dataclasses as _dc
+
+            service_stub = _PartialFunction(
+                service_stub,
+                _PartialFunctionFlags.FUNCTION | _PartialFunctionFlags.WEB_ENDPOINT,
+                _dc.replace(web_pf.params),
+            )
         service_function = app.function(
             serialized=True, name=user_cls.__name__, **function_kwargs
-        )(_class_service_stub(user_cls))
+        )(service_stub)
         spec = service_function.spec
+        if web_method_name is not None:
+            spec.experimental_options["web_method_name"] = web_method_name
+            # the web method rides the method table so the container can
+            # resolve its bound callable (runtime/user_code.py)
+            method_partials = {**method_partials, web_method_name: web_pf}
         spec.batch_max_size = batch_max
         spec.batch_wait_ms = batch_wait
         spec.max_concurrent_inputs = max_conc
@@ -379,6 +411,13 @@ class _Cls(_Object, type_prefix="cs"):
     def __call__(self, *args: Any, **kwargs: Any) -> _Obj:
         """Instantiate: returns an _Obj binding constructor params."""
         return _Obj(self, args, kwargs)
+
+    async def get_web_url(self, timeout: float = 60.0) -> str:
+        """URL of the class's web-endpoint method (the service function's
+        web URL — one per class; serving tier docs/SERVING.md)."""
+        if self._service_function is None:
+            raise ExecutionError("class has no service function (not hydrated?)")
+        return await self._service_function.get_web_url(timeout)
 
 
 def _class_service_stub(user_cls: type) -> Callable:

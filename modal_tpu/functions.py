@@ -128,6 +128,11 @@ class _FunctionSpec:
     max_containers: int = 0
     buffer_containers: int = 0
     scaledown_window: int = 60
+    # serving-tier SLO autoscaling (docs/SERVING.md): web functions have no
+    # input backlog, so the scheduler sizes them on pushed serving telemetry
+    # against these targets (0 = backlog autoscaling only)
+    target_ttft_ms: float = 0.0
+    target_tokens_per_replica: float = 0.0
     max_concurrent_inputs: int = 0
     target_concurrent_inputs: int = 0
     batch_max_size: int = 0
@@ -266,6 +271,8 @@ class _Function(_Object, type_prefix="fu"):
                     max_containers=spec.max_containers,
                     buffer_containers=spec.buffer_containers,
                     scaledown_window=spec.scaledown_window,
+                    target_ttft_ms=spec.target_ttft_ms,
+                    target_tokens_per_replica=spec.target_tokens_per_replica,
                 )
             )
             for k, v in spec.experimental_options.items():
@@ -600,12 +607,16 @@ class _Function(_Object, type_prefix="fu"):
         max_containers: Optional[int] = None,
         buffer_containers: Optional[int] = None,
         scaledown_window: Optional[int] = None,
+        target_ttft_ms: Optional[float] = None,
+        target_tokens_per_replica: Optional[float] = None,
     ) -> None:
         settings = api_pb2.AutoscalerSettings(
             min_containers=min_containers or 0,
             max_containers=max_containers or 0,
             buffer_containers=buffer_containers or 0,
             scaledown_window=scaledown_window or 0,
+            target_ttft_ms=target_ttft_ms or 0.0,
+            target_tokens_per_replica=target_tokens_per_replica or 0.0,
         )
         await retry_transient_errors(
             self.client.stub.FunctionUpdateSchedulingParams,

@@ -1,0 +1,352 @@
+"""Paged (block) KV cache: fixed-size pages + a block allocator so
+heterogeneous sequence lengths share ONE HBM pool.
+
+The dense `KVCache` (llama.py) allocates `batch × max_len` per request —
+serving N concurrent requests that way costs `N × max_len` HBM regardless of
+how short each sequence actually is, and admitting a new request means
+allocating (and compiling for) a new cache. Here the pool is allocated ONCE:
+
+- **pages**: `[n_layers, num_pages, page_size, n_kv, hd]` k/v arrays — the
+  whole serving tier's KV memory, fixed at engine start. HBM is bounded by
+  `num_pages × page_size`, never by `num_requests × max_len`.
+- **page table**: `[slots, pages_per_slot]` int32 — slot s's token position p
+  lives in page `page_table[s, p // page_size]` at offset `p % page_size`.
+- **block allocator** (`PageAllocator`, host-side): a free list handing out
+  pages one at a time as sequences grow. Fragmentation is structural-zero:
+  any free page serves any slot (no contiguity requirement), so alloc/free
+  churn from heterogeneous lengths can't strand capacity.
+
+Page 0 is reserved as a **scratch page**: inactive slots' writes are routed
+there, which keeps `paged_decode_step` a single fixed-shape executable (the
+batch dimension is always `slots`; inactivity is data, not shape). Scratch
+garbage is never read — attention masks positions beyond each slot's length.
+
+TPU notes: everything here is static-shape jnp (gathers/scatters lower to
+XLA dynamic-gather/scatter), so the same program runs on TPU, interpret-mode
+Pallas hosts, and the CPU fallback unchanged (the Maple-style portability
+constraint). A Pallas paged-attention kernel (per-page VMEM streaming like
+ops/attention.py's flash kernel) is the TPU upgrade path — same signatures.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .llama import LlamaConfig, apply_rope, repeat_kv, rms_norm, rope_frequencies
+
+DEFAULT_PAGE_SIZE = 16
+
+
+class PagePoolExhausted(Exception):
+    """The shared page pool has no free pages (caller should preempt or
+    queue — never a crash; docs/SERVING.md degradation matrix)."""
+
+
+class PageAllocator:
+    """Host-side free-list block allocator over the page pool.
+
+    Pages are interchangeable (the page table adds the indirection), so this
+    is exact-fit by construction: `can_alloc(n)` ⇔ `len(free) >= n`, no
+    matter how fragmented the alloc/free history was. Page 0 is reserved as
+    the scratch page and never handed out."""
+
+    def __init__(self, num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the reserved scratch page)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() yields 1, 2, ...
+        self.high_water = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_for(self, num_tokens: int) -> int:
+        return max(1, math.ceil(num_tokens / self.page_size))
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"need {n} pages, {len(self._free)} free (pool {self.num_pages - 1})"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self.high_water = max(self.high_water, self.allocated_pages)
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        if len(set(pages)) != len(pages):
+            raise ValueError(f"double free within one batch: {pages}")
+        for p in pages:
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"page {p} out of range")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+
+
+class PagedKVCache(NamedTuple):
+    """Device state of the shared pool (one per serving engine, NOT per
+    request). All shapes static — one compiled decode executable serves
+    every admission pattern."""
+
+    k_pages: jax.Array  # [n_layers, num_pages, page_size, n_kv, hd]
+    v_pages: jax.Array
+    page_table: jax.Array  # [slots, pages_per_slot] int32 (0 = scratch)
+    seq_lens: jax.Array  # [slots] int32 — tokens written per slot
+
+    @staticmethod
+    def create(
+        cfg: LlamaConfig,
+        slots: int,
+        num_pages: int,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        pages_per_slot: Optional[int] = None,
+    ) -> "PagedKVCache":
+        pages_per_slot = pages_per_slot or math.ceil(cfg.max_seq_len / page_size)
+        shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        return PagedKVCache(
+            k_pages=jnp.zeros(shape, cfg.dtype),
+            v_pages=jnp.zeros(shape, cfg.dtype),
+            page_table=jnp.zeros((slots, pages_per_slot), jnp.int32),
+            seq_lens=jnp.zeros((slots,), jnp.int32),
+        )
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def num_slots(self) -> int:
+        return self.page_table.shape[0]
+
+    @property
+    def kv_span(self) -> int:
+        """Max attended positions per slot (pages_per_slot × page_size)."""
+        return self.page_table.shape[1] * self.page_size
+
+    def pool_bytes(self) -> int:
+        return int(self.k_pages.size + self.v_pages.size) * self.k_pages.dtype.itemsize
+
+
+# -- host-side table maintenance (small jitted updates between steps) --------
+
+
+@jax.jit
+def assign_pages(cache: PagedKVCache, slot: int, start_index: int, pages: jax.Array) -> PagedKVCache:
+    """Write newly-allocated page ids into slot's table row at
+    [start_index : start_index+len(pages)] (len(pages) is static per call —
+    admission batches one page list at a time)."""
+    row = lax.dynamic_update_slice(cache.page_table[slot], pages.astype(jnp.int32), (start_index,))
+    return cache._replace(page_table=cache.page_table.at[slot].set(row))
+
+
+@jax.jit
+def release_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
+    """Point the slot back at scratch and zero its length (the host frees
+    the pages on the allocator side)."""
+    return cache._replace(
+        page_table=cache.page_table.at[slot].set(0),
+        seq_lens=cache.seq_lens.at[slot].set(0),
+    )
+
+
+# -- paged forward internals --------------------------------------------------
+
+
+def _scatter_kv(k_pages, v_pages, k, v, page_ids, offsets):
+    """Write per-position K/V rows into their pages.
+    k_pages/v_pages: [P, page, n_kv, hd]; k/v: [T, n_kv, hd];
+    page_ids/offsets: [T] (scratch-routed entries carry page 0)."""
+    return (
+        k_pages.at[page_ids, offsets].set(k, mode="drop"),
+        v_pages.at[page_ids, offsets].set(v, mode="drop"),
+    )
+
+
+def _paged_attention(q, k_pages, v_pages, page_table, mask):
+    """Gather each slot's page span and attend.
+    q: [S, Sq, H, hd]; k_pages/v_pages: [P, page, n_kv, hd];
+    page_table: [S, pages_per_slot]; mask: [S, 1, Sq, K] additive.
+    Returns [S, Sq, H, hd]."""
+    s, sq, h, hd = q.shape
+    page = k_pages.shape[1]
+    n_kv = k_pages.shape[2]
+    k_span = page_table.shape[1] * page
+    # [S, pages_per_slot, page, n_kv, hd] -> [S, K, n_kv, hd]
+    k_att = k_pages[page_table].reshape(s, k_span, n_kv, hd)
+    v_att = v_pages[page_table].reshape(s, k_span, n_kv, hd)
+    n_rep = h // n_kv
+    k_att = repeat_kv(k_att, n_rep)
+    v_att = repeat_kv(v_att, n_rep)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("sqhd,skhd->shqk", q, k_att, preferred_element_type=jnp.float32) * scale
+    probs = jax.nn.softmax((logits + mask).astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("shqk,skhd->sqhd", probs, v_att)
+
+
+def _paged_layer(cfg, x, layer, positions, write_page_ids, write_offsets, mask, inv_freq, page_table, kp, vp):
+    """One transformer layer over paged KV. x: [S, Sq, D]; positions:
+    [S, Sq]; write_page_ids/offsets: flat [S*Sq] scatter targets."""
+    from .quant import qmm
+
+    s, sq, d = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = qmm(h, layer["wq"]).reshape(s, sq, cfg.n_heads, hd)
+    k = qmm(h, layer["wk"]).reshape(s, sq, cfg.n_kv_heads, hd)
+    v = qmm(h, layer["wv"]).reshape(s, sq, cfg.n_kv_heads, hd)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    kp, vp = _scatter_kv(
+        kp, vp,
+        k.reshape(s * sq, cfg.n_kv_heads, hd),
+        v.reshape(s * sq, cfg.n_kv_heads, hd),
+        write_page_ids, write_offsets,
+    )
+    attn_out = _paged_attention(q, kp, vp, page_table, mask)
+    x = x + qmm(attn_out.reshape(s, sq, cfg.n_heads * hd), layer["wo"])
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(qmm(h, layer["w_gate"]).astype(jnp.float32)).astype(x.dtype) * qmm(h, layer["w_up"])
+    x = x + qmm(gated, layer["w_down"])
+    return x, kp, vp
+
+
+def _run_layers(params, cfg, x, positions, write_page_ids, write_offsets, mask, page_table, cache):
+    inv_freq = rope_frequencies(cfg)
+
+    def body(x_carry, layer_and_pages):
+        layer, kp, vp = layer_and_pages
+        x_out, kp, vp = _paged_layer(
+            cfg, x_carry, layer, positions, write_page_ids, write_offsets,
+            mask, inv_freq, page_table, kp, vp,
+        )
+        return x_out, (kp, vp)
+
+    x, (k_pages, v_pages) = lax.scan(body, x, (params["layers"], cache.k_pages, cache.v_pages))
+    return x, k_pages, v_pages
+
+
+def _logits(params, cfg, x_last):
+    from .quant import qmm
+
+    x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    return qmm(x_last, params["lm_head"]).astype(jnp.float32)
+
+
+# -- public jitted entry points ----------------------------------------------
+# MoE configs route through the dense path (moe_ffn assumes full-batch
+# dispatch); the serving engine rejects them at construction.
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def paged_prefill(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [S_pad] int32 — one slot's prompt chunk, padded
+    length: jax.Array,  # [] int32 — real token count (<= S_pad)
+    cache: PagedKVCache,
+    slot: jax.Array,  # [] int32
+    start_pos: jax.Array,  # [] int32 — tokens already in the slot (chunked prefill)
+):
+    """Prefill one slot's prompt chunk into its pages while existing slots'
+    pages stay untouched. Padded positions (>= length) scatter to the scratch
+    page and are never attended. Returns (last_logits [V], next_token [],
+    cache); chunked callers ignore logits until the final chunk.
+
+    One executable per (cfg, S_pad): callers bucket prompt lengths
+    (PREFILL_BUCKETS) so arbitrary prompts hit a handful of compiles."""
+    from .quant import qembed
+
+    (s_pad,) = tokens.shape
+    page = cache.page_size
+    idx = jnp.arange(s_pad, dtype=jnp.int32)
+    valid = idx < length
+    positions = start_pos + idx  # [S_pad]
+    row = cache.page_table[slot]  # [pages_per_slot]
+    write_page_ids = jnp.where(valid, row[jnp.clip(positions // page, 0, row.shape[0] - 1)], 0)
+    write_offsets = jnp.where(valid, positions % page, 0)
+
+    x = qembed(params["embed"], tokens[None, :])  # [1, S_pad, D]
+    # causal within the slot's whole span: q at position p sees kv_pos <= p;
+    # rows past `length` are garbage but their outputs are never read
+    kv_pos = jnp.arange(cache.kv_span, dtype=jnp.int32)[None, None, None, :]
+    q_pos = positions[None, None, :, None]
+    mask = jnp.where(kv_pos <= q_pos, 0.0, -jnp.inf).astype(jnp.float32)  # [1,1,S_pad,K]
+
+    x, k_pages, v_pages = _run_layers(
+        params, cfg, x, positions[None, :], write_page_ids, write_offsets,
+        mask, cache.page_table[slot][None, :], cache,
+    )
+    last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)  # [D]
+    logits = _logits(params, cfg, last)
+    cache = cache._replace(
+        k_pages=k_pages,
+        v_pages=v_pages,
+        seq_lens=cache.seq_lens.at[slot].set(start_pos + length),
+    )
+    return logits, jnp.argmax(logits).astype(jnp.int32), cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def paged_decode_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [slots] int32 — current token per slot
+    cache: PagedKVCache,
+    active: jax.Array,  # [slots] bool
+):
+    """One continuous-batching decode step over EVERY slot (fixed shape:
+    inactive slots compute on garbage routed to the scratch page). Returns
+    (logits [slots, V], next_tokens [slots], cache). Joining or leaving a
+    slot between steps never changes the executable — admission is data."""
+    from .quant import qembed
+
+    slots = cache.num_slots
+    page = cache.page_size
+    positions = cache.seq_lens  # [slots] — the new token's position
+    rows = cache.page_table  # [slots, pages_per_slot]
+    page_idx = jnp.clip(positions // page, 0, rows.shape[1] - 1)
+    write_page_ids = jnp.where(active, jnp.take_along_axis(rows, page_idx[:, None], axis=1)[:, 0], 0)
+    write_offsets = jnp.where(active, positions % page, 0)
+
+    x = qembed(params["embed"], tokens[:, None])  # [slots, 1, D]
+    kv_pos = jnp.arange(cache.kv_span, dtype=jnp.int32)[None, None, None, :]
+    mask = jnp.where(kv_pos <= positions[:, None, None, None], 0.0, -jnp.inf).astype(jnp.float32)
+
+    x, k_pages, v_pages = _run_layers(
+        params, cfg, x, positions[:, None], write_page_ids, write_offsets, mask, rows, cache,
+    )
+    logits = _logits(params, cfg, x[:, 0, :])  # [slots, V]
+    cache = cache._replace(
+        k_pages=k_pages,
+        v_pages=v_pages,
+        seq_lens=jnp.where(active, cache.seq_lens + 1, cache.seq_lens),
+    )
+    return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+
+# prompt-length buckets: one prefill executable per bucket serves every
+# prompt that pads into it (mirrors sampling.DECODE_CHUNK's
+# one-executable-per-length discipline)
+PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def prefill_bucket(n: int, max_len: int) -> int:
+    for b in PREFILL_BUCKETS:
+        if b >= n and b <= max_len:
+            return b
+    return max_len

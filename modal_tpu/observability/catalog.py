@@ -235,6 +235,13 @@ OUTPUT_STREAM_EVENTS = REGISTRY.counter(
     "reconnect | reset | fallback).",
     ("event",),
 )
+DISPATCH_EXCHANGES = REGISTRY.counter(
+    "modal_tpu_dispatch_exchange_total",
+    "Container turnarounds on the merged FunctionExchange RPC, by payload "
+    "(with_outputs = PutOutputs piggybacked on the claim, claim_only, "
+    "fallback = exchange abandoned to the split RPCs).",
+    ("carried",),
+)
 
 # -- dispatch attribution + profiling (ISSUE 7; observability/critical_path.py,
 # observability/profiler.py, docs/OBSERVABILITY.md) ---------------------------
@@ -279,6 +286,61 @@ STEP_SECONDS = REGISTRY.histogram(
     "Train/decode step wall time (post-compile steady state), by loop kind.",
     ("kind",),
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60),
+)
+
+# -- serving tier (ISSUE 9; serving/engine.py, serving/api.py,
+# models/paged_kv.py, docs/SERVING.md) ----------------------------------------
+
+SERVING_TTFT = REGISTRY.histogram(
+    "modal_tpu_serving_ttft_seconds",
+    "Time to first generated token per request (submit → first token in the "
+    "buffer); observations carry the request's trace id as an exemplar.",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60),
+)
+SERVING_TTFT_P95 = REGISTRY.gauge(
+    "modal_tpu_serving_ttft_p95_seconds",
+    "p95 TTFT over the engine's recent-request window — the SLO signal the "
+    "scheduler scales serving replicas on (AutoscalerSettings.target_ttft_ms).",
+)
+SERVING_TOKENS_PER_S = REGISTRY.gauge(
+    "modal_tpu_serving_tokens_per_second",
+    "Generated tokens/s over the engine's trailing 10s window (continuous-"
+    "batching throughput; the capacity signal for SLO scale-down).",
+)
+SERVING_BATCH_OCCUPANCY = REGISTRY.histogram(
+    "modal_tpu_serving_batch_occupancy",
+    "Active decode slots per continuous-batching step (how full the running "
+    "batch actually is).",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+SERVING_QUEUE_DEPTH = REGISTRY.gauge(
+    "modal_tpu_serving_queue_depth",
+    "Requests admitted to the engine but not yet holding a decode slot.",
+)
+SERVING_REQUESTS = REGISTRY.counter(
+    "modal_tpu_serving_requests_total",
+    "Serving requests finished, by outcome (ok | error | stopped).",
+    ("outcome",),
+)
+SERVING_PREEMPTIONS = REGISTRY.counter(
+    "modal_tpu_serving_preemptions_total",
+    "Requests preempted out of their decode slot by KV-pool pressure "
+    "(requeued with their generated prefix; no tokens lost).",
+)
+SERVING_STREAM_EVENTS = REGISTRY.counter(
+    "modal_tpu_serving_stream_events_total",
+    "SSE delivery lifecycle (open | token | done | reset | buffered_fallback).",
+    ("event",),
+)
+KV_PAGES_ALLOCATED = REGISTRY.gauge(
+    "modal_tpu_kv_pages_allocated",
+    "KV-cache pages currently allocated out of the shared pool "
+    "(models/paged_kv.py block allocator).",
+)
+KV_PAGES_FREE = REGISTRY.gauge(
+    "modal_tpu_kv_pages_free",
+    "KV-cache pages free in the shared pool (total HBM is bounded by the "
+    "pool, never by num_requests × max_len).",
 )
 
 # -- chaos --------------------------------------------------------------------
@@ -345,6 +407,9 @@ SPAN_CATALOG: dict[str, str] = {
     "coldstart.preinit": "warm-pool opt-in jax backend pre-initialization",
     "recovery.replay": "journal replay into a fresh ServerState",
     "recovery.crash_restart": "chaos supervisor crash + same-port rebuild",
+    "serving.admit": "serving-tier admission: request submit → decode-slot + KV pages",
+    "serving.prefill": "serving-tier prompt prefill (chunked; ends at the first token)",
+    "serving.stream": "one SSE token stream: open → done/reset (serving/api.py)",
 }
 
 

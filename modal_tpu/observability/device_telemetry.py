@@ -209,6 +209,20 @@ PUSH_FAMILIES = (
     "modal_tpu_compile_seconds",
     "modal_tpu_step_seconds",
     "modal_tpu_profiler_samples_total",
+    # serving tier (docs/SERVING.md): the SLO signals the scheduler sizes
+    # serving replicas on ride the same heartbeat plane. Histograms/counters
+    # delta-merge; the p95/tokens-per-s/queue gauges are latest-wins on the
+    # supervisor registry — the SCHEDULER reads each task's raw pushed
+    # report (TaskState_.telemetry_prev_json), so scaling stays per-replica
+    # even when the merged gauge view collapses to one writer.
+    "modal_tpu_serving_ttft_seconds",
+    "modal_tpu_serving_ttft_p95_seconds",
+    "modal_tpu_serving_tokens_per_second",
+    "modal_tpu_serving_queue_depth",
+    "modal_tpu_serving_batch_occupancy",
+    "modal_tpu_serving_requests_total",
+    "modal_tpu_kv_pages_allocated",
+    "modal_tpu_kv_pages_free",
 )
 
 

@@ -54,6 +54,9 @@ _OVERRIDES: dict[str, tuple[Optional[str], Optional[str], Arity]] = {
     # batch is pushed the instant _append_output fires, with periodic empty
     # keep-alives; the poll path stays as the fallback rung
     "FunctionStreamOutputs": ("FunctionGetOutputsRequest", "FunctionGetOutputsResponse", Arity.UNARY_STREAM),
+    # merged container turnaround (docs/DISPATCH.md): PutOutputs + GetInputs
+    # in one exchange — the response is wire-identical to the claim poll's
+    "FunctionExchange": ("FunctionExchangeRequest", "FunctionGetInputsResponse", Arity.UNARY_UNARY),
     "SandboxGetLogs": (None, "TaskLogsBatch", Arity.UNARY_STREAM),
     "SandboxSnapshotFs": (None, "SandboxSnapshotFsRequestResponse", Arity.UNARY_UNARY),
     "ContainerExecGetOutput": (None, "RuntimeOutputBatch", Arity.UNARY_STREAM),
@@ -105,6 +108,7 @@ _RPC_NAMES = [
     "ContainerHeartbeat",
     "FunctionGetInputs",
     "FunctionPutOutputs",
+    "FunctionExchange",
     "ContainerCheckpoint",
     "ContainerStop",
     "ContainerLog",

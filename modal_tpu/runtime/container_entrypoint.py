@@ -336,6 +336,9 @@ async def run_input_loop(service: Service, io: ContainerIOManager) -> None:
             t.add_done_callback(_on_done)
         if running:
             await asyncio.gather(*running, return_exceptions=True)
+        # outputs stashed for a next exchange poll that will never come
+        # (kill_switch / scaledown exit) flush on the split path
+        await io.flush_pending_exchange()
         if first_exc:
             raise first_exc[0]
     except BaseException:
@@ -360,7 +363,10 @@ async def run_web_endpoint(
 
     function_def = container_args.function_def
     webhook_type = function_def.webhook_type
-    callable_ = service.get_callable()
+    # class-based services name their web method (cls.py from_local); plain
+    # functions serve their single callable
+    web_method = function_def.experimental_options.get("web_method_name", "")
+    callable_ = service.get_callable(web_method)
     if webhook_type == api_pb2.WEB_ENDPOINT_TYPE_ASGI_APP:
         asgi = callable_()  # user factory returns the ASGI app
     elif webhook_type == api_pb2.WEB_ENDPOINT_TYPE_WSGI_APP:

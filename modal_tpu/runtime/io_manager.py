@@ -31,6 +31,24 @@ from . import execution_context
 MAX_OUTPUT_BATCH_SIZE = 20  # reference container_io_manager.py:874
 
 
+def _is_unimplemented(exc: BaseException) -> bool:
+    import grpc
+
+    code = getattr(exc, "code", None)
+    try:
+        return callable(code) and code() == grpc.StatusCode.UNIMPLEMENTED
+    except Exception:  # pragma: no cover — foreign exception shapes
+        return False
+
+
+def exchange_enabled() -> bool:
+    """MODAL_TPU_DISPATCH_EXCHANGE (default on): merge a finished input's
+    FunctionPutOutputs into the next FunctionGetInputs as ONE
+    FunctionExchange RPC — the remaining dispatch-floor lever named by
+    docs/DISPATCH.md (one round trip per container turnaround, not two)."""
+    return os.environ.get("MODAL_TPU_DISPATCH_EXCHANGE", "1") not in ("0", "false", "no")
+
+
 @dataclass
 class IOContext:
     """One unit of user work: a single input, or a batch of inputs assembled
@@ -110,6 +128,13 @@ class ContainerIOManager:
         # coalesced output publication (_utils/coalescer.py), created lazily
         # on the serving loop
         self._out_batcher = None
+        # merged-turnaround exchange (docs/DISPATCH.md): outputs finishing
+        # while the input loop is PARKED on a slot ride the next claim as
+        # one FunctionExchange; outputs finishing mid-long-poll go direct
+        # (they must not wait out a 10s claim window)
+        self._pending_exchange: list[api_pb2.FunctionPutOutputsItem] = []
+        self._poll_in_flight = False
+        self._exchange_unsupported = False  # legacy server: remembered once
         ContainerIOManager._singleton = self
 
     @classmethod
@@ -273,9 +298,7 @@ class ContainerIOManager:
                     batch_linger_ms=self.function_def.batch_linger_ms,
                 )
                 request.function_id = self._function_id
-                resp = await retry_transient_errors(
-                    self.stub.FunctionGetInputs, request, attempt_timeout=15.0, max_retries=None
-                )
+                resp = await self._claim(request)
                 if resp.rate_limit_sleep_duration:
                     await asyncio.sleep(resp.rate_limit_sleep_duration)
                 items = [i for i in resp.inputs]
@@ -320,6 +343,76 @@ class ContainerIOManager:
                 slots_held = 0
 
     _function_id: str = ""
+
+    async def _claim(self, request: api_pb2.FunctionGetInputsRequest):
+        """One claim long-poll. When the exchange rung is up, any outputs
+        stashed by `push_outputs` while the loop was parked ride the same
+        RPC (FunctionExchange = PutOutputs + GetInputs in one turnaround);
+        UNIMPLEMENTED (legacy server) is remembered once and the split RPCs
+        take over — with the stashed outputs flushed first, dedupe-safe."""
+        put_items: list[api_pb2.FunctionPutOutputsItem] = []
+        if exchange_enabled() and not self._exchange_unsupported:
+            from ..observability.catalog import DISPATCH_EXCHANGES
+
+            put_items, self._pending_exchange = self._pending_exchange, []
+            ex_req = api_pb2.FunctionExchangeRequest(get=request)
+            if put_items:
+                ex_req.put.CopyFrom(
+                    api_pb2.FunctionPutOutputsRequest(outputs=put_items, task_id=self.task_id)
+                )
+            self._poll_in_flight = True
+            try:
+                # carried-payload accounting (with_outputs | claim_only)
+                # happens SERVER-side in services.FunctionExchange — the
+                # supervisor's registry is where operators (and tests) look
+                return await retry_transient_errors(
+                    self.stub.FunctionExchange, ex_req, attempt_timeout=15.0, max_retries=None
+                )
+            except Exception as exc:
+                if _is_unimplemented(exc):
+                    # legacy server: remember, flush the stash on the split
+                    # path (server dedupe by (input_id, retry_count) makes a
+                    # maybe-double send safe), fall through to the plain poll
+                    logger.debug("FunctionExchange unimplemented; using split RPCs")
+                    self._exchange_unsupported = True
+                    DISPATCH_EXCHANGES.inc(carried="fallback")
+                    if put_items:
+                        await self._put_outputs_direct(put_items)
+                else:
+                    # non-transient failure: the stash must survive this
+                    # claim attempt — re-stash so the retried poll (or the
+                    # exit flush) delivers it; dropping it would force the
+                    # inputs through lease-expiry re-execution
+                    self._pending_exchange[:0] = put_items
+                    raise
+            finally:
+                self._poll_in_flight = False
+        self._poll_in_flight = True
+        try:
+            return await retry_transient_errors(
+                self.stub.FunctionGetInputs, request, attempt_timeout=15.0, max_retries=None
+            )
+        finally:
+            self._poll_in_flight = False
+
+    async def _put_outputs_direct(self, items: list[api_pb2.FunctionPutOutputsItem]) -> None:
+        for start in range(0, len(items), MAX_OUTPUT_BATCH_SIZE):
+            await retry_transient_errors(
+                self.stub.FunctionPutOutputs,
+                api_pb2.FunctionPutOutputsRequest(
+                    outputs=items[start : start + MAX_OUTPUT_BATCH_SIZE], task_id=self.task_id
+                ),
+                max_retries=None,
+                additional_status_codes=[],
+            )
+
+    async def flush_pending_exchange(self) -> None:
+        """Drain outputs stashed for the next exchange when no next poll is
+        coming (terminate/scaledown exit) — delivery must not die with the
+        loop."""
+        if self._pending_exchange:
+            items, self._pending_exchange = self._pending_exchange, []
+            await self._put_outputs_direct(items)
 
     async def _fail_assembly(self, items: list, exc: BaseException) -> None:
         """Report an assembly (deserialize/blob-fetch) failure for one
@@ -378,7 +471,24 @@ class ContainerIOManager:
                     retry_count=ctx.retry_counts[i],
                 )
             )
-        if coalescing_enabled():
+        if (
+            exchange_enabled()
+            and not self._exchange_unsupported
+            and not self._poll_in_flight
+            and not self.terminate
+            # the piggyback stays one well-formed output batch; overflow
+            # (many concurrent inputs finishing in one park window) takes
+            # the direct paths below rather than building an oversized RPC
+            and len(self._pending_exchange) + len(items) <= MAX_OUTPUT_BATCH_SIZE
+        ):
+            # the input loop is parked on slot acquire (not mid-long-poll):
+            # these outputs ride the NEXT claim as one FunctionExchange —
+            # the slot release below is exactly what unblocks that claim, so
+            # publication happens at the head of the next poll instead of as
+            # its own round trip. Mid-poll finishes fall through to the
+            # direct paths (delivery must not wait out a 10s claim window).
+            self._pending_exchange.extend(items)
+        elif coalescing_enabled():
             # coalesced publication (ISSUE 8): concurrent inputs finishing
             # within one window share one RPC. The submit still completes
             # before the slot is released — delivery stays on the critical

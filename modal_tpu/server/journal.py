@@ -94,6 +94,7 @@ JOURNALED_RPCS = frozenset(
         "FunctionGetOutputs",  # journals consumption (clear_on_success takes)
         "FunctionStreamOutputs",  # journals consumption, same as the poll twin
         "FunctionPutOutputs",
+        "FunctionExchange",  # put side journals via _append_output; claims transient like FunctionGetInputs
         "FunctionCallCancel",
         "ContainerCheckpoint",  # resume tokens survive the restart
         "TaskResult",  # input retry/fail outcomes via _append_output/input_retry

@@ -17,6 +17,7 @@ SURVEY §7 hard part 1). Responsibilities:
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import secrets
 import time
@@ -201,6 +202,13 @@ class Scheduler:
             # concurrency 50 need 2 containers, not 8.
             max_conc = max(1, fn.definition.max_concurrent_inputs or 1)
             desired = -(-backlog // max_conc)  # ceil
+            # SLO autoscaling (ISSUE 9, docs/SERVING.md): serving/web
+            # functions have no input backlog — replicas are sized on the
+            # serving telemetry their containers push over heartbeats,
+            # against the declared TTFT/throughput targets.
+            slo_desired = self._slo_desired(fn, live)
+            if slo_desired is not None:
+                desired = slo_desired
             # Drain-time shaping from the container-reported call-time EWMA:
             # when the live fleet clears the backlog faster than a cold start
             # could help (~5s locally), adding containers only adds cold
@@ -216,6 +224,112 @@ class Scheduler:
                 if not await self._launch_task(fn):
                     break  # no capacity right now
         await self._sync_pool_directives(desired_pools)
+
+    # ------------------------------------------------------------------
+    # SLO autoscaling for serving functions (ISSUE 9, docs/SERVING.md)
+    # ------------------------------------------------------------------
+
+    SLO_SCALE_COOLDOWN_S = float(os.environ.get("MODAL_TPU_SLO_SCALE_COOLDOWN", "10"))
+    # scale down only when BOTH: p95 TTFT under half its target AND the
+    # fleet is running below this fraction of per-replica token capacity
+    SLO_SCALEDOWN_UTIL = 0.3
+
+    @staticmethod
+    def _serving_report(task: TaskState_) -> Optional[dict]:
+        """One task's last-pushed serving telemetry (the raw heartbeat JSON
+        stored by ContainerHeartbeat — per-replica by construction, unlike
+        the merged registry gauges)."""
+        raw = getattr(task, "telemetry_prev_json", "")
+        if not raw:
+            return None
+        try:
+            report = json.loads(raw)
+        except ValueError:
+            return None
+
+        def gauge(name: str) -> Optional[float]:
+            series = (report.get(name) or {}).get("series") or {}
+            try:
+                return float(series[""]) if "" in series else None
+            except (TypeError, ValueError):
+                return None
+
+        ttft_p95 = gauge("modal_tpu_serving_ttft_p95_seconds")
+        tokens_per_s = gauge("modal_tpu_serving_tokens_per_second")
+        queue_depth = gauge("modal_tpu_serving_queue_depth")
+        if ttft_p95 is None and tokens_per_s is None and queue_depth is None:
+            return None
+        return {
+            "ttft_p95_s": ttft_p95 or 0.0,
+            "tokens_per_s": tokens_per_s or 0.0,
+            "queue_depth": queue_depth or 0.0,
+        }
+
+    def _slo_desired(self, fn: FunctionState, live: list[str]) -> Optional[int]:
+        """Desired replica count from pushed serving telemetry, or None when
+        the function declares no SLO targets (backlog autoscaling applies).
+
+        Policy (one step per cooldown window, hysteresis between the up and
+        down thresholds so the count doesn't flap):
+        - UP   when any replica's pushed p95 TTFT exceeds target_ttft_ms, or
+               replicas report a non-empty admission queue;
+        - DOWN when every replica's p95 TTFT sits under half the target AND
+               mean tokens/s per replica is below SLO_SCALEDOWN_UTIL ×
+               target_tokens_per_replica.
+        """
+        settings = fn.autoscaler
+        ttft_slo_s = (settings.target_ttft_ms or 0.0) / 1000.0
+        tps_target = settings.target_tokens_per_replica or 0.0
+        if ttft_slo_s <= 0 and tps_target <= 0:
+            return None
+        reports = []
+        for tid in live:
+            task = self.s.tasks.get(tid)
+            if task is None:
+                continue
+            report = self._serving_report(task)
+            if report is not None:
+                reports.append(report)
+        current = len(live)
+        if not reports:
+            return max(current, settings.min_containers, 1)
+        desired = current
+        worst_ttft = max(r["ttft_p95_s"] for r in reports)
+        queued = sum(r["queue_depth"] for r in reports)
+        total_tps = sum(r["tokens_per_s"] for r in reports)
+        # a TTFT violation only counts while there IS traffic (queueing or
+        # tokens flowing): the pushed p95 gauge is the LAST window's value
+        # and goes stale when requests stop — without the activity gate a
+        # spike followed by silence would ratchet the fleet to max and pin
+        # it there (scale-down needs a sub-half-target p95 that an idle
+        # replica can never produce)
+        active = queued > 0 or total_tps > 0
+        violated = queued > 0 or (ttft_slo_s > 0 and worst_ttft > ttft_slo_s and active)
+        idle = (
+            (ttft_slo_s <= 0 or worst_ttft < 0.5 * ttft_slo_s or not active)
+            and queued == 0
+            and tps_target > 0
+            and total_tps / max(1, current) < self.SLO_SCALEDOWN_UTIL * tps_target
+        )
+        floor = max(settings.min_containers, 1)
+        ceiling = settings.max_containers or 8
+        now = time.time()
+        if now - fn.slo_last_scale_at >= self.SLO_SCALE_COOLDOWN_S:
+            if violated:
+                desired = min(current + 1, max(ceiling, floor))
+            elif idle:
+                desired = max(current - 1, floor)
+            if desired != current:
+                # stamp the cooldown only for a move that actually happens —
+                # a clamped no-op (already at min/max) must not delay the
+                # next legitimate step by a burned window
+                fn.slo_last_scale_at = now
+                logger.info(
+                    f"SLO autoscale {fn.tag}: {current} -> {desired} "
+                    f"(ttft_p95={worst_ttft * 1000:.0f}ms target={settings.target_ttft_ms:.0f}ms "
+                    f"queue={queued:.0f} tokens/s={total_tps:.0f})"
+                )
+        return max(desired, floor)
 
     async def _sync_pool_directives(self, desired: dict[str, int]) -> None:
         """Push warm-pool sizing diffs to workers (PoolDirective on the poll

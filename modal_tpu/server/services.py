@@ -1408,6 +1408,23 @@ class ModalTPUServicer:
         with self._journal_group():
             return await self._put_outputs(request)
 
+    async def FunctionExchange(self, request: api_pb2.FunctionExchangeRequest, context) -> api_pb2.FunctionGetInputsResponse:
+        """One container turnaround in one RPC (docs/DISPATCH.md): apply the
+        finished inputs' outputs (same journal group-commit + (input_id,
+        retry_count) dedupe as FunctionPutOutputs — a retried exchange cannot
+        double-deliver), then run the FunctionGetInputs long-poll. Outputs
+        land and notify waiters BEFORE the poll blocks, so caller-visible
+        delivery never waits out the claim window."""
+        from ..observability.catalog import DISPATCH_EXCHANGES
+
+        if request.HasField("put") and request.put.outputs:
+            DISPATCH_EXCHANGES.inc(carried="with_outputs")
+            with self._journal_group():
+                await self._put_outputs(request.put)
+        else:
+            DISPATCH_EXCHANGES.inc(carried="claim_only")
+        return await self.FunctionGetInputs(request.get, context)
+
     async def _put_outputs(self, request: api_pb2.FunctionPutOutputsRequest) -> api_pb2.FunctionPutOutputsResponse:
         # coalesced publication (io_manager's output MicroBatcher) delivers
         # many inputs' outputs in one RPC; the journal group above commits

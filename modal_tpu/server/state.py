@@ -131,6 +131,10 @@ class FunctionState:
     # FunctionGetInputs (io_manager.note_call_time) — shapes the autoscaler's
     # drain-time estimate (reference autoscaler surface app.py:778)
     reported_call_time: float = 0.0
+    # SLO autoscaling cooldown stamp (scheduler._slo_desired): serving
+    # replica counts move at most one step per window, so a TTFT spike can't
+    # slam min→max in one tick
+    slo_last_scale_at: float = 0.0
 
     @property
     def autoscaler(self) -> api_pb2.AutoscalerSettings:

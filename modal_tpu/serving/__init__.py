@@ -1,0 +1,45 @@
+"""Serving tier (docs/SERVING.md).
+
+Two halves share this package:
+
+- `reload` — `modal_tpu serve` hot-reload (deploy-in-subprocess, redeploy on
+  file change). Re-exported here so `modal_tpu.serving.serve_app` keeps its
+  pre-package import path.
+- `engine` / `api` / `service` — production inference serving: the
+  continuous-batching decode loop over a paged KV pool (models/paged_kv.py),
+  the SSE/JSON ASGI surface, and the `@app.cls` deployment helper. These are
+  lazy attributes: the engine pulls in jax, which the CLI/client surface
+  must not pay for.
+"""
+
+from .reload import serve_app, watch  # noqa: F401
+
+__all__ = [
+    "EngineStopped",
+    "GenRequest",
+    "ServingEngine",
+    "serve_app",
+    "watch",
+    "serving_asgi_app",
+    "llm_service",
+]
+
+_LAZY = {
+    "ServingEngine": ("engine", "ServingEngine"),
+    "GenRequest": ("engine", "GenRequest"),
+    "EngineStopped": ("engine", "EngineStopped"),
+    "serving_asgi_app": ("api", "serving_asgi_app"),
+    "llm_service": ("service", "llm_service"),
+}
+
+
+def __getattr__(name: str):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    module = importlib.import_module(f".{entry[0]}", __name__)
+    value = getattr(module, entry[1])
+    globals()[name] = value
+    return value

@@ -1,0 +1,297 @@
+"""HTTP surface of the serving tier: JSON + SSE over ASGI.
+
+Served by the same dependency-free ASGI machinery every web endpoint uses
+(runtime/asgi.py AsgiHttpServer in-container, `@web_server`'s proxy in
+front) — SSE needs nothing beyond incremental `http.response.body` chunks,
+which both hops already stream.
+
+Routes (docs/SERVING.md has the full contract):
+
+- ``POST /v1/generate`` — body ``{"prompt": [ids...]} | {"text": "..."}``
+  plus ``max_new_tokens``, ``stream``, ``request_id``, ``eos_token_id``.
+  ``stream=false`` answers one JSON object at completion; ``stream=true``
+  answers ``text/event-stream``: a ``start`` event carrying the request id,
+  one ``token`` event per generated token (``id`` doubles as the SSE event
+  id = token index), and a final ``done`` event.
+- ``GET /v1/result/{request_id}`` — the buffered result (blocks until the
+  request completes). This is the degradation target: a client whose SSE
+  stream dies mid-generation re-fetches here and gets every token exactly
+  once — the engine's per-request buffer, not the transport, is the source
+  of truth.
+- ``GET /v1/stats`` — engine stats; ``GET /healthz`` — liveness.
+
+Chaos: ``MODAL_TPU_CHAOS_SERVING_STREAM_RESETS=N`` aborts the next N SSE
+streams after their first token event (the serving twin of the dispatch
+plane's stream_reset knob) — tests/test_serving.py proves the buffered
+degrade loses nothing.
+
+``text`` prompts use byte-level tokens (ids 0-255), enough for demos on any
+config with vocab_size >= 256 — the model zoo ships no tokenizer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Callable, Optional
+
+from ..config import logger
+from ..observability import tracing
+from ..observability.catalog import SERVING_STREAM_EVENTS
+from .engine import EngineStopped, GenRequest, ServingEngine
+
+STREAM_RESET_ENV = "MODAL_TPU_CHAOS_SERVING_STREAM_RESETS"
+_chaos_resets: dict = {"remaining": None}
+
+
+def _consume_stream_reset() -> bool:
+    """Budgeted chaos knob, read lazily from env (containers get it via
+    function secrets/env like the other MODAL_TPU_CHAOS_* knobs)."""
+    if _chaos_resets["remaining"] is None:
+        try:
+            _chaos_resets["remaining"] = int(os.environ.get(STREAM_RESET_ENV, "0") or 0)
+        except ValueError:
+            logger.warning(f"ignoring malformed {STREAM_RESET_ENV}")
+            _chaos_resets["remaining"] = 0
+    if _chaos_resets["remaining"] > 0:
+        _chaos_resets["remaining"] -= 1
+        return True
+    return False
+
+
+def _reset_chaos_for_tests() -> None:
+    _chaos_resets["remaining"] = None
+
+
+class _StreamReset(Exception):
+    """Raised mid-SSE to kill the connection the way a transport loss
+    would: the response is already started, so the server truncates."""
+
+
+def _decode_prompt(body: dict, vocab_size: int) -> list[int]:
+    if "prompt" in body:
+        prompt = body["prompt"]
+        if not isinstance(prompt, list) or not all(isinstance(t, int) for t in prompt):
+            raise ValueError("'prompt' must be a list of int token ids")
+        bad = [t for t in prompt if not 0 <= t < vocab_size]
+        if bad:
+            raise ValueError(f"token ids out of range [0, {vocab_size}): {bad[:5]}")
+        return prompt
+    if "text" in body:
+        if vocab_size < 256:
+            raise ValueError("byte-level 'text' prompts need vocab_size >= 256")
+        data = str(body["text"]).encode("utf-8")
+        if not data:
+            raise ValueError("empty text prompt")
+        return list(data)
+    raise ValueError("body needs 'prompt' (token ids) or 'text'")
+
+
+def _sse(event: str, data: dict, event_id: Optional[int] = None) -> bytes:
+    lines = [f"event: {event}"]
+    if event_id is not None:
+        lines.append(f"id: {event_id}")
+    lines.append(f"data: {json.dumps(data, separators=(',', ':'))}")
+    return ("\n".join(lines) + "\n\n").encode()
+
+
+def _result_payload(req: GenRequest, vocab_size: int) -> dict:
+    out = {
+        "request_id": req.id,
+        "tokens": list(req.tokens),
+        "num_tokens": len(req.tokens),
+        "ttft_s": req.ttft_s,
+        "preemptions": req.preemptions,
+    }
+    if req.error:
+        out["error"] = req.error
+    if vocab_size >= 256 and all(t < 256 for t in req.tokens):
+        try:
+            out["text"] = bytes(req.tokens).decode("utf-8", "replace")
+        except Exception:  # pragma: no cover
+            pass
+    return out
+
+
+def serving_asgi_app(engine: ServingEngine, max_new_tokens_limit: int = 4096) -> Callable:
+    """Build the ASGI 3 application fronting `engine`. Plug it into
+    `@modal_tpu.asgi_app()` (serving/service.py does) or serve it directly
+    with runtime/asgi.py's AsgiHttpServer (tools/bench_serving.py does)."""
+
+    vocab_size = engine.cfg.vocab_size
+
+    async def send_json(send, status: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        await send(
+            {
+                "type": "http.response.start",
+                "status": status,
+                "headers": [
+                    (b"content-type", b"application/json"),
+                    (b"content-length", str(len(data)).encode()),
+                ],
+            }
+        )
+        await send({"type": "http.response.body", "body": data})
+
+    async def read_body(receive) -> bytes:
+        body = b""
+        while True:
+            msg = await receive()
+            if msg["type"] != "http.request":
+                return body
+            body += msg.get("body", b"")
+            if not msg.get("more_body"):
+                return body
+
+    async def handle_generate(scope, receive, send) -> None:
+        try:
+            raw = await read_body(receive)
+            body = json.loads(raw) if raw else {}
+            if not isinstance(body, dict):
+                raise ValueError("JSON body must be an object")
+            prompt = _decode_prompt(body, vocab_size)
+            max_new = int(body.get("max_new_tokens", 64))
+            if not 1 <= max_new <= max_new_tokens_limit:
+                raise ValueError(f"max_new_tokens must be in [1, {max_new_tokens_limit}]")
+            stream = bool(body.get("stream", False))
+            eos = body.get("eos_token_id")
+            request_id = str(body.get("request_id", ""))
+        except (ValueError, json.JSONDecodeError) as exc:
+            await send_json(send, 400, {"error": str(exc)})
+            return
+        try:
+            req = engine.submit(
+                prompt, max_new, request_id=request_id,
+                eos_token_id=int(eos) if eos is not None else None,
+            )
+        except EngineStopped as exc:
+            # backpressure/drain, not a caller mistake: 429 tells clients to
+            # retry here, 503 tells them to retry another replica
+            await send_json(send, 429 if "queue full" in str(exc) else 503, {"error": str(exc)})
+            return
+        except ValueError as exc:
+            await send_json(send, 400, {"error": str(exc)})
+            return
+        if not stream:
+            await wait_done(req)
+            payload = _result_payload(req, vocab_size)
+            await send_json(send, 500 if req.error else 200, payload)
+            return
+        await stream_sse(send, req)
+
+    async def wait_done(req: GenRequest) -> None:
+        offset, done = 0, False
+        while not done:
+            new, done = await req.wait_new_async(offset, timeout=None)
+            offset += len(new)
+
+    async def stream_sse(send, req: GenRequest) -> None:
+        """SSE delivery with a cursor into the request's buffer. A transport
+        death (or the chaos reset) mid-stream leaves the buffer intact —
+        the client re-reads via GET /v1/result/{id}."""
+        await send(
+            {
+                "type": "http.response.start",
+                "status": 200,
+                "headers": [
+                    (b"content-type", b"text/event-stream"),
+                    (b"cache-control", b"no-cache"),
+                    (b"x-accel-buffering", b"no"),
+                ],
+            }
+        )
+        SERVING_STREAM_EVENTS.inc(event="open")
+        chaos_this_stream = _consume_stream_reset()
+        with tracing.span("serving.stream", attrs={"request_id": req.id}):
+            await send(
+                {
+                    "type": "http.response.body",
+                    "body": _sse("start", {"request_id": req.id}),
+                    "more_body": True,
+                }
+            )
+            offset = 0
+            try:
+                while True:
+                    tokens, done = await req.wait_new_async(offset, timeout=30.0)
+                    for i, tok in enumerate(tokens):
+                        await send(
+                            {
+                                "type": "http.response.body",
+                                "body": _sse("token", {"token": tok, "i": offset + i}, event_id=offset + i),
+                                "more_body": True,
+                            }
+                        )
+                        SERVING_STREAM_EVENTS.inc(event="token")
+                        if chaos_this_stream:
+                            raise _StreamReset()
+                    offset += len(tokens)
+                    if done:
+                        break
+                    if not tokens:
+                        # keep-alive comment per SSE spec (idle admission queue)
+                        await send(
+                            {"type": "http.response.body", "body": b": keep-alive\n\n", "more_body": True}
+                        )
+                await send(
+                    {
+                        "type": "http.response.body",
+                        "body": _sse("done", _result_payload(req, vocab_size)),
+                        "more_body": True,
+                    }
+                )
+                SERVING_STREAM_EVENTS.inc(event="done")
+                await send({"type": "http.response.body", "body": b""})
+            except _StreamReset:
+                SERVING_STREAM_EVENTS.inc(event="reset")
+                logger.warning(f"serving: chaos stream reset for {req.id} (buffer intact)")
+                raise ConnectionResetError(f"chaos serving stream reset ({req.id})")
+
+    async def handle_result(scope, receive, send, request_id: str) -> None:
+        await read_body(receive)
+        req = engine.get(request_id)
+        if req is None:
+            await send_json(send, 404, {"error": f"unknown request {request_id!r}"})
+            return
+        SERVING_STREAM_EVENTS.inc(event="buffered_fallback")
+        await wait_done(req)
+        await send_json(send, 500 if req.error else 200, _result_payload(req, vocab_size))
+
+    async def app(scope, receive, send):
+        if scope["type"] == "lifespan":
+            from ..runtime.asgi import _lifespan_protocol
+
+            return await _lifespan_protocol(receive, send)
+        if scope["type"] != "http":
+            return
+        path = scope.get("path", "/")
+        method = scope.get("method", "GET").upper()
+        try:
+            if path == "/healthz" and method == "GET":
+                await read_body(receive)
+                await send_json(send, 200, {"ok": True, "time": time.time()})
+            elif path == "/v1/stats" and method == "GET":
+                await read_body(receive)
+                await send_json(send, 200, engine.stats())
+            elif path == "/v1/generate" and method == "POST":
+                await handle_generate(scope, receive, send)
+            elif path.startswith("/v1/result/") and method == "GET":
+                await handle_result(scope, receive, send, path[len("/v1/result/"):])
+            else:
+                await read_body(receive)
+                await send_json(send, 404, {"error": f"no route {method} {path}"})
+        except (ConnectionResetError, BrokenPipeError):
+            raise
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — one request must not kill the app
+            logger.warning(f"serving api error on {method} {path}: {exc}")
+            try:
+                await send_json(send, 500, {"error": f"{type(exc).__name__}: {exc}"})
+            except Exception:
+                pass
+
+    return app

@@ -1,0 +1,605 @@
+"""Continuous-batching decode loop over the paged KV pool.
+
+The dense serving story (`sampling.greedy_generate`) runs one request at a
+time: tokens/s/chip is batch=1 math and every queued request's TTFT includes
+the whole queue ahead of it. This engine keeps ONE decode loop running and
+lets requests join and leave it per step:
+
+- **slots**: the decode batch has `max_slots` fixed positions; a request is
+  admitted into a free slot the moment one (plus KV pages) is available —
+  mid-decode, without restarting in-flight sequences (`paged_decode_step` is
+  one fixed-shape executable; admission is data, not shape).
+- **prefill/decode separation**: prompts prefill in `prefill_chunk`-token
+  slices, one slice per loop iteration, interleaved with decode steps — a
+  4k-token prompt cannot stall everyone else's token cadence for its whole
+  prefill, it pays its own TTFT instead.
+- **paged KV**: all slots share one page pool (models/paged_kv.py). HBM is
+  bounded by the pool, not `num_requests × max_len`; when the pool runs dry
+  the youngest request is preempted (pages freed, request requeued with its
+  generated prefix — tokens already streamed are never re-emitted).
+- **streaming**: generated tokens append to a per-request buffer;
+  consumers (SSE handlers, `.result()`) read with a cursor, so a dropped
+  stream re-reads from the buffer — exactly-once regardless of transport.
+
+The loop runs on its own thread (jax releases the GIL during device
+compute); `submit()` is thread-safe and returns immediately — TTFT is the
+engine's admission+prefill latency, not queue drain.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..config import logger
+from ..observability import tracing
+from ..observability.catalog import (
+    KV_PAGES_ALLOCATED,
+    KV_PAGES_FREE,
+    SERVING_BATCH_OCCUPANCY,
+    SERVING_PREEMPTIONS,
+    SERVING_QUEUE_DEPTH,
+    SERVING_REQUESTS,
+    SERVING_TOKENS_PER_S,
+    SERVING_TTFT,
+    SERVING_TTFT_P95,
+)
+
+_req_counter = itertools.count()
+
+
+class EngineStopped(RuntimeError):
+    pass
+
+
+class GenRequest:
+    """One generation request: prompt in, token stream out.
+
+    `tokens` is the buffered, exactly-once source of truth — stream
+    consumers keep a cursor into it (`wait_new` / `wait_new_async`), so a
+    reset stream resumes (or degrades to a buffered read) without loss or
+    duplication."""
+
+    def __init__(
+        self,
+        prompt: list[int],
+        max_new_tokens: int,
+        request_id: str = "",
+        eos_token_id: Optional[int] = None,
+        trace_context: Optional[Any] = None,
+    ):
+        self.id = request_id or f"gr-{next(_req_counter)}-{os.getpid()}"
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.trace_context = trace_context
+        self.created_at = time.time()
+        self.admitted_at = 0.0
+        self.first_token_at = 0.0
+        self.finished_at = 0.0
+        self.preemptions = 0
+        self.tokens: list[int] = []
+        self.done = False
+        self.error: Optional[str] = None
+        self._cond = threading.Condition()
+        self._async_waiters: list[tuple[Any, Any]] = []  # (loop, asyncio.Event)
+
+    # -- engine side --------------------------------------------------------
+
+    def _append(self, token: int) -> None:
+        with self._cond:
+            if self.first_token_at == 0.0:
+                self.first_token_at = time.time()
+            self.tokens.append(token)
+            self._wake()
+
+    def _finish(self, error: Optional[str] = None) -> None:
+        with self._cond:
+            self.done = True
+            self.error = error
+            self.finished_at = time.time()
+            self._wake()
+
+    def _wake(self) -> None:
+        self._cond.notify_all()
+        for loop, event in self._async_waiters:
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except RuntimeError:
+                pass  # consumer's loop is gone; the buffer still has the tokens
+        self._async_waiters.clear()
+
+    # -- consumer side ------------------------------------------------------
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at:
+            return self.first_token_at - self.created_at
+        return None
+
+    def wait_new(self, offset: int, timeout: Optional[float] = None) -> tuple[list[int], bool]:
+        """Block until tokens beyond `offset` exist (or done/timeout);
+        returns (new_tokens, done)."""
+        with self._cond:
+            self._cond.wait_for(lambda: len(self.tokens) > offset or self.done, timeout)
+            return list(self.tokens[offset:]), self.done
+
+    async def wait_new_async(self, offset: int, timeout: Optional[float] = None) -> tuple[list[int], bool]:
+        """Async twin of `wait_new` (no thread parked per waiting stream —
+        the engine wakes the consumer's loop directly)."""
+        import asyncio
+
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        while True:
+            with self._cond:
+                if len(self.tokens) > offset or self.done:
+                    return list(self.tokens[offset:]), self.done
+                event = asyncio.Event()
+                self._async_waiters.append((asyncio.get_running_loop(), event))
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return list(self.tokens[offset:]), self.done
+            try:
+                await asyncio.wait_for(event.wait(), remaining)
+            except asyncio.TimeoutError:
+                return list(self.tokens[offset:]), self.done
+
+    def result(self, timeout: Optional[float] = None) -> list[int]:
+        """Block until completion; returns the full generated token list."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self.done, timeout):
+                raise TimeoutError(f"request {self.id} not done after {timeout}s")
+        if self.error:
+            raise EngineStopped(self.error)
+        return list(self.tokens)
+
+
+@dataclass
+class _Slot:
+    request: GenRequest
+    pages: list[int] = field(default_factory=list)
+    pos: int = 0  # tokens written to the slot's pages (mirrors seq_lens)
+    prefill_tokens: list[int] = field(default_factory=list)  # prompt (+ regenerated prefix)
+    prefill_done: int = 0  # tokens of prefill_tokens already written
+    cur_token: int = 0  # token to feed the next decode step
+    state: str = "prefill"  # "prefill" | "decode"
+    admitted_step: int = 0
+
+
+class ServingEngine:
+    """The serving tier's model runtime: one shared paged-KV pool + one
+    continuous decode loop (docs/SERVING.md)."""
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: Any,
+        *,
+        max_slots: int = 8,
+        num_pages: Optional[int] = None,
+        page_size: int = 16,
+        pages_per_slot: Optional[int] = None,
+        prefill_chunk: int = 128,
+        max_waiting: int = 1024,
+    ):
+        import math
+
+        from ..models.paged_kv import DEFAULT_PAGE_SIZE, PageAllocator, PagedKVCache
+
+        if getattr(cfg, "is_moe", False):
+            raise ValueError("MoE configs are not paged-servable yet (dense FFN only)")
+        page_size = page_size or DEFAULT_PAGE_SIZE
+        pages_per_slot = pages_per_slot or math.ceil(cfg.max_seq_len / page_size)
+        if num_pages is None:
+            # default pool: half of what dense per-slot max_len caches would
+            # take — the whole point is sharing
+            num_pages = 1 + max(2 * max_slots, (max_slots * pages_per_slot) // 2)
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.pages_per_slot = pages_per_slot
+        self.prefill_chunk = prefill_chunk
+        self.max_context = pages_per_slot * page_size
+        self.max_waiting = max_waiting
+        self.allocator = PageAllocator(num_pages, page_size)
+        self.cache = PagedKVCache.create(cfg, max_slots, num_pages, page_size, pages_per_slot)
+        self.slots: list[Optional[_Slot]] = [None] * max_slots
+        self.waiting: deque[GenRequest] = deque()
+        self.requests: dict[str, GenRequest] = {}  # id -> request (bounded retention)
+        self._retired: deque[str] = deque()
+        self.step_count = 0
+        self.tokens_generated = 0
+        self.requests_completed = 0
+        self.preemptions = 0
+        self._ttft_window: deque[float] = deque(maxlen=100)
+        self._rate_window: deque[tuple[float, int]] = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, name="serving-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        # fail anything still in flight — consumers must not hang
+        with self._lock:
+            leftovers = [s.request for s in self.slots if s is not None] + list(self.waiting)
+            self.slots = [None] * self.max_slots
+            self.waiting.clear()
+            for req in leftovers:
+                self._retired.append(req.id)
+        for req in leftovers:
+            req._finish(error="engine stopped")
+            SERVING_REQUESTS.inc(outcome="stopped")
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: list[int],
+        max_new_tokens: int = 64,
+        *,
+        request_id: str = "",
+        eos_token_id: Optional[int] = None,
+    ) -> GenRequest:
+        """Thread-safe admission into the running loop. Returns immediately;
+        consume via the returned request's wait_new/result."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.max_context:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) exceeds the "
+                f"engine's context limit ({self.max_context} = pages_per_slot × page_size)"
+            )
+        total_pages = self.allocator.num_pages - 1
+        if self.allocator.pages_for(len(prompt) + max_new_tokens) > total_pages:
+            raise ValueError(
+                f"request needs more KV pages than the whole pool ({total_pages})"
+            )
+        req = GenRequest(
+            prompt, max_new_tokens, request_id=request_id, eos_token_id=eos_token_id,
+            trace_context=tracing.current_context(),
+        )
+        with self._work:
+            if self._stop:
+                raise EngineStopped("engine stopped")
+            if len(self.waiting) >= self.max_waiting:
+                raise EngineStopped(f"admission queue full ({self.max_waiting})")
+            self.waiting.append(req)
+            self.requests[req.id] = req
+            self._retire_requests()
+            SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
+            self._work.notify_all()
+        return req
+
+    def get(self, request_id: str) -> Optional[GenRequest]:
+        with self._lock:
+            return self.requests.get(request_id)
+
+    def _retire_requests(self, keep: int = 512) -> None:
+        # bounded completed-request retention (buffered-degrade reads window)
+        while len(self.requests) > keep and self._retired:
+            victim = self._retired.popleft()
+            self.requests.pop(victim, None)
+
+    # -- the loop -----------------------------------------------------------
+
+    def _run(self) -> None:
+        logger.debug(
+            f"serving engine up: slots={self.max_slots} pages={self.allocator.num_pages - 1} "
+            f"page_size={self.page_size} pool={self.cache.pool_bytes() / 1e6:.1f}MB"
+        )
+        while True:
+            with self._work:
+                while not self._stop and not self.waiting and not any(self.slots):
+                    self._work.wait(timeout=0.5)
+                if self._stop:
+                    return
+            try:
+                self._admit()
+                self._prefill_one()
+                self._decode_step()
+            except Exception as exc:  # noqa: BLE001 — loop must survive
+                logger.exception(f"serving loop iteration failed: {exc}")
+                self._fail_all(f"engine loop error: {type(exc).__name__}: {exc}")
+
+    def _fail_all(self, message: str) -> None:
+        with self._lock:
+            victims = [s for s in self.slots if s is not None]
+            self.slots = [None] * self.max_slots
+            # error-finished requests must still age out of the registry
+            # (the retirement queue is what _retire_requests evicts from)
+            for s in victims:
+                self._retired.append(s.request.id)
+        for s in victims:
+            self.allocator.free(s.pages)
+            s.request._finish(error=message)
+            SERVING_REQUESTS.inc(outcome="error")
+        self._sync_page_gauges()
+
+    def _sync_page_gauges(self) -> None:
+        KV_PAGES_ALLOCATED.set(float(self.allocator.allocated_pages))
+        KV_PAGES_FREE.set(float(self.allocator.free_pages))
+
+    def _admit(self) -> None:
+        """Move waiting requests into free slots while pages allow. FIFO —
+        skipping the head for a smaller request would starve long prompts."""
+        import jax.numpy as jnp
+
+        from ..models.paged_kv import PagePoolExhausted, assign_pages
+
+        while True:
+            with self._lock:
+                if not self.waiting:
+                    return
+                free_idx = next((i for i, s in enumerate(self.slots) if s is None), None)
+                if free_idx is None:
+                    return
+                req = self.waiting[0]
+                prefill_tokens = req.prompt + req.tokens  # preempted: regen prefix too
+                need = self.allocator.pages_for(len(prefill_tokens) + 1)
+                if not self.allocator.can_alloc(need):
+                    return  # pool dry; decode-side preemption or completions will free
+                self.waiting.popleft()
+                SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
+                try:
+                    pages = self.allocator.alloc(need)
+                except PagePoolExhausted:  # pragma: no cover — guarded above
+                    self.waiting.appendleft(req)
+                    return
+                slot = _Slot(
+                    request=req,
+                    pages=pages,
+                    prefill_tokens=prefill_tokens,
+                    admitted_step=self.step_count,
+                )
+                self.slots[free_idx] = slot
+            # pad the row to pages_per_slot: assign_pages keys an executable
+            # on the page-array SHAPE, so padded admissions all share one
+            # compile (growth adds single pages — one more shape, total two)
+            row = pages + [0] * (self.pages_per_slot - len(pages))
+            self.cache = assign_pages(self.cache, free_idx, 0, jnp.asarray(row, jnp.int32))
+            req.admitted_at = time.time()
+            self._sync_page_gauges()
+            if req.trace_context is not None:
+                tracing.record_span(
+                    "serving.admit",
+                    start=req.created_at,
+                    end=req.admitted_at,
+                    parent=req.trace_context,
+                    attrs={"request_id": req.id, "slot": free_idx, "pages": len(pages)},
+                )
+
+    def _prefill_one(self) -> None:
+        """Advance the oldest prefilling slot by one chunk. One chunk per
+        loop iteration: decode steps interleave, so in-flight token cadence
+        survives long-prompt arrivals."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.paged_kv import paged_prefill, prefill_bucket
+
+        with self._lock:
+            candidates = [
+                (i, s) for i, s in enumerate(self.slots) if s is not None and s.state == "prefill"
+            ]
+        if not candidates:
+            return
+        idx, slot = min(candidates, key=lambda t: t[1].admitted_step)
+        req = slot.request
+        chunk = slot.prefill_tokens[slot.prefill_done : slot.prefill_done + self.prefill_chunk]
+        bucket = prefill_bucket(len(chunk), self.max_context)
+        padded = np.zeros((bucket,), np.int32)
+        padded[: len(chunk)] = chunk
+        t0 = time.time()
+        logits, next_tok, self.cache = paged_prefill(
+            self.params,
+            self.cfg,
+            jnp.asarray(padded),
+            jnp.int32(len(chunk)),
+            self.cache,
+            jnp.int32(idx),
+            jnp.int32(slot.prefill_done),
+        )
+        slot.prefill_done += len(chunk)
+        slot.pos = slot.prefill_done
+        if slot.prefill_done >= len(slot.prefill_tokens):
+            # prefill complete: the model's continuation after the whole
+            # prefix is a NEW token — for a fresh request the first one
+            # (TTFT); for a preempted-and-readmitted one the next one
+            # (already-emitted tokens re-entered via prefill_tokens and are
+            # never re-appended — the continuation after them is new)
+            slot.state = "decode"
+            slot.cur_token = int(next_tok)
+            if req.trace_context is not None:
+                tracing.record_span(
+                    "serving.prefill",
+                    start=req.admitted_at or t0,
+                    end=time.time(),
+                    parent=req.trace_context,
+                    attrs={"request_id": req.id, "prompt_tokens": len(slot.prefill_tokens)},
+                )
+            req._append(int(next_tok))
+            if len(req.tokens) == 1:
+                self._note_ttft(req)
+            self.tokens_generated += 1
+            self._note_rate(1)
+            self._maybe_finish(idx, slot)
+
+    def _note_ttft(self, req: GenRequest) -> None:
+        ttft = req.first_token_at - req.created_at
+        SERVING_TTFT.observe(
+            ttft,
+            exemplar=req.trace_context.trace_id if req.trace_context is not None else None,
+        )
+        self._ttft_window.append(ttft)
+        window = sorted(self._ttft_window)
+        SERVING_TTFT_P95.set(window[min(len(window) - 1, int(0.95 * len(window)))])
+
+    def _note_rate(self, n: int) -> None:
+        now = time.time()
+        self._rate_window.append((now, n))
+        while self._rate_window and now - self._rate_window[0][0] > 10.0:
+            self._rate_window.popleft()
+        span = max(1e-3, now - self._rate_window[0][0]) if len(self._rate_window) > 1 else 1.0
+        SERVING_TOKENS_PER_S.set(sum(c for _, c in self._rate_window) / span)
+
+    def _grow_pages(self) -> bool:
+        """Before a decode step, every active slot whose next write crosses a
+        page boundary gets a fresh page; a dry pool preempts the youngest
+        decoding slot and retries. Returns False if nothing can decode."""
+        import jax.numpy as jnp
+
+        from ..models.paged_kv import assign_pages
+
+        while True:
+            with self._lock:
+                needy = [
+                    (i, s)
+                    for i, s in enumerate(self.slots)
+                    if s is not None and s.state == "decode" and s.pos >= len(s.pages) * self.page_size
+                ]
+            if not needy:
+                return True
+            short = len(needy) - self.allocator.free_pages
+            if short > 0:
+                if not self._preempt_youngest(exclude=()):
+                    return False  # nothing left to preempt
+                continue
+            for i, s in needy:
+                page = self.allocator.alloc(1)
+                s.pages.extend(page)
+                self.cache = assign_pages(
+                    self.cache, i, len(s.pages) - 1, jnp.asarray(page, jnp.int32)
+                )
+            self._sync_page_gauges()
+            return True
+
+    def _preempt_youngest(self, exclude: tuple[int, ...]) -> bool:
+        """Free the most-recently-admitted slot's pages and requeue its
+        request (generated prefix preserved: re-admission re-prefills
+        prompt+tokens, the stream never sees a duplicate)."""
+        from ..models.paged_kv import release_slot
+
+        with self._lock:
+            victims = [
+                (i, s)
+                for i, s in enumerate(self.slots)
+                if s is not None and i not in exclude
+            ]
+            if not victims:
+                return False
+            idx, slot = max(victims, key=lambda t: t[1].admitted_step)
+            self.slots[idx] = None
+            self.waiting.appendleft(slot.request)
+            SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
+        self.allocator.free(slot.pages)
+        self.cache = release_slot(self.cache, idx)
+        slot.request.preemptions += 1
+        self.preemptions += 1
+        SERVING_PREEMPTIONS.inc()
+        self._sync_page_gauges()
+        logger.debug(
+            f"serving: preempted request {slot.request.id} (slot {idx}, "
+            f"{len(slot.request.tokens)} tokens kept)"
+        )
+        return True
+
+    def _decode_step(self) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.paged_kv import paged_decode_step
+
+        if not self._grow_pages():
+            return
+        with self._lock:
+            decoding = [
+                (i, s) for i, s in enumerate(self.slots) if s is not None and s.state == "decode"
+            ]
+        if not decoding:
+            return
+        tokens = np.zeros((self.max_slots,), np.int32)
+        active = np.zeros((self.max_slots,), bool)
+        for i, s in decoding:
+            tokens[i] = s.cur_token
+            active[i] = True
+        _logits, next_tokens, self.cache = paged_decode_step(
+            self.params, self.cfg, jnp.asarray(tokens), self.cache, jnp.asarray(active)
+        )
+        next_host = np.asarray(next_tokens)
+        self.step_count += 1
+        SERVING_BATCH_OCCUPANCY.observe(float(len(decoding)))
+        emitted = 0
+        for i, s in decoding:
+            s.pos += 1  # the fed token was written at its position
+            tok = int(next_host[i])
+            s.cur_token = tok
+            s.request._append(tok)
+            emitted += 1
+            self._maybe_finish(i, s)
+        self.tokens_generated += emitted
+        self._note_rate(emitted)
+
+    def _maybe_finish(self, idx: int, slot: _Slot) -> None:
+        from ..models.paged_kv import release_slot
+
+        req = slot.request
+        finished = len(req.tokens) >= req.max_new_tokens or (
+            req.eos_token_id is not None and req.tokens and req.tokens[-1] == req.eos_token_id
+        )
+        if not finished:
+            return
+        with self._lock:
+            self.slots[idx] = None
+            self._retired.append(req.id)
+        self.allocator.free(slot.pages)
+        self.cache = release_slot(self.cache, idx)
+        self.requests_completed += 1
+        SERVING_REQUESTS.inc(outcome="ok")
+        self._sync_page_gauges()
+        req._finish()
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            active = sum(1 for s in self.slots if s is not None)
+            waiting = len(self.waiting)
+        return {
+            "max_slots": self.max_slots,
+            "active_slots": active,
+            "waiting": waiting,
+            "steps": self.step_count,
+            "tokens_generated": self.tokens_generated,
+            "requests_completed": self.requests_completed,
+            "preemptions": self.preemptions,
+            "kv_pages_total": self.allocator.num_pages - 1,
+            "kv_pages_allocated": self.allocator.allocated_pages,
+            "kv_pages_free": self.allocator.free_pages,
+            "kv_pages_high_water": self.allocator.high_water,
+            "kv_pool_bytes": self.cache.pool_bytes(),
+            "tokens_per_s": SERVING_TOKENS_PER_S.value(),
+            "ttft_p95_s": SERVING_TTFT_P95.value(),
+        }

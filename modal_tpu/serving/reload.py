@@ -14,7 +14,7 @@ import sys
 import time
 from typing import Optional
 
-from .config import logger
+from ..config import logger
 
 
 def _snapshot(paths: list[str]) -> dict[str, float]:

@@ -1,0 +1,101 @@
+"""`llm_service`: a deployable continuous-batching LLM endpoint in one call.
+
+Glues the pieces the serving tier is built from: an `@app.cls` whose
+`@enter(snap=True)` hook builds params + the `ServingEngine` (so the warm
+pool's snapshot/restore covers the loaded weights), an `@asgi_app` method
+returning the SSE/JSON surface (serving/api.py), and SLO-driven autoscaler
+settings (`target_ttft_ms` / `target_tokens_per_replica`) the scheduler
+sizes replicas with from pushed serving telemetry.
+
+    app = modal_tpu.App("llm")
+    Service = modal_tpu.serving.llm_service(
+        app, model="llama3-8b", tpu="v5e-8", checkpoint="/vol/ckpt",
+        max_slots=32, target_ttft_ms=500,
+    )
+    # deploy; POST {url}/v1/generate with {"prompt": [...], "stream": true}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def llm_service(
+    app: Any,
+    *,
+    model: str = "tiny",
+    checkpoint: Optional[str] = None,  # volume/local path for weights.load_params
+    quantize_int8: bool = False,
+    seed: int = 0,
+    max_slots: int = 8,
+    num_pages: Optional[int] = None,
+    page_size: int = 16,
+    pages_per_slot: Optional[int] = None,
+    prefill_chunk: int = 128,
+    name: str = "LLMService",
+    min_containers: int = 1,
+    max_containers: int = 4,
+    target_ttft_ms: float = 0.0,
+    target_tokens_per_replica: float = 0.0,
+    **cls_kwargs: Any,
+) -> Any:
+    """Register a serving class on `app` and return it (an `@app.cls`
+    result: instantiate + `.get_web_url()` under a run, or deploy it)."""
+    import modal_tpu
+
+    opts = dict(
+        serialized=True,
+        min_containers=min_containers,
+        max_containers=max_containers,
+        target_ttft_ms=target_ttft_ms,
+        target_tokens_per_replica=target_tokens_per_replica,
+    )
+    opts.update(cls_kwargs)
+
+    class _LLMService:
+        @modal_tpu.enter(snap=True)
+        def load(self):
+            # pre-snapshot: weights + engine warm-up land in the warm-state
+            # snapshot, so restored replicas skip straight to serving
+            import jax
+
+            from modal_tpu.models.llama import get_config, init_params
+
+            cfg = get_config(model)
+            if checkpoint:
+                from modal_tpu.models.weights import load_params
+
+                params = load_params(checkpoint, cfg)
+            else:
+                params = init_params(cfg, jax.random.PRNGKey(seed))
+            if quantize_int8:
+                from modal_tpu.models.quant import quantize_params
+
+                params = quantize_params(params)
+            from modal_tpu.serving.engine import ServingEngine
+
+            self.engine = ServingEngine(
+                params,
+                cfg,
+                max_slots=max_slots,
+                num_pages=num_pages,
+                page_size=page_size,
+                pages_per_slot=pages_per_slot,
+                prefill_chunk=prefill_chunk,
+            ).start()
+
+        @modal_tpu.exit()
+        def shutdown(self):
+            self.engine.stop()
+
+        @modal_tpu.asgi_app()
+        def serve(self):
+            from modal_tpu.serving.api import serving_asgi_app
+
+            return serving_asgi_app(self.engine)
+
+    # rename BEFORE decoration: @app.cls registers under __name__, and the
+    # deployed class/function tag must match the caller's `name`
+    _LLMService.__name__ = name
+    _LLMService.__qualname__ = name
+    return app.cls(**opts)(_LLMService)

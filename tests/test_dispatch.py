@@ -512,3 +512,96 @@ def test_claim_coalescing_under_concurrency(supervisor):
 
     with app.run():
         assert sorted(echo.map(range(64))) == list(range(64))
+
+
+# ---------------------------------------------------------------------------
+# merged turnaround: FunctionExchange (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_exchange_merges_put_and_claim(supervisor):
+    """Default mode: container turnarounds ride FunctionExchange — finished
+    outputs piggyback the next claim (one RPC, not two), results exactly
+    right. The in-process supervisor shares this registry, so the server-side
+    counters ARE the proof the merged RPC served the traffic."""
+    from modal_tpu.observability.catalog import DISPATCH_EXCHANGES
+
+    ex_before = RPC_TOTAL.value(method="FunctionExchange", code="ok")
+    carried_before = DISPATCH_EXCHANGES.value(carried="with_outputs")
+    app, noop = _make_noop("dispatch-exchange")
+    with app.run():
+        assert [noop.remote(i) for i in range(6)] == list(range(6))
+        assert sorted(noop.map(range(24))) == list(range(24))
+    assert RPC_TOTAL.value(method="FunctionExchange", code="ok") > ex_before
+    # sequential turnarounds (1 slot, backlog present) MUST have carried
+    # outputs on the claim — that is the round trip being shaved
+    assert DISPATCH_EXCHANGES.value(carried="with_outputs") > carried_before
+
+
+def test_exchange_env_kill_switch(supervisor, monkeypatch):
+    """MODAL_TPU_DISPATCH_EXCHANGE=0: the split FunctionPutOutputs +
+    FunctionGetInputs path serves everything, results identical."""
+    monkeypatch.setenv("MODAL_TPU_DISPATCH_EXCHANGE", "0")
+    ex_before = RPC_TOTAL.value(method="FunctionExchange", code="ok")
+    put_before = RPC_TOTAL.value(method="FunctionPutOutputs", code="ok")
+    app, noop = _make_noop("dispatch-exchange-off")
+    with app.run():
+        assert [noop.remote(i) for i in range(4)] == list(range(4))
+    assert RPC_TOTAL.value(method="FunctionExchange", code="ok") == ex_before
+    assert RPC_TOTAL.value(method="FunctionPutOutputs", code="ok") > put_before
+
+
+def test_exchange_journal_and_dedupe_semantics(supervisor):
+    """The exchange's put side rides the same funnel as FunctionPutOutputs:
+    journaled (classified in JOURNALED_RPCS) and deduped by (input_id,
+    retry_count) — a duplicate exchange cannot double-deliver."""
+    import asyncio
+
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.journal import JOURNALED_RPCS
+
+    assert "FunctionExchange" in JOURNALED_RPCS
+    app, noop = _make_noop("dispatch-exchange-dedupe")
+    with app.run():
+        assert noop.remote(5) == 5
+        # replay the SAME output item straight at the servicer: the dedupe
+        # keys must drop it (no second output appended to the call)
+        servicer = supervisor.servicer
+        state = servicer.s
+
+        async def _replay():
+            call = next(
+                c for c in state.function_calls.values()
+                if state.functions[c.function_id].tag.endswith("noop")
+            )
+            inp_id = call.input_ids[0]
+            outputs_before = len(call.outputs)
+            item = api_pb2.FunctionPutOutputsItem(
+                input_id=inp_id,
+                function_call_id=call.function_call_id,
+                idx=0,
+                retry_count=0,
+                result=api_pb2.GenericResult(status=api_pb2.GENERIC_STATUS_SUCCESS),
+            )
+            req = api_pb2.FunctionExchangeRequest(
+                put=api_pb2.FunctionPutOutputsRequest(
+                    outputs=[item], task_id=next(iter(state.tasks))
+                ),
+                get=api_pb2.FunctionGetInputsRequest(
+                    function_id=call.function_id, task_id=next(iter(state.tasks))
+                ),
+            )
+
+            class _Ctx:
+                def invocation_metadata(self):
+                    return ()
+
+                async def abort(self, code, details):
+                    raise AssertionError(f"abort {code}: {details}")
+
+            await servicer.FunctionExchange(req, _Ctx())
+            return outputs_before, len(call.outputs)
+
+        before, after = synchronizer.run(_replay())
+        assert after == before, "duplicate exchange output was not deduped"

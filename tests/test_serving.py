@@ -1,17 +1,539 @@
-"""`modal-tpu serve` hot reload, end-to-end (reference serving.py:92 —
-deploy-in-subprocess, redeploy on file change): the deployed function's
-behavior must actually CHANGE after the source file is edited."""
+"""ISSUE 9: production inference serving — paged KV cache, continuous
+batching, SSE streaming, SLO autoscaling.
 
+Contracts pinned here (docs/SERVING.md):
+- the block allocator survives alloc/free churn with zero stranded capacity
+  (pages are interchangeable; fragmentation is structural-zero);
+- paged attention matches the dense KVCache path numerically;
+- a request admitted MID-DECODE joins the running batch without restarting
+  in-flight sequences (bit-identical streams, step counter monotonic);
+- KV HBM is bounded by the page pool, never by num_requests × max_len —
+  pool pressure preempts + requeues instead of OOMing, with zero token
+  loss/duplication;
+- a chaos reset mid-SSE-stream degrades to the buffered result with every
+  token delivered exactly once;
+- the scheduler sizes serving replicas from pushed TTFT/tokens-per-s
+  telemetry against the declared SLO targets.
+
+Plus the pre-existing `modal-tpu serve` hot-reload e2e (reload.py).
+"""
+
+import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import textwrap
+import threading
 import time
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one shared engine geometry for every test: the jitted paged executables
+# (prefill buckets + the decode step) key on these shapes, so the whole
+# module pays each compile once
+SLOTS, PAGES, PAGE, PAGES_PER_SLOT = 4, 25, 16, 8
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from modal_tpu.models.llama import get_config, init_params
+
+    cfg = get_config("tiny")
+    return init_params(cfg, jax.random.PRNGKey(0)), cfg
+
+
+def _engine(params, cfg, **overrides):
+    from modal_tpu.serving.engine import ServingEngine
+
+    kwargs = dict(
+        max_slots=SLOTS, num_pages=PAGES, page_size=PAGE,
+        pages_per_slot=PAGES_PER_SLOT, prefill_chunk=32,
+    )
+    kwargs.update(overrides)
+    return ServingEngine(params, cfg, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache + block allocator
+# ---------------------------------------------------------------------------
+
+
+def test_page_allocator_alloc_free_churn():
+    """Exact-fit under arbitrary fragmentation history: any free page serves
+    any slot, so churn can never strand capacity."""
+    from modal_tpu.models.paged_kv import PageAllocator, PagePoolExhausted
+
+    alloc = PageAllocator(num_pages=9, page_size=16)  # 8 usable (page 0 reserved)
+    assert alloc.free_pages == 8
+    a = alloc.alloc(3)
+    b = alloc.alloc(3)
+    assert 0 not in a + b  # scratch page never handed out
+    assert len(set(a + b)) == 6
+    # fragment: free the middle allocation, then ask for more than any
+    # contiguous run — a block allocator with a page table doesn't care
+    alloc.free(b)
+    c = alloc.alloc(5)
+    assert len(c) == 5 and alloc.free_pages == 0
+    with pytest.raises(PagePoolExhausted):
+        alloc.alloc(1)
+    with pytest.raises(ValueError):
+        alloc.free([c[0], c[0]])  # double free in one call
+    alloc.free(c)
+    alloc.free(a)
+    assert alloc.free_pages == 8
+    with pytest.raises(ValueError):
+        alloc.free([a[0]])  # double free across calls
+    assert alloc.high_water == 8
+    assert alloc.pages_for(1) == 1 and alloc.pages_for(16) == 1 and alloc.pages_for(17) == 2
+
+
+def test_paged_prefill_matches_dense(tiny_model):
+    """Paged attention == dense KVCache attention (logit-level; greedy token
+    chains can diverge on exact bf16 ties, so the pin is numeric)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_tpu.models.llama import KVCache
+    from modal_tpu.models.paged_kv import (
+        PagedKVCache, PageAllocator, assign_pages, paged_decode_step, paged_prefill,
+    )
+    from modal_tpu.models.sampling import decode_step, prefill
+
+    params, cfg = tiny_model
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 10), 0, cfg.vocab_size).astype(jnp.int32)
+
+    dense = KVCache.create(cfg, 1, PAGES_PER_SLOT * PAGE)
+    dlogits, dense = prefill(params, cfg, prompt, dense)
+
+    cache = PagedKVCache.create(cfg, SLOTS, PAGES, PAGE, PAGES_PER_SLOT)
+    alloc = PageAllocator(PAGES, PAGE)
+    pages = alloc.alloc(3)
+    cache = assign_pages(cache, 0, 0, jnp.asarray(pages, jnp.int32))
+    # chunked prefill (2 chunks) must agree with the dense whole-prompt pass
+    padded1 = jnp.zeros((16,), jnp.int32).at[:6].set(prompt[0, :6])
+    _l, _t, cache = paged_prefill(params, cfg, padded1, jnp.int32(6), cache, jnp.int32(0), jnp.int32(0))
+    padded2 = jnp.zeros((16,), jnp.int32).at[:4].set(prompt[0, 6:])
+    plogits, _tok, cache = paged_prefill(params, cfg, padded2, jnp.int32(4), cache, jnp.int32(0), jnp.int32(6))
+    np.testing.assert_allclose(np.asarray(plogits), np.asarray(dlogits[0]), atol=3e-2, rtol=0)
+
+    # one decode step, same token fed both paths
+    tok = int(np.asarray(dlogits[0]).argmax())
+    dlog2, dense = decode_step(params, cfg, jnp.asarray([[tok]], jnp.int32), dense)
+    toks = jnp.zeros((SLOTS,), jnp.int32).at[0].set(tok)
+    active = jnp.zeros((SLOTS,), bool).at[0].set(True)
+    plog2, _n, cache = paged_decode_step(params, cfg, toks, cache, active)
+    np.testing.assert_allclose(np.asarray(plog2[0]), np.asarray(dlog2[0]), atol=3e-2, rtol=0)
+    assert int(cache.seq_lens[0]) == 11
+
+
+def test_total_kv_bytes_bounded_by_pool_not_requests(tiny_model):
+    """The acceptance inequality: engine KV bytes are the POOL's, and the
+    pool is smaller than dense per-request max_len caches for the same
+    concurrent load."""
+    from modal_tpu.models.llama import KVCache
+    from modal_tpu.models.paged_kv import PagedKVCache
+
+    params, cfg = tiny_model
+    paged = PagedKVCache.create(cfg, SLOTS, PAGES, PAGE, PAGES_PER_SLOT)
+    dense = KVCache.create(cfg, SLOTS, cfg.max_seq_len)
+    dense_bytes = int(dense.k.size + dense.v.size) * dense.k.dtype.itemsize
+    assert paged.pool_bytes() < dense_bytes / 2
+    # and the pool does not grow with request count: shapes are fixed
+    assert paged.k_pages.shape == (cfg.n_layers, PAGES, PAGE, cfg.n_kv_heads, cfg.head_dim)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching engine
+# ---------------------------------------------------------------------------
+
+
+def test_mid_decode_admission_joins_without_restart(tiny_model):
+    """THE continuous-batching pin: B admitted while A is mid-decode; A's
+    token stream is bit-identical to its solo run, the engine's step counter
+    never resets, and B's stream equals B's own solo run."""
+    import numpy as np
+
+    params, cfg = tiny_model
+    rng = np.random.default_rng(1)
+    prompt_a = rng.integers(0, cfg.vocab_size, size=9).tolist()
+    prompt_b = rng.integers(0, cfg.vocab_size, size=14).tolist()
+
+    eng = _engine(params, cfg).start()
+    try:
+        solo_a = eng.submit(prompt_a, max_new_tokens=30).result(timeout=120)
+        solo_b = eng.submit(prompt_b, max_new_tokens=12).result(timeout=120)
+
+        req_a = eng.submit(prompt_a, max_new_tokens=30)
+        # wait until A is decoding (first token out), then join B mid-decode
+        first, _done = req_a.wait_new(0, timeout=60)
+        assert first, "A never produced a first token"
+        steps_at_join = eng.step_count
+        req_b = eng.submit(prompt_b, max_new_tokens=12)
+        out_a = req_a.result(timeout=120)
+        out_b = req_b.result(timeout=120)
+    finally:
+        eng.stop()
+    assert out_a == solo_a, "in-flight sequence changed by a mid-decode admission"
+    assert out_b == solo_b, "joining request decoded differently than solo"
+    assert req_b.admitted_at > req_a.first_token_at, "B was not admitted mid-decode"
+    assert eng.step_count > steps_at_join, "decode loop restarted instead of continuing"
+    assert eng.requests_completed >= 4
+
+
+def test_variable_length_admission_and_limits(tiny_model):
+    import numpy as np
+
+    params, cfg = tiny_model
+    rng = np.random.default_rng(2)
+    eng = _engine(params, cfg).start()
+    try:
+        lengths = [(3, 5), (40, 21), (17, 8), (60, 30), (1, 1), (25, 13)]
+        reqs = [
+            (gen, eng.submit(rng.integers(0, cfg.vocab_size, size=plen).tolist(), max_new_tokens=gen))
+            for plen, gen in lengths
+        ]
+        for gen, r in reqs:
+            assert len(r.result(timeout=120)) == gen
+        # over-context and over-pool submissions fail loudly at submit
+        with pytest.raises(ValueError, match="context limit"):
+            eng.submit([1] * 100, max_new_tokens=PAGES_PER_SLOT * PAGE)
+        with pytest.raises(ValueError):
+            eng.submit([], max_new_tokens=1)
+    finally:
+        eng.stop()
+    assert eng.allocator.free_pages == PAGES - 1, "pages leaked across completions"
+
+
+def test_pool_pressure_preempts_and_requeues_without_token_loss(tiny_model):
+    """Eviction under pool exhaustion: more concurrent demand than pages —
+    the youngest decoding request is preempted (pages freed, requeued with
+    its generated prefix) and every stream still completes exactly-once,
+    bounded by the pool the whole time."""
+    import numpy as np
+
+    params, cfg = tiny_model
+    rng = np.random.default_rng(3)
+    eng = _engine(params, cfg).start()
+    try:
+        # solo references first (deterministic regardless of preemption)
+        prompts = [rng.integers(0, cfg.vocab_size, size=10).tolist() for _ in range(4)]
+        solos = [eng.submit(p, max_new_tokens=100).result(timeout=240) for p in prompts]
+        # 4 × (10 + 100 + 1) tokens needs 4×7=28 pages > 24 in the pool:
+        # someone must be preempted mid-decode
+        reqs = [eng.submit(p, max_new_tokens=100) for p in prompts]
+        outs = [r.result(timeout=240) for r in reqs]
+    finally:
+        eng.stop()
+    assert eng.preemptions > 0, "pool was never exhausted — test geometry wrong"
+    for solo, out in zip(solos, outs):
+        assert out == solo, "preemption changed or duplicated a token stream"
+    assert eng.allocator.high_water <= PAGES - 1
+    assert eng.allocator.free_pages == PAGES - 1
+
+
+def test_engine_matches_direct_paged_loop(tiny_model):
+    """Engine bookkeeping (chunked prefill, page growth, slot reuse) adds
+    nothing to the math: its stream equals a hand-rolled single-slot
+    paged_prefill + paged_decode_step loop."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from modal_tpu.models.paged_kv import (
+        PagedKVCache, PageAllocator, assign_pages, paged_decode_step, paged_prefill,
+    )
+
+    params, cfg = tiny_model
+    prompt = np.random.default_rng(4).integers(0, cfg.vocab_size, size=7).tolist()
+    gen = 20
+
+    cache = PagedKVCache.create(cfg, SLOTS, PAGES, PAGE, PAGES_PER_SLOT)
+    alloc = PageAllocator(PAGES, PAGE)
+    pages = alloc.alloc(alloc.pages_for(len(prompt) + gen + 1))
+    cache = assign_pages(cache, 0, 0, jnp.asarray(pages, jnp.int32))
+    padded = jnp.zeros((16,), jnp.int32).at[: len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+    _l, tok, cache = paged_prefill(
+        params, cfg, padded, jnp.int32(len(prompt)), cache, jnp.int32(0), jnp.int32(0)
+    )
+    reference = [int(tok)]
+    cur = jnp.zeros((SLOTS,), jnp.int32).at[0].set(tok)
+    active = jnp.zeros((SLOTS,), bool).at[0].set(True)
+    for _ in range(gen - 1):
+        _l, nxt, cache = paged_decode_step(params, cfg, cur, cache, active)
+        reference.append(int(nxt[0]))
+        cur = cur.at[0].set(nxt[0])
+
+    eng = _engine(params, cfg).start()
+    try:
+        out = eng.submit(prompt, max_new_tokens=gen).result(timeout=120)
+    finally:
+        eng.stop()
+    assert out == reference
+
+
+# ---------------------------------------------------------------------------
+# SSE surface + chaos degrade
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sse_server(tiny_model):
+    """The serving ASGI app behind the real AsgiHttpServer on a private
+    loop thread (exactly how a container serves it)."""
+    import asyncio
+
+    from modal_tpu.runtime.asgi import AsgiHttpServer
+    from modal_tpu.serving.api import serving_asgi_app
+
+    params, cfg = tiny_model
+    engine = _engine(params, cfg).start()
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    server = AsgiHttpServer(serving_asgi_app(engine))
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(30)
+    try:
+        yield server.port, engine
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        engine.stop()
+
+
+def _http(port: int, method: str, path: str, body: dict | None = None) -> tuple[bytes, list[float]]:
+    """Blocking HTTP/1.1 exchange; returns (raw_response, per-chunk arrival
+    times) so tests can see WHEN bytes landed."""
+    payload = json.dumps(body).encode() if body is not None else b""
+    s = socket.create_connection(("127.0.0.1", port), timeout=120)
+    try:
+        s.sendall(
+            f"{method} {path} HTTP/1.1\r\nhost: t\r\ncontent-length: {len(payload)}\r\n\r\n".encode()
+            + payload
+        )
+        chunks, stamps = [], []
+        while True:
+            data = s.recv(65536)
+            if not data:
+                break
+            chunks.append(data)
+            stamps.append(time.monotonic())
+        return b"".join(chunks), stamps
+    finally:
+        s.close()
+
+
+def _json_body(raw: bytes) -> dict:
+    return json.loads(raw.split(b"\r\n\r\n", 1)[1])
+
+
+def test_sse_streams_tokens_before_completion(sse_server):
+    """The TTFT point of streaming: token events arrive while generation is
+    still running, and the streamed sequence equals the buffered one."""
+    port, _engine_ = sse_server
+    raw, stamps = _http(
+        port, "POST", "/v1/generate",
+        {"prompt": [3, 1, 4, 1, 5], "max_new_tokens": 16, "stream": True},
+    )
+    text = raw.decode()
+    assert text.count("event: token") == 16
+    assert "event: done" in text
+    # bytes arrived incrementally (first token strictly before the last chunk)
+    assert len(stamps) > 1 and stamps[0] < stamps[-1]
+    streamed = [
+        json.loads(line[6:])["token"]
+        for line in text.splitlines()
+        if line.startswith("data: ") and '"token"' in line
+    ]
+    done = [json.loads(line[6:]) for line in text.splitlines() if line.startswith("data: ") and '"tokens"' in line]
+    assert streamed == done[0]["tokens"]
+    assert done[0]["ttft_s"] is not None
+
+
+def test_chaos_stream_reset_degrades_to_buffered_exactly_once(sse_server, monkeypatch):
+    """ISSUE 9 chaos case: the SSE stream is killed mid-flight; the client
+    falls back to the buffered read and sees every token exactly once."""
+    from modal_tpu.serving import api as serving_api
+
+    port, engine = sse_server
+    monkeypatch.setenv(serving_api.STREAM_RESET_ENV, "1")
+    serving_api._reset_chaos_for_tests()
+    try:
+        raw, _ = _http(
+            port, "POST", "/v1/generate",
+            {"prompt": [9, 8, 7, 6], "max_new_tokens": 12, "stream": True, "request_id": "chaos-sse"},
+        )
+        text = raw.decode()
+        assert "event: done" not in text, "stream should have been reset mid-flight"
+        streamed = [
+            json.loads(line[6:])["token"]
+            for line in text.splitlines()
+            if line.startswith("data: ") and '"token"' in line
+        ]
+        assert len(streamed) >= 1, "reset fired before the first token"
+        # degrade: buffered fetch returns the COMPLETE stream
+        raw2, _ = _http(port, "GET", "/v1/result/chaos-sse")
+        body = _json_body(raw2)
+        assert len(body["tokens"]) == 12
+        # exactly-once: what the broken stream delivered is a strict prefix
+        # of the buffer — nothing lost, nothing duplicated
+        assert body["tokens"][: len(streamed)] == streamed
+        # generation itself was never disturbed
+        req = engine.get("chaos-sse")
+        assert req is not None and req.error is None and req.done
+    finally:
+        serving_api._reset_chaos_for_tests()
+
+
+def test_api_validation_and_stats(sse_server):
+    port, _ = sse_server
+    raw, _ = _http(port, "POST", "/v1/generate", {"prompt": "nope"})
+    assert b"400" in raw.split(b"\r\n")[0]
+    raw, _ = _http(port, "POST", "/v1/generate", {"prompt": [999999], "max_new_tokens": 2})
+    assert b"400" in raw.split(b"\r\n")[0]
+    raw, _ = _http(port, "GET", "/v1/result/ghost")
+    assert b"404" in raw.split(b"\r\n")[0]
+    raw, _ = _http(port, "GET", "/v1/stats")
+    stats = _json_body(raw)
+    assert stats["kv_pages_total"] == PAGES - 1
+    raw, _ = _http(port, "GET", "/healthz")
+    assert _json_body(raw)["ok"] is True
+    # byte-level text prompts round-trip (vocab 512 >= 256)
+    raw, _ = _http(port, "POST", "/v1/generate", {"text": "hi", "max_new_tokens": 3})
+    assert len(_json_body(raw)["tokens"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# SLO autoscaling (scheduler)
+# ---------------------------------------------------------------------------
+
+
+def _serving_push_json(ttft_p95: float, tokens_per_s: float, queue: float = 0.0) -> str:
+    return json.dumps(
+        {
+            "modal_tpu_serving_ttft_p95_seconds": {"kind": "gauge", "series": {"": ttft_p95}},
+            "modal_tpu_serving_tokens_per_second": {"kind": "gauge", "series": {"": tokens_per_s}},
+            "modal_tpu_serving_queue_depth": {"kind": "gauge", "series": {"": queue}},
+        }
+    )
+
+
+def test_slo_autoscaler_desired_replicas(tmp_path):
+    """Scheduler unit: desired replica count follows pushed serving
+    telemetry against the declared SLO targets — up on TTFT violation or
+    queueing, down on deep idle, one step per cooldown window."""
+    from modal_tpu.proto import api_pb2
+    from modal_tpu.server.scheduler import Scheduler
+    from modal_tpu.server.state import FunctionState, ServerState, TaskState_
+
+    state = ServerState(str(tmp_path / "state"))
+    definition = api_pb2.Function(function_name="svc", webhook_type=api_pb2.WEB_ENDPOINT_TYPE_ASGI_APP)
+    definition.autoscaler_settings.min_containers = 1
+    definition.autoscaler_settings.max_containers = 8
+    definition.autoscaler_settings.target_ttft_ms = 500.0
+    definition.autoscaler_settings.target_tokens_per_replica = 1000.0
+    fn = FunctionState(function_id="fu-slo", app_id="ap-1", tag="svc", definition=definition)
+    state.functions["fu-slo"] = fn
+    sched = Scheduler(state)
+
+    def _task(tid: str, push: str) -> str:
+        state.tasks[tid] = TaskState_(task_id=tid, function_id="fu-slo", app_id="ap-1")
+        state.tasks[tid].telemetry_prev_json = push
+        return tid
+
+    # TTFT blown on one replica -> scale up one step
+    live = [_task("ta-1", _serving_push_json(ttft_p95=2.0, tokens_per_s=900))]
+    assert sched._slo_desired(fn, live) == 2
+    assert fn.slo_last_scale_at > 0
+    # cooldown: an immediate second evaluation holds at current size
+    assert sched._slo_desired(fn, live) == 1
+    fn.slo_last_scale_at = 0.0
+    # queueing with healthy TTFT also scales up
+    live = [_task("ta-2", _serving_push_json(ttft_p95=0.1, tokens_per_s=900, queue=3))]
+    assert sched._slo_desired(fn, live) == 2
+    fn.slo_last_scale_at = 0.0
+    # deep idle (TTFT way under, throughput way under capacity) scales down
+    live = [
+        _task("ta-3", _serving_push_json(ttft_p95=0.05, tokens_per_s=100)),
+        _task("ta-4", _serving_push_json(ttft_p95=0.04, tokens_per_s=80)),
+    ]
+    assert sched._slo_desired(fn, live) == 1
+    fn.slo_last_scale_at = 0.0
+    # healthy middle ground: hold
+    live = [_task("ta-5", _serving_push_json(ttft_p95=0.3, tokens_per_s=800))]
+    assert sched._slo_desired(fn, live) == 1
+    # STALE violation: a past TTFT spike with zero current traffic must NOT
+    # keep ratcheting the fleet up (the pushed p95 is last-window data)
+    live = [_task("ta-6", _serving_push_json(ttft_p95=5.0, tokens_per_s=0.0, queue=0))]
+    assert sched._slo_desired(fn, live) == 1
+    # and a clamped no-op (already at the min floor, deep idle) must not
+    # burn the cooldown window
+    assert fn.slo_last_scale_at == 0.0
+    # min_containers floor holds even with no telemetry yet
+    assert sched._slo_desired(fn, []) == 1
+    # no SLO targets declared -> backlog autoscaling (None)
+    definition.autoscaler_settings.target_ttft_ms = 0.0
+    definition.autoscaler_settings.target_tokens_per_replica = 0.0
+    assert sched._slo_desired(fn, live) is None
+
+
+def test_serving_families_ride_the_heartbeat_whitelist():
+    """Observability parity: the SLO signals must actually be pushed (and
+    the families must exist in the catalog so merges have a target)."""
+    from modal_tpu.observability import METRIC_CATALOG
+    from modal_tpu.observability.device_telemetry import PUSH_FAMILIES
+
+    for family in (
+        "modal_tpu_serving_ttft_seconds",
+        "modal_tpu_serving_ttft_p95_seconds",
+        "modal_tpu_serving_tokens_per_second",
+        "modal_tpu_serving_queue_depth",
+        "modal_tpu_serving_batch_occupancy",
+        "modal_tpu_kv_pages_allocated",
+        "modal_tpu_kv_pages_free",
+    ):
+        assert family in METRIC_CATALOG, family
+        assert family in PUSH_FAMILIES, family
+
+
+# ---------------------------------------------------------------------------
+# e2e: the @app.cls serving service through the real stack (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_llm_service_cls_end_to_end(supervisor):
+    """llm_service → @app.cls with @enter-built engine + @asgi_app method →
+    real container → web URL → tokens. The cls web-endpoint path and the
+    serving tier, one hop each."""
+    import urllib.request
+
+    import modal_tpu
+
+    app = modal_tpu.App("serving-e2e-cls")
+    Service = modal_tpu.serving.llm_service(
+        app, model="tiny", max_slots=4, num_pages=41, page_size=16,
+        name="TinyLLM", timeout=300,
+    )
+    with app.run():
+        url = Service.get_web_url(timeout=120)
+        body = json.dumps({"prompt": [1, 2, 3, 4, 5], "max_new_tokens": 8}).encode()
+        req = urllib.request.Request(
+            url + "/v1/generate", data=body, headers={"content-type": "application/json"}
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=240).read())
+        assert len(out["tokens"]) == 8
+        stats = json.loads(urllib.request.urlopen(url + "/v1/stats", timeout=30).read())
+        assert stats["requests_completed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# `modal-tpu serve` hot reload (pre-existing contract, serving/reload.py)
+# ---------------------------------------------------------------------------
 
 
 def _script(version: str) -> str:

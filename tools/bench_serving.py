@@ -1,0 +1,232 @@
+"""Serving-tier load generator: many concurrent SSE clients vs the
+sequential baseline.
+
+ISSUE 9 acceptance artifact: under >=32 concurrent clients the continuous-
+batching engine must deliver >=2x `tokens/s/chip` over the sequential
+`greedy_generate` baseline on the tiny config (CPU fallback), with p99 TTFT
+reported and the first SSE token observed BEFORE generation completes.
+
+What it runs:
+
+1. **baseline** — `sampling.greedy_generate` batch=1, one request at a time
+   (the pre-serving path: a queue of `.remote()`s decoding serially).
+2. **serving** — a `ServingEngine` behind the real ASGI HTTP server
+   (runtime/asgi.py AsgiHttpServer — the same server a container uses), hit
+   by N concurrent socket clients speaking `POST /v1/generate` with
+   `stream: true`; client-side timestamps give TTFT per request.
+
+Prints ONE line: SERVING_BENCH_RESULT {json}; bench.py folds the fields in
+as ``serving_*`` and tolerance-checks them against BENCH_serving.json (same
+>1.5x discipline as the dispatch floor guard).
+
+Run directly: JAX_PLATFORMS=cpu python tools/bench_serving.py [--clients 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+PROMPT_LEN = 12
+GEN_LEN = 32
+
+
+def _quantile(vals: list[float], q: float) -> float:
+    from modal_tpu.observability.critical_path import _quantile as cp_quantile
+
+    return cp_quantile(sorted(vals), q)
+
+
+def _baseline_tokens_per_s(params, cfg, prompts, warmup: int = 1) -> float:
+    """Sequential batch=1 greedy decode — the pre-serving throughput."""
+    import jax.numpy as jnp
+
+    from modal_tpu.models.sampling import greedy_generate
+
+    def run_one(prompt) -> None:
+        out = greedy_generate(
+            params, cfg, jnp.asarray([prompt], jnp.int32), GEN_LEN, cache_len=cfg.max_seq_len
+        )
+        out.block_until_ready()
+
+    for p in prompts[:warmup]:
+        run_one(p)  # compile prefill + fused decode chunks
+    t0 = time.perf_counter()
+    for p in prompts:
+        run_one(p)
+    wall = time.perf_counter() - t0
+    return len(prompts) * GEN_LEN / wall
+
+
+class _SSEClient:
+    """Minimal blocking SSE client over a raw socket (no deps; reads the
+    exact bytes the server framed, so first-token timing is honest)."""
+
+    def __init__(self, port: int):
+        self.port = port
+
+    def generate_stream(self, prompt: list[int], request_id: str) -> dict:
+        payload = json.dumps(
+            {"prompt": prompt, "max_new_tokens": GEN_LEN, "stream": True, "request_id": request_id}
+        ).encode()
+        t_submit = time.perf_counter()
+        s = socket.create_connection(("127.0.0.1", self.port), timeout=300)
+        try:
+            s.sendall(
+                b"POST /v1/generate HTTP/1.1\r\nhost: bench\r\ncontent-type: application/json\r\n"
+                + f"content-length: {len(payload)}\r\n\r\n".encode()
+                + payload
+            )
+            buf = b""
+            t_first = None
+            tokens: list[int] = []
+            done = False
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n\n" in buf:
+                    event, buf = buf.split(b"\n\n", 1)
+                    text = event.decode("utf-8", "replace")
+                    if "event: token" in text:
+                        if t_first is None:
+                            t_first = time.perf_counter()
+                        for line in text.splitlines():
+                            if line.startswith("data: "):
+                                tokens.append(json.loads(line[6:])["token"])
+                    elif "event: done" in text:
+                        done = True
+                if done:
+                    break
+        finally:
+            s.close()
+        t_done = time.perf_counter()
+        return {
+            "ttft_s": (t_first - t_submit) if t_first is not None else None,
+            "wall_s": t_done - t_submit,
+            "tokens": tokens,
+            "done": done,
+            # the streaming acceptance: the first token landed strictly
+            # before the request's generation completed
+            "first_token_before_completion": (
+                t_first is not None and done and t_first < t_done - 1e-4
+            ),
+        }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--clients", type=int, default=32, help="concurrent SSE clients")
+    parser.add_argument("--requests", type=int, default=64, help="total requests")
+    parser.add_argument("--baseline-requests", type=int, default=8)
+    args = parser.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("MODAL_TPU_JAX_PLATFORM", "cpu")
+
+    import jax
+    import numpy as np
+
+    from modal_tpu.models.llama import get_config, init_params
+    from modal_tpu.runtime.asgi import AsgiHttpServer
+    from modal_tpu.serving.api import serving_asgi_app
+    from modal_tpu.serving.engine import ServingEngine
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=PROMPT_LEN).tolist() for _ in range(args.requests)
+    ]
+    n_chips = max(1, jax.device_count()) if jax.default_backend() != "cpu" else 1
+
+    result: dict = {"clients": args.clients, "requests": args.requests, "gen_len": GEN_LEN}
+
+    # --- phase 1: sequential baseline ------------------------------------
+    base_tps = _baseline_tokens_per_s(params, cfg, prompts[: args.baseline_requests])
+    result["baseline_tokens_per_s_per_chip"] = round(base_tps / n_chips, 1)
+    print(f"bench[serving]: baseline {base_tps:.0f} tokens/s (batch=1 sequential)", file=sys.stderr)
+
+    # --- phase 2: continuous batching behind the real ASGI server --------
+    pool_pages = args.clients * ((PROMPT_LEN + GEN_LEN) // 16 + 2) + 8
+    engine = ServingEngine(
+        params,
+        cfg,
+        max_slots=args.clients,
+        num_pages=pool_pages,
+        page_size=16,
+        prefill_chunk=64,
+    ).start()
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    server = AsgiHttpServer(serving_asgi_app(engine))
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(30)
+    client = _SSEClient(server.port)
+
+    try:
+        # warmup: compile the prefill bucket + the max_slots decode executable
+        warm = client.generate_stream(prompts[0], "warmup-0")
+        assert warm["done"] and len(warm["tokens"]) == GEN_LEN, warm
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=args.clients) as pool:
+            outs = list(
+                pool.map(
+                    lambda iv: client.generate_stream(iv[1], f"bench-{iv[0]}"),
+                    enumerate(prompts),
+                )
+            )
+        wall = time.perf_counter() - t0
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+
+    bad = [o for o in outs if not o["done"] or len(o["tokens"]) != GEN_LEN]
+    if bad:
+        print(f"bench[serving]: {len(bad)} incomplete responses", file=sys.stderr)
+    ttfts = [o["ttft_s"] for o in outs if o["ttft_s"] is not None]
+    total_tokens = sum(len(o["tokens"]) for o in outs)
+    serving_tps = total_tokens / wall
+    stats = engine.stats()
+    engine.stop()
+
+    result.update(
+        {
+            "tokens_per_s_per_chip": round(serving_tps / n_chips, 1),
+            "speedup_vs_sequential": round(serving_tps / max(1e-9, base_tps), 2),
+            "requests_per_s": round(len(outs) / wall, 2),
+            "p50_ttft_s": round(_quantile(ttfts, 0.5), 4),
+            "p99_ttft_s": round(_quantile(ttfts, 0.99), 4),
+            "first_sse_token_before_completion": all(
+                o["first_token_before_completion"] for o in outs
+            ),
+            "incomplete_responses": len(bad),
+            "engine_steps": stats["steps"],
+            "kv_pages_high_water": stats["kv_pages_high_water"],
+            "kv_pages_total": stats["kv_pages_total"],
+            "kv_pool_mb": round(stats["kv_pool_bytes"] / 1e6, 2),
+            "preemptions": stats["preemptions"],
+        }
+    )
+    print(
+        f"bench[serving]: {serving_tps:.0f} tokens/s over {args.clients} clients "
+        f"({result['speedup_vs_sequential']}x sequential), "
+        f"TTFT p50 {result['p50_ttft_s']}s p99 {result['p99_ttft_s']}s",
+        file=sys.stderr,
+    )
+    print("SERVING_BENCH_RESULT " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,16 @@ NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
     "AttemptStartBatchResponse": [
         ("responses", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED, ".modal.tpu.api.AttemptStartResponse"),
     ],
+    # Dispatch-floor lever (ISSUE 9 satellite, docs/DISPATCH.md): the
+    # container's output publication and next-input claim share ONE RPC —
+    # the server applies `put` (same journal group-commit + (input_id,
+    # retry_count) dedupe as FunctionPutOutputs), then runs the
+    # FunctionGetInputs long-poll for `get`. Response reuses
+    # FunctionGetInputsResponse, so the claim path is wire-identical.
+    "FunctionExchangeRequest": [
+        ("put", 1, F.TYPE_MESSAGE, F.LABEL_OPTIONAL, ".modal.tpu.api.FunctionPutOutputsRequest"),
+        ("get", 2, F.TYPE_MESSAGE, F.LABEL_OPTIONAL, ".modal.tpu.api.FunctionGetInputsRequest"),
+    ],
 }
 
 # (message, field_name, field_number, field_type) — optionally a 5-tuple with
@@ -165,6 +175,13 @@ PATCHES: list[tuple[str, str, int, int]] = [
     ("ClientHelloResponse", "uds_path", 5, F.TYPE_STRING),
     ("ClientHelloResponse", "input_plane_uds_path", 6, F.TYPE_STRING),
     ("ClientHelloResponse", "blob_local_dir", 7, F.TYPE_STRING),
+    # Serving-tier SLO autoscaling (ISSUE 9, docs/SERVING.md): web/serving
+    # functions have no input backlog to scale on, so the scheduler sizes
+    # them from the serving telemetry containers push over heartbeats —
+    # scale up while pushed p95 TTFT exceeds target_ttft_ms, scale down
+    # while per-replica tokens/s sits far under target_tokens_per_replica
+    ("AutoscalerSettings", "target_ttft_ms", 5, F.TYPE_FLOAT),
+    ("AutoscalerSettings", "target_tokens_per_replica", 6, F.TYPE_FLOAT),
 ]
 
 HEADER = '''\
