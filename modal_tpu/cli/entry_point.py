@@ -421,20 +421,31 @@ def _render_critical_path(spans: list) -> None:
 @click.option("--state-dir", default=None, help="Supervisor state dir (see `app trace`).")
 @click.option("--last", default=0, help="Aggregate only the N most recent matching traces (0 = all).")
 @click.option("--json", "as_json", is_flag=True, help="Machine-readable aggregate.")
-def app_attribute(needle: str, state_dir: Optional[str], last: int, as_json: bool) -> None:
+@click.option(
+    "--serving",
+    is_flag=True,
+    help="Serving-timeline ruleset: decompose each request's TTFT and "
+    "per-token latency into queue/prefill/decode/stream (+ requeue) with "
+    "explicit gap residue (ISSUE 11; traces root at serving.request).",
+)
+def app_attribute(
+    needle: str, state_dir: Optional[str], last: int, as_json: bool, serving: bool
+) -> None:
     """Aggregate critical-path attribution across every matching `.remote()`:
     p50/p95/p99 per segment (queue_wait, place, handoff, serialize, rpc,
     user.execute, output delivery) plus the unaccounted `gap` share —
     the honest answer to "where does dispatch latency go?" (ROADMAP item 3).
+    With --serving, the same sweep over per-request serving timelines.
     """
     from ..observability import critical_path as cp
 
     _root, store = _trace_store(state_dir)
-    agg, _per_trace = cp.attribute_store(store, needle, last=last)
+    agg, _per_trace = cp.attribute_store(store, needle, last=last, serving=serving)
     if not agg.get("calls"):
+        root_name = cp.SERVING_ROOT_SPAN if serving else cp.ROOT_SPAN
         raise click.ClickException(
             f"no attributable trace matching {needle!r} under {store} "
-            "(traces need a function.call root span; is tracing on?)"
+            f"(traces need a {root_name} root span; is tracing on?)"
         )
     if as_json:
         click.echo(json.dumps(agg, indent=2, sort_keys=True))
@@ -503,6 +514,28 @@ def _fmt_event_attrs(ev: dict) -> str:
     return " ".join(parts)
 
 
+def _discover_metrics_url(
+    url: Optional[str], state_dir: Optional[str]
+) -> tuple[str, Optional[str]]:
+    """The ONE metrics_url breadcrumb discovery, shared by `metrics`,
+    `alerts`, and `top`: (resolved_url, breadcrumb_path_or_None). The
+    breadcrumb path comes back so callers can distinguish "stale breadcrumb"
+    from "bad --url" in their error text."""
+    from ..config import config as _config
+
+    if url is not None:
+        return url, None
+    root = state_dir or _config["state_dir"]
+    url_file = os.path.join(root, "observability", "metrics_url")
+    if not os.path.exists(url_file):
+        raise click.ClickException(
+            f"no supervisor metrics endpoint recorded at {url_file} "
+            "(is a supervisor running? pass --url to reach one directly)"
+        )
+    with open(url_file) as f:
+        return f.read().strip(), url_file
+
+
 @cli.command("metrics")
 @click.option("--url", default=None, help="Scrape URL (default: the local supervisor's).")
 @click.option("--state-dir", default=None, help="Supervisor state dir (metrics_url discovery).")
@@ -513,19 +546,7 @@ def metrics_cmd(url: Optional[str], state_dir: Optional[str], as_json: bool) -> 
     import urllib.error
     import urllib.request
 
-    from ..config import config as _config
-
-    url_file = None
-    if url is None:
-        root = state_dir or _config["state_dir"]
-        url_file = os.path.join(root, "observability", "metrics_url")
-        if not os.path.exists(url_file):
-            raise click.ClickException(
-                f"no supervisor metrics endpoint recorded at {url_file} "
-                "(is a supervisor running? pass --url to scrape one directly)"
-            )
-        with open(url_file) as f:
-            url = f.read().strip()
+    url, url_file = _discover_metrics_url(url, state_dir)
     try:
         text = urllib.request.urlopen(url, timeout=5).read().decode()
     except (urllib.error.URLError, OSError) as exc:
@@ -544,6 +565,182 @@ def metrics_cmd(url: Optional[str], state_dir: Optional[str], as_json: bool) -> 
         click.echo(json.dumps(_parse_prometheus(text), indent=2, sort_keys=True))
     else:
         click.echo(text, nl=False)
+
+
+# ---------------------------------------------------------------------------
+# fleet SLO observability (ISSUE 11): alerts + live top dashboard over the
+# supervisor's time-series store (GET /metrics/history; server/history.py)
+# ---------------------------------------------------------------------------
+
+
+def _history_fetch(url: Optional[str], state_dir: Optional[str], query: str, **params) -> dict:
+    """One history query against the supervisor's /metrics/history endpoint,
+    discovered via the same metrics_url breadcrumb `modal_tpu metrics` uses
+    (shared `_discover_metrics_url`)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    url, url_file = _discover_metrics_url(url, state_dir)
+    base = url[: -len("/metrics")] if url.endswith("/metrics") else url.rstrip("/")
+    qs = urllib.parse.urlencode({"query": query, **{k: v for k, v in params.items() if v}})
+    try:
+        raw = urllib.request.urlopen(f"{base}/metrics/history?{qs}", timeout=5).read()
+    except (urllib.error.URLError, OSError) as exc:
+        if url_file is not None:
+            raise click.ClickException(
+                f"history endpoint at {base} is not answering — the breadcrumb at "
+                f"{url_file} is stale (supervisor not running or restarting), or the "
+                f"supervisor was started with MODAL_TPU_TS_INTERVAL=0. ({exc})"
+            )
+        raise click.ClickException(f"history query against {base} failed: {exc}")
+    try:
+        return json.loads(raw)
+    except ValueError as exc:
+        raise click.ClickException(f"malformed history payload: {exc}")
+
+
+def _fmt_num(v, unit: str = "", scale: float = 1.0, digits: int = 1) -> str:
+    if v is None:
+        return "-"
+    return f"{v * scale:.{digits}f}{unit}"
+
+
+@cli.command("alerts")
+@click.option("--url", default=None, help="Metrics URL (default: the local supervisor's).")
+@click.option("--state-dir", default=None, help="Supervisor state dir (metrics_url discovery).")
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable alert dump.")
+def alerts_cmd(url: Optional[str], state_dir: Optional[str], as_json: bool) -> None:
+    """SLO burn-rate alert states (observability/slo.py): per rule, the
+    fast/slow-window values, burn rates, and firing/resolved status. Firing
+    and resolving transitions are journaled — a firing alert here survives a
+    supervisor crash_restart."""
+    payload = _history_fetch(url, state_dir, "alerts")
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, sort_keys=True))
+        return
+    rules = payload.get("rules") or []
+    alerts = payload.get("alerts") or {}
+    if not rules and not alerts:
+        click.echo("no SLO rules evaluated yet (sampler warming up?)")
+        return
+    click.echo(
+        f"{'rule':<26} {'state':<9} {'fast':>10} {'slow':>10} {'burn':>7} {'threshold':>10}"
+    )
+    for r in rules:
+        state = r.get("state", "ok")
+        burn = r.get("fast_burn")
+        click.echo(
+            f"{r['rule']:<26} {state:<9} "
+            f"{_fmt_num(r.get('fast_value'), digits=4):>10} "
+            f"{_fmt_num(r.get('slow_value'), digits=4):>10} "
+            f"{_fmt_num(burn, 'x', digits=2):>7} "
+            f"{r.get('op', '>')}{r.get('threshold')!s:>9}"
+        )
+    # journal-recovered alerts for rules the (fresh) evaluator hasn't
+    # re-evaluated yet still show — silence is not recovery
+    for name, a in sorted(alerts.items()):
+        if any(r.get("rule") == name for r in rules):
+            continue
+        click.echo(f"{name:<26} {a.get('state', '?'):<9} (recovered from journal)")
+    firing = [n for n, a in alerts.items() if a.get("state") == "firing"]
+    click.echo(f"{len(firing)} firing" + (f": {', '.join(sorted(firing))}" if firing else ""))
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(points: list, width: int = 30) -> str:
+    vals = [p[1] for p in points][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARK_CHARS[int((v - lo) / span * (len(_SPARK_CHARS) - 1))] for v in vals)
+
+
+def _render_top_frame(payload: dict) -> str:
+    lines: list[str] = []
+    fleet = payload.get("fleet") or {}
+    alerts = (payload.get("alerts") or {}).get("alerts") or {}
+    firing = sorted(n for n, a in alerts.items() if a.get("state") == "firing")
+    stamp = datetime.datetime.fromtimestamp(payload.get("time", time.time())).strftime("%H:%M:%S")
+    lines.append(f"modal_tpu top — {stamp}   alerts firing: {len(firing)}" + (
+        f" ({', '.join(firing)})" if firing else ""
+    ))
+    lines.append(
+        f"  TTFT p50 {_fmt_num(fleet.get('ttft_p50_s'), 's', digits=3)}  "
+        f"p95 {_fmt_num(fleet.get('ttft_p95_s'), 's', digits=3)}   "
+        f"tokens/s {_fmt_num(fleet.get('tokens_per_s'))}   "
+        f"req/s {_fmt_num(fleet.get('requests_per_s'), digits=2)}   "
+        f"queue {_fmt_num(fleet.get('queue_depth'), digits=0)}   "
+        f"dispatch p50 {_fmt_num(fleet.get('dispatch_p50_s'), 's', digits=3)}"
+    )
+    lines.append(
+        f"  KV pages free {_fmt_num(fleet.get('kv_pages_free'), digits=0)} / "
+        f"alloc {_fmt_num(fleet.get('kv_pages_allocated'), digits=0)}   "
+        f"batch occupancy p50 {_fmt_num(fleet.get('batch_occupancy_p50'), digits=0)}   "
+        f"mem {_fmt_num(fleet.get('device_memory_bytes'), ' MB', scale=1e-6, digits=0)}   "
+        f"call err/s {_fmt_num(fleet.get('call_errors_per_s'), digits=2)}"
+    )
+    spark = _sparkline(payload.get("tokens_sparkline") or [])
+    if spark:
+        lines.append(f"  tokens/s (10m) {spark}")
+    for name, a in sorted(alerts.items()):
+        if a.get("state") == "firing":
+            lines.append(
+                f"  ALERT {name}: burn {_fmt_num(a.get('burn_rate'), 'x', digits=1)} "
+                f"value {_fmt_num(a.get('value'), digits=4)} (threshold {a.get('threshold')})"
+            )
+    replicas = payload.get("replicas") or []
+    lines.append("")
+    lines.append(
+        f"  {'replica':<14} {'function':<16} {'occup':>6} {'kv free':>8} {'queue':>6} "
+        f"{'ttft p95':>9} {'tok/s':>8} {'mem MB':>8} {'age':>7}"
+    )
+    if not replicas:
+        lines.append("  (no serving replicas pushing telemetry)")
+    for r in replicas:
+        lines.append(
+            f"  {r.get('task_id', '')[:14]:<14} {str(r.get('function', ''))[:16]:<16} "
+            f"{_fmt_num(r.get('batch_occupancy_mean'), digits=1):>6} "
+            f"{_fmt_num(r.get('kv_pages_free'), digits=0):>8} "
+            f"{_fmt_num(r.get('queue_depth'), digits=0):>6} "
+            f"{_fmt_num(r.get('ttft_p95_s'), 's', digits=3):>9} "
+            f"{_fmt_num(r.get('tokens_per_s')):>8} "
+            f"{_fmt_num(r.get('memory_bytes'), scale=1e-6, digits=0):>8} "
+            f"{_fmt_num(r.get('age_s'), 's', digits=0):>7}"
+        )
+    return "\n".join(lines)
+
+
+@cli.command("top")
+@click.option("--url", default=None, help="Metrics URL (default: the local supervisor's).")
+@click.option("--state-dir", default=None, help="Supervisor state dir (metrics_url discovery).")
+@click.option("--interval", default=2.0, help="Refresh interval in seconds.")
+@click.option("--once", is_flag=True, help="Render a single frame and exit (no screen control).")
+@click.option("--json", "as_json", is_flag=True, help="Dump one raw dashboard payload as JSON.")
+def top_cmd(
+    url: Optional[str], state_dir: Optional[str], interval: float, once: bool, as_json: bool
+) -> None:
+    """Live fleet dashboard over the supervisor's time-series history: per-
+    replica batch occupancy, KV pool free pages, queue depth, TTFT p50/p95,
+    tokens/s, device memory, and active SLO burn rates. Ctrl-C to exit."""
+    payload = _history_fetch(url, state_dir, "top")
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, sort_keys=True))
+        return
+    if once:
+        click.echo(_render_top_frame(payload))
+        return
+    try:
+        while True:
+            # ANSI home+clear-to-end keeps the frame flicker-free
+            click.echo("\033[H\033[2J" + _render_top_frame(payload), nl=True)
+            time.sleep(max(0.2, interval))
+            payload = _history_fetch(url, state_dir, "top")
+    except KeyboardInterrupt:
+        pass
 
 
 # ---------------------------------------------------------------------------

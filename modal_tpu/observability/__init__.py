@@ -18,9 +18,15 @@ Two dependency-free pillars (ISSUE 2):
 ``catalog`` is the single declarative list of every metric family — the
 instrumentation-parity test (tests/test_api_parity.py) checks it against the
 RPCs `server/services.py` actually implements.
+
+ISSUE 11 adds the fleet-SLO tier on top: ``timeseries`` (supervisor-resident
+tiered ring-buffer history over the merged registry), ``slo`` (multi-window
+burn-rate alerting with journaled transitions), and ``quantile`` (the one
+quantile contract shared by the registry, the attribution aggregate, and
+the bench tools).
 """
 
-from . import critical_path, device_telemetry, metrics, profiler, tracing
+from . import critical_path, device_telemetry, metrics, profiler, quantile, slo, timeseries, tracing
 from .catalog import METRIC_CATALOG, SPAN_CATALOG, instrumented_rpc_names
 from .metrics import REGISTRY
 
@@ -30,6 +36,9 @@ __all__ = [
     "critical_path",
     "profiler",
     "device_telemetry",
+    "quantile",
+    "slo",
+    "timeseries",
     "REGISTRY",
     "METRIC_CATALOG",
     "SPAN_CATALOG",

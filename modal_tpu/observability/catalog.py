@@ -307,6 +307,12 @@ SERVING_TOKENS_PER_S = REGISTRY.gauge(
     "Generated tokens/s over the engine's trailing 10s window (continuous-"
     "batching throughput; the capacity signal for SLO scale-down).",
 )
+SERVING_TOKENS = REGISTRY.counter(
+    "modal_tpu_serving_tokens_total",
+    "Generated tokens, cumulative. The throughput-floor SLO rule reads this "
+    "as a rate-over-window — unlike the tokens/s gauge, a wedged engine's "
+    "zero deltas read as zero throughput instead of a frozen healthy value.",
+)
 SERVING_BATCH_OCCUPANCY = REGISTRY.histogram(
     "modal_tpu_serving_batch_occupancy",
     "Active decode slots per continuous-batching step (how full the running "
@@ -341,6 +347,43 @@ KV_PAGES_FREE = REGISTRY.gauge(
     "modal_tpu_kv_pages_free",
     "KV-cache pages free in the shared pool (total HBM is bounded by the "
     "pool, never by num_requests × max_len).",
+)
+
+# -- fleet SLO observability (ISSUE 11; observability/timeseries.py,
+# observability/slo.py, docs/OBSERVABILITY.md) --------------------------------
+
+TIMESERIES_SAMPLES = REGISTRY.counter(
+    "modal_tpu_timeseries_samples_total",
+    "Samples taken by the supervisor-resident time-series store.",
+)
+TIMESERIES_POINTS = REGISTRY.gauge(
+    "modal_tpu_timeseries_points",
+    "Points currently held per rollup tier of the time-series store "
+    "(bounded by construction: tiers × series cap × ring length).",
+    ("tier",),
+)
+TIMESERIES_SAMPLE_SECONDS = REGISTRY.histogram(
+    "modal_tpu_timeseries_sample_seconds",
+    "Wall time of one full store sample (every tracked family snapshotted, "
+    "deltas computed, rollups folded).",
+    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25),
+)
+SLO_BURN_RATE = REGISTRY.gauge(
+    "modal_tpu_slo_burn_rate",
+    "Current burn rate per SLO rule and window (fast|slow): observed/objective, "
+    "1.0 = exactly on budget (observability/slo.py).",
+    ("rule", "window"),
+)
+SLO_ALERTS_FIRING = REGISTRY.gauge(
+    "modal_tpu_slo_alerts_firing",
+    "1 while the named SLO rule's burn-rate alert is firing.",
+    ("rule",),
+)
+SLO_ALERT_TRANSITIONS = REGISTRY.counter(
+    "modal_tpu_slo_alert_transitions_total",
+    "SLO alert state transitions (firing | resolved); each is also a "
+    "journaled event, so firing alerts survive a supervisor crash_restart.",
+    ("rule", "transition"),
 )
 
 # -- chaos --------------------------------------------------------------------
@@ -407,8 +450,12 @@ SPAN_CATALOG: dict[str, str] = {
     "coldstart.preinit": "warm-pool opt-in jax backend pre-initialization",
     "recovery.replay": "journal replay into a fresh ServerState",
     "recovery.crash_restart": "chaos supervisor crash + same-port rebuild",
-    "serving.admit": "serving-tier admission: request submit → decode-slot + KV pages",
+    "serving.admit": "serving-tier admission: queue wait → decode-slot + KV pages",
     "serving.prefill": "serving-tier prompt prefill (chunked; ends at the first token)",
+    "serving.prefill_chunk": "one prefill chunk's device compute (per-request timeline detail)",
+    "serving.decode": "periodic decode progress mark (every N tokens; batch occupancy + KV pages attrs)",
+    "serving.preempt": "KV-pool-pressure preemption: slot freed, request requeued with its prefix",
+    "serving.request": "root of one serving request's lifecycle: submit → done (ISSUE 11 timelines)",
     "serving.stream": "one SSE token stream: open → done/reset (serving/api.py)",
 }
 

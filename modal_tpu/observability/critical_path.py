@@ -66,9 +66,27 @@ SEGMENT_RULES: list[tuple[str, str, int]] = [
 ROOT_SPAN = "function.call"
 GAP = "gap"
 
+# -- serving ruleset (ISSUE 11) ----------------------------------------------
+# Per-request serving timelines root at `serving.request` (engine.submit)
+# and decompose TTFT / per-token latency into queue → prefill → decode →
+# stream. Priorities: prefill chunks (device compute) over the blanket
+# prefill span over decode marks over the SSE stream span (which covers the
+# whole delivery and must claim only what compute doesn't explain).
+SERVING_ROOT_SPAN = "serving.request"
+SERVING_SEGMENT_RULES: list[tuple[str, str, int]] = [
+    ("serving.preempt", "requeue", 80),
+    ("serving.prefill_chunk", "prefill", 65),
+    ("serving.decode", "decode", 55),
+    ("serving.admit", "queue", 50),
+    ("serving.prefill", "prefill", 45),
+    ("serving.stream", "stream", 20),
+]
 
-def segment_for(name: str) -> Optional[tuple[str, int]]:
-    for rule, segment, priority in SEGMENT_RULES:
+
+def segment_for(
+    name: str, rules: Optional[list[tuple[str, str, int]]] = None
+) -> Optional[tuple[str, int]]:
+    for rule, segment, priority in (rules if rules is not None else SEGMENT_RULES):
         if rule.endswith("*"):
             if name.startswith(rule[:-1]):
                 return segment, priority
@@ -151,8 +169,8 @@ def order_spans(spans: list[dict]) -> list[dict]:
 # -- per-trace attribution ----------------------------------------------------
 
 
-def trace_root(spans: list[dict]) -> Optional[dict]:
-    roots = [s for s in spans if s.get("name") == ROOT_SPAN]
+def trace_root(spans: list[dict], root_span: str = ROOT_SPAN) -> Optional[dict]:
+    roots = [s for s in spans if s.get("name") == root_span]
     if not roots:
         ids = {s.get("span_id") for s in spans}
         roots = [s for s in spans if not s.get("parent_id") or s["parent_id"] not in ids]
@@ -161,15 +179,21 @@ def trace_root(spans: list[dict]) -> Optional[dict]:
     return min(roots, key=lambda s: float(s.get("start") or 0.0))
 
 
-def attribute_trace(spans: list[dict]) -> Optional[dict]:
+def attribute_trace(
+    spans: list[dict],
+    rules: Optional[list[tuple[str, str, int]]] = None,
+    root_span: str = ROOT_SPAN,
+) -> Optional[dict]:
     """One trace's wall-time attribution: {segment: seconds}, plus ``gap``
     (root wall time no segment covers) and ``total`` (root wall time).
-    Returns None when the trace has no usable root interval."""
-    root = trace_root(spans)
+    Returns None when the trace has no usable root interval. `rules` /
+    `root_span` select the ruleset — the default dispatch story, or the
+    serving timeline (SERVING_SEGMENT_RULES + SERVING_ROOT_SPAN)."""
+    root = trace_root(spans, root_span)
     if root is None:
         return None
     norm = normalize_starts(spans)
-    if root.get("name") == ROOT_SPAN:
+    if root.get("name") == root_span:
         t0 = norm.get(root.get("span_id", ""), float(root.get("start") or 0.0))
         t1 = float(root.get("end") or 0.0)
     else:
@@ -184,7 +208,7 @@ def attribute_trace(spans: list[dict]) -> Optional[dict]:
     # clip every mapped span to the root interval
     intervals: list[tuple[float, float, int, str]] = []
     for s in spans:
-        mapped = segment_for(s.get("name") or "")
+        mapped = segment_for(s.get("name") or "", rules)
         if mapped is None:
             continue
         segment, priority = mapped
@@ -218,11 +242,9 @@ def attribute_trace(spans: list[dict]) -> Optional[dict]:
 # -- aggregation across calls -------------------------------------------------
 
 
-def _quantile(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
+# shared helper (observability/quantile.py, ISSUE 11 satellite); the old
+# name stays importable — the bench tools and tests address it here
+from .quantile import quantile as _quantile  # noqa: E402
 
 
 def aggregate_attributions(per_trace: list[dict]) -> dict:
@@ -261,7 +283,9 @@ SEGMENT_ORDER = [
     "queue_wait", "place", "handoff", "image.build", "container.boot",
     "container.imports", "container.enter_hooks", "serialize", "coalesce",
     "client.prepare", "rpc.client", "rpc.server", "recovery", "input_deliver",
-    "user.execute", "output_deliver", "deserialize", GAP,
+    "user.execute", "output_deliver", "deserialize",
+    # serving timeline segments (SERVING_SEGMENT_RULES), in lifecycle order
+    "queue", "prefill", "decode", "requeue", "stream", GAP,
 ]
 
 
@@ -286,15 +310,27 @@ def format_attribution_table(agg: dict) -> str:
     return "\n".join(lines)
 
 
-def attribute_store(trace_dir: str, needle: str = "", last: int = 0) -> tuple[dict, list[dict]]:
+def attribute_store(
+    trace_dir: str, needle: str = "", last: int = 0, serving: bool = False
+) -> tuple[dict, list[dict]]:
     """End-to-end helper: read the span store, group by trace, attribute each
     call, aggregate. `last` keeps only the N most recent matching traces
-    (0 = all). Returns (aggregate, per_trace_attributions)."""
+    (0 = all). `serving=True` switches to the serving-timeline ruleset and
+    considers only traces that actually carry a `serving.request` root.
+    Returns (aggregate, per_trace_attributions)."""
     from . import tracing
 
     traces = tracing.find_traces(trace_dir, needle)
     ordered = sorted(traces.values(), key=lambda spans: min(s["start"] for s in spans))
+    if serving:
+        ordered = [
+            spans for spans in ordered if any(s.get("name") == SERVING_ROOT_SPAN for s in spans)
+        ]
     if last:
         ordered = ordered[-last:]
-    per_trace = [a for spans in ordered if (a := attribute_trace(spans)) is not None]
+    rules = SERVING_SEGMENT_RULES if serving else None
+    root = SERVING_ROOT_SPAN if serving else ROOT_SPAN
+    per_trace = [
+        a for spans in ordered if (a := attribute_trace(spans, rules=rules, root_span=root)) is not None
+    ]
     return aggregate_attributions(per_trace), per_trace

@@ -218,12 +218,32 @@ PUSH_FAMILIES = (
     "modal_tpu_serving_ttft_seconds",
     "modal_tpu_serving_ttft_p95_seconds",
     "modal_tpu_serving_tokens_per_second",
+    "modal_tpu_serving_tokens_total",
     "modal_tpu_serving_queue_depth",
     "modal_tpu_serving_batch_occupancy",
     "modal_tpu_serving_requests_total",
     "modal_tpu_kv_pages_allocated",
     "modal_tpu_kv_pages_free",
 )
+
+
+def pushed_gauge(report: dict, name: str) -> Optional[float]:
+    """Read one gauge family out of a pushed heartbeat report (the
+    export_families JSON shape): the sum across its series, None when the
+    family is absent or carries nothing numeric. The ONE parser for the
+    per-task report — the SLO autoscaler (scheduler._serving_report) and the
+    `modal_tpu top` replica table (server/history.py) must read identical
+    values or 'top shows what scaling sees' stops being true."""
+    series = (report.get(name) or {}).get("series")
+    if not isinstance(series, dict):
+        return None
+    vals = []
+    for v in series.values():
+        try:
+            vals.append(float(v))
+        except (TypeError, ValueError):
+            continue
+    return sum(vals) if vals else None
 
 
 def container_report() -> str:

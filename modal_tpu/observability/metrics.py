@@ -174,22 +174,18 @@ class Histogram(_Metric):
             return sum(s.count for s in self._series.values())
 
     def quantile(self, q: float) -> Optional[float]:
-        """Bucket-interpolated quantile across ALL series (bench summary)."""
+        """Bucket quantile across ALL series (bench summary); delegates to
+        the shared helper (observability/quantile.py) so the registry, the
+        attribution aggregate, and the time-series store agree on p50."""
+        from .quantile import bucket_quantile
+
         with self._lock:
             total = sum(s.count for s in self._series.values())
-            if total == 0:
-                return None
             merged = [0] * len(self.buckets)
             for s in self._series.values():
                 for i, c in enumerate(s.counts):
                     merged[i] += c
-            target = q * total
-            seen = 0.0
-            for i, c in enumerate(merged):
-                seen += c
-                if seen >= target:
-                    return self.buckets[i]
-            return self.buckets[-1]
+        return bucket_quantile(self.buckets, merged, q, total=total)
 
     def render(self, exemplars: bool = False) -> list[str]:
         """Exposition lines; with ``exemplars=True`` bucket samples carry the

@@ -1,0 +1,52 @@
+"""The one quantile contract for the whole stack (ISSUE 11 satellite).
+
+Three copies of nearest-rank/bucket quantile logic had grown independently
+(`critical_path._quantile`, `metrics.Histogram.quantile`, the bench tools'
+fallbacks); they are deduplicated here so a p50 printed by a bench table,
+the attribution aggregate, and the registry roll-up can never disagree on
+what "p50" means.
+
+Two flavors, matching the two data shapes the stack produces:
+
+- ``quantile(sorted_vals, q)`` — nearest-rank over raw samples (attribution
+  aggregates, bench wall-time lists). Input MUST already be sorted.
+- ``bucket_quantile(bounds, counts, q)`` — histogram-bucket quantile over
+  cumulative-free per-bucket counts; returns the upper bound of the bucket
+  the q-th observation lands in (the same conservative answer Prometheus'
+  `histogram_quantile` gives at bucket resolution). Used by the registry's
+  histograms and the time-series store's windowed quantiles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def quantile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile of an ALREADY-SORTED sample list; 0.0 when
+    empty (the historical `critical_path._quantile` contract)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def bucket_quantile(
+    bounds: Sequence[float], counts: Sequence[int], q: float, total: Optional[int] = None
+) -> Optional[float]:
+    """Quantile from per-bucket (NON-cumulative) counts against `bounds`
+    (ascending upper bounds). `total` is the observation count INCLUDING any
+    +Inf-bucket overflow not present in `counts` (defaults to sum(counts));
+    a quantile landing past the last finite bound collapses to it, as the
+    registry's `Histogram.quantile` always did. None when empty."""
+    if total is None:
+        total = int(sum(counts))
+    if total <= 0 or not bounds:
+        return None
+    target = q * total
+    seen = 0.0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= target:
+            return bounds[min(i, len(bounds) - 1)]
+    return bounds[-1]

@@ -217,6 +217,10 @@ _RPC_NAMES = [
     # profiler in the supervisor and fan out to live containers via
     # ContainerHeartbeatResponse.profile_command
     "ProfileControl",
+    # Fleet SLO observability (ISSUE 11, observability/timeseries.py +
+    # slo.py): windowed metric history, burn-rate alert states, and the
+    # `modal_tpu top` dashboard payload from the supervisor-resident store
+    "MetricsHistory",
     # Workspace (identity/membership/settings; billing is NG)
     "WorkspaceNameLookup",
     "WorkspaceMemberList",

@@ -57,6 +57,10 @@ class BlobServer:
         # blob server is the one HTTP listener the stack already runs, so the
         # metrics plane rides it instead of opening another port.
         app.router.add_get("/metrics", self._metrics)
+        # windowed history / burn-rate alerts / `modal_tpu top` payloads from
+        # the supervisor-resident time-series store (ISSUE 11): same queries
+        # as the MetricsHistory RPC, on the plane CLIs can always reach
+        app.router.add_get("/metrics/history", self._metrics_history)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         try:
@@ -102,6 +106,25 @@ class BlobServer:
             content_type="text/plain",
             charset="utf-8",
         )
+
+    async def _metrics_history(self, request: web.Request) -> web.Response:
+        """History queries (server/history.py): ?query=describe|series|
+        quantile|alerts|top [&family=...&window_s=...&q=...] → JSON."""
+        from .history import history_payload
+
+        try:
+            window_s = float(request.query.get("window_s", 0) or 0)
+            q = float(request.query.get("q", 0) or 0)
+        except ValueError:
+            return web.json_response({"error": "window_s/q must be numeric"}, status=400)
+        payload = history_payload(
+            self.state,
+            query=request.query.get("query", "describe"),
+            family=request.query.get("family", ""),
+            window_s=window_s,
+            q=q,
+        )
+        return web.json_response(payload)
 
     async def stop(self) -> None:
         if self._runner is not None:

@@ -1,0 +1,184 @@
+"""History / alert / dashboard query plane (ISSUE 11).
+
+One payload builder shared by the ``MetricsHistory`` RPC (services.py) and
+``GET /metrics/history`` (blob_server.py): both surfaces answer the same
+queries against the supervisor's time-series store + SLO evaluator, so the
+CLI (`modal_tpu top`, `modal_tpu alerts`) can use whichever plane is
+reachable. Payloads are JSON by design — shapes are library-defined and
+evolve faster than the wire (same reasoning as the heartbeat's
+telemetry_json).
+
+Queries:
+
+- ``describe`` — tracked families, tiers, point counts.
+- ``series``   — one family's windowed points (+ p50/p95/p99 for histograms).
+- ``quantile`` — one histogram quantile over a window.
+- ``alerts``   — burn rates per rule + alert states (journal-backed).
+- ``top``      — the `modal_tpu top` dashboard: fleet roll-ups, per-replica
+  serving telemetry (from each task's raw heartbeat push — per-replica even
+  where merged gauges are latest-wins), device memory, active burn rates.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+from ..proto import api_pb2
+
+# windows the top dashboard summarizes over (seconds)
+TOP_FAST_WINDOW_S = 60.0
+TOP_SLOW_WINDOW_S = 600.0
+
+
+def history_payload(
+    state: Any,
+    query: str,
+    family: str = "",
+    window_s: float = 0.0,
+    q: float = 0.0,
+) -> dict:
+    """Answer one history query against `state` (a ServerState). Unknown
+    queries and a missing store degrade to explanatory payloads, never
+    exceptions — this feeds CLIs and dashboards."""
+    store = state.timeseries
+    evaluator = state.slo
+    query = query or "describe"
+    if query == "alerts":
+        if evaluator is not None:
+            return evaluator.payload()
+        # no evaluator (e.g. sampler disabled): the journal-backed
+        # projection still answers — a recovered firing alert is visible
+        # even before the first post-restart evaluation
+        return {"time": time.time(), "rules": [], "alerts": dict(state.alerts)}
+    if store is None:
+        return {"error": "time-series store not running (MODAL_TPU_TS_INTERVAL=0?)"}
+    if query == "describe":
+        return store.describe()
+    if query == "series":
+        return store.series_payload(family, window_s or TOP_FAST_WINDOW_S)
+    if query == "quantile":
+        return {
+            "family": family,
+            "q": q or 0.5,
+            "window_s": window_s or TOP_FAST_WINDOW_S,
+            "value": store.hist_quantile(family, q or 0.5, window_s or TOP_FAST_WINDOW_S),
+        }
+    if query == "top":
+        return top_payload(state)
+    return {"error": f"unknown history query {query!r}"}
+
+
+# the one per-task heartbeat-report parser, shared with the SLO autoscaler
+# (scheduler._serving_report): `top` must show exactly what scaling sees
+from ..observability.device_telemetry import pushed_gauge as _push_gauge  # noqa: E402
+
+
+def _replica_rows(state: Any) -> list[dict]:
+    """Per-replica serving telemetry from each live task's RAW heartbeat
+    push (TaskState_.telemetry_prev_json) — the same per-replica source the
+    SLO autoscaler reads, so `top` shows exactly what scaling decisions see."""
+    rows = []
+    now = time.time()
+    for task in state.tasks.values():
+        raw = getattr(task, "telemetry_prev_json", "")
+        if not raw:
+            continue
+        try:
+            report = json.loads(raw)
+        except ValueError:
+            continue
+        ttft_p95 = _push_gauge(report, "modal_tpu_serving_ttft_p95_seconds")
+        tokens_per_s = _push_gauge(report, "modal_tpu_serving_tokens_per_second")
+        queue_depth = _push_gauge(report, "modal_tpu_serving_queue_depth")
+        pages_free = _push_gauge(report, "modal_tpu_kv_pages_free")
+        pages_alloc = _push_gauge(report, "modal_tpu_kv_pages_allocated")
+        # batch occupancy rides as a cumulative histogram: report its mean
+        occ = (report.get("modal_tpu_serving_batch_occupancy") or {}).get("series") or {}
+        occ_mean = None
+        tot_sum = tot_count = 0.0
+        for s in occ.values():
+            if isinstance(s, dict):
+                tot_sum += float(s.get("sum", 0.0))
+                tot_count += float(s.get("count", 0))
+        if tot_count:
+            occ_mean = tot_sum / tot_count
+        hbm = 0.0
+        dev = (report.get("modal_tpu_device_memory_bytes") or {}).get("series") or {}
+        for key, v in dev.items():
+            if key.endswith(",bytes_in_use") or key.endswith(",rss"):
+                try:
+                    hbm += float(v)
+                except (TypeError, ValueError):
+                    pass
+        if all(v is None for v in (ttft_p95, tokens_per_s, queue_depth, pages_free)):
+            continue  # pushed telemetry, but nothing serving-shaped
+        fn = state.functions.get(task.function_id)
+        rows.append(
+            {
+                "task_id": task.task_id,
+                "function": fn.tag if fn is not None else task.function_id,
+                "state": api_pb2.TaskState.Name(task.state) if task.state else "",
+                "age_s": round(now - task.started_at, 1) if task.started_at else None,
+                "ttft_p95_s": ttft_p95,
+                "tokens_per_s": tokens_per_s,
+                "queue_depth": queue_depth,
+                "batch_occupancy_mean": occ_mean,
+                "kv_pages_free": pages_free,
+                "kv_pages_allocated": pages_alloc,
+                "memory_bytes": hbm or None,
+            }
+        )
+    return rows
+
+
+def top_payload(state: Any) -> dict:
+    """The `modal_tpu top` dashboard payload."""
+    store = state.timeseries
+    evaluator = state.slo
+    now = time.time()
+    w = TOP_FAST_WINDOW_S
+    fleet: dict = {}
+    sparkline: list = []
+    if store is not None:
+        fleet = {
+            "ttft_p50_s": store.hist_quantile("modal_tpu_serving_ttft_seconds", 0.5, w),
+            "ttft_p95_s": store.hist_quantile("modal_tpu_serving_ttft_seconds", 0.95, w),
+            "dispatch_p50_s": store.hist_quantile("modal_tpu_dispatch_latency_seconds", 0.5, w),
+            "batch_occupancy_p50": store.hist_quantile("modal_tpu_serving_batch_occupancy", 0.5, w),
+            "requests_per_s": store.counter_rate("modal_tpu_serving_requests_total", w),
+            # call outcomes from the bounded task-results family (the
+            # rpc_total label space overflows the store's series cap)
+            "calls_per_s": store.counter_rate("modal_tpu_task_results_total", w),
+            "call_errors_per_s": store.counter_rate(
+                "modal_tpu_task_results_total", w, label_filter="FAILURE"
+            ),
+            "preemptions_per_s": store.counter_rate("modal_tpu_serving_preemptions_total", w),
+        }
+        for name, key in (
+            ("modal_tpu_serving_tokens_per_second", "tokens_per_s"),
+            ("modal_tpu_serving_queue_depth", "queue_depth"),
+            ("modal_tpu_kv_pages_free", "kv_pages_free"),
+            ("modal_tpu_kv_pages_allocated", "kv_pages_allocated"),
+            ("modal_tpu_scheduler_queue_depth", "scheduler_queue_depth"),
+            ("modal_tpu_device_memory_bytes", "device_memory_bytes"),
+        ):
+            stats = store.gauge_stats(name, w)
+            fleet[key] = stats["last"] if stats else None
+        # tokens/s sparkline over the slow window (merged across series)
+        pts = store.window_points("modal_tpu_serving_tokens_per_second", TOP_SLOW_WINDOW_S)
+        merged: dict[float, float] = {}
+        for series in pts.values():
+            for p in series:
+                merged[p[0]] = merged.get(p[0], 0.0) + p[1]
+        sparkline = [[round(t, 1), round(v, 2)] for t, v in sorted(merged.items())]
+    alerts = evaluator.payload() if evaluator is not None else {"rules": [], "alerts": dict(state.alerts)}
+    return {
+        "time": now,
+        "store": store.describe() if store is not None else None,
+        "fleet": fleet,
+        "tokens_sparkline": sparkline,
+        "replicas": _replica_rows(state),
+        "alerts": alerts,
+    }

@@ -141,6 +141,8 @@ EXEMPT_RPCS: dict[str, str] = {
     "FunctionCallPutData": "generator data chunks are an ephemeral stream (can be GiB-scale)",
     "FunctionSetWebUrl": "runtime-transient; the serving container re-reports it",
     "ProfileControl": "profiling toggle is runtime-transient; an operator re-issues it after a restart",
+    "MetricsHistory": "read-only history query; rollups are runtime-transient, rebuilt by sampling "
+    "(alert TRANSITIONS are journaled separately by the SLO evaluator, record type 'alert')",
     # on-disk content-addressed stores are already durable
     "MountPutFile": "content-addressed block store on disk is already durable",
     "MountGetOrCreate": "manifest is stored as an on-disk block",
@@ -990,6 +992,21 @@ def _apply_rpc_dedupe(s, r):
         s.idempotency.put(r["key"], r.get("method", ""), _unb64(r.get("resp", "")), journal=False)
 
 
+def _apply_alert(s, r):
+    """SLO alert transition (observability/slo.py): replay keeps the LAST
+    state per rule, so a firing alert survives crash_restart — the rebuilt
+    evaluator adopts state.alerts and can only resolve it with real
+    post-restart samples proving recovery."""
+    s.alerts[r["rule"]] = {
+        k: r[k]
+        for k in (
+            "rule", "state", "since", "value", "burn_rate", "threshold",
+            "description", "fast_window_s", "slow_window_s",
+        )
+        if k in r
+    }
+
+
 _APPLIERS: dict[str, Callable] = {
     "app": _apply_app,
     "app_state": _apply_app_state,
@@ -1024,6 +1041,7 @@ _APPLIERS: dict[str, Callable] = {
     "token": _apply_token,
     "attempt": _apply_attempt,
     "rpc_dedupe": _apply_rpc_dedupe,
+    "alert": _apply_alert,
 }
 
 
@@ -1050,6 +1068,8 @@ def synthesize_records(s) -> list[dict]:
                 "granted_at": s.token_granted_at.get(token_id, 0.0),
             }
         )
+    for alert in s.alerts.values():
+        out.append({"t": "alert", **alert})
     hash_by_image = {v: k for k, v in s.images_by_hash.items()}
     for img in s.images.values():
         out.append(

@@ -238,7 +238,10 @@ class Scheduler:
     def _serving_report(task: TaskState_) -> Optional[dict]:
         """One task's last-pushed serving telemetry (the raw heartbeat JSON
         stored by ContainerHeartbeat — per-replica by construction, unlike
-        the merged registry gauges)."""
+        the merged registry gauges). Parsed by the shared `pushed_gauge`
+        helper, the same one `modal_tpu top`'s replica table uses."""
+        from ..observability.device_telemetry import pushed_gauge
+
         raw = getattr(task, "telemetry_prev_json", "")
         if not raw:
             return None
@@ -246,17 +249,9 @@ class Scheduler:
             report = json.loads(raw)
         except ValueError:
             return None
-
-        def gauge(name: str) -> Optional[float]:
-            series = (report.get(name) or {}).get("series") or {}
-            try:
-                return float(series[""]) if "" in series else None
-            except (TypeError, ValueError):
-                return None
-
-        ttft_p95 = gauge("modal_tpu_serving_ttft_p95_seconds")
-        tokens_per_s = gauge("modal_tpu_serving_tokens_per_second")
-        queue_depth = gauge("modal_tpu_serving_queue_depth")
+        ttft_p95 = pushed_gauge(report, "modal_tpu_serving_ttft_p95_seconds")
+        tokens_per_s = pushed_gauge(report, "modal_tpu_serving_tokens_per_second")
+        queue_depth = pushed_gauge(report, "modal_tpu_serving_queue_depth")
         if ttft_p95 is None and tokens_per_s is None and queue_depth is None:
             return None
         return {
@@ -265,17 +260,85 @@ class Scheduler:
             "queue_depth": queue_depth or 0.0,
         }
 
-    def _slo_desired(self, fn: FunctionState, live: list[str]) -> Optional[int]:
-        """Desired replica count from pushed serving telemetry, or None when
-        the function declares no SLO targets (backlog autoscaling applies).
+    _LIVE_TASK_STATES = (
+        api_pb2.TASK_STATE_QUEUED,
+        api_pb2.TASK_STATE_WORKER_ASSIGNED,
+        api_pb2.TASK_STATE_CREATED,
+        api_pb2.TASK_STATE_ACTIVE,
+        api_pb2.TASK_STATE_IDLE,
+    )
 
-        Policy (one step per cooldown window, hysteresis between the up and
+    def _sole_serving_function(self, fn: FunctionState) -> bool:
+        """Is `fn` the only function with live serving replicas? The fleet
+        TTFT histogram is unlabeled (every replica's pushes merge into it),
+        so its windowed p95 is attributable to one function's objective only
+        when no OTHER function is serving — SLO-targeted or not: a slow
+        target-less serving cls feeds the same histogram and would otherwise
+        make function A scale on function B's latency. "Serving" is detected
+        by what actually pollutes the signal: a live task pushing serving
+        telemetry (`_serving_report`)."""
+        for other in self.s.functions.values():
+            if other.function_id == fn.function_id:
+                continue
+            for tid in other.task_ids:
+                task = self.s.tasks.get(tid)
+                if (
+                    task is not None
+                    and task.state in self._LIVE_TASK_STATES
+                    and self._serving_report(task) is not None
+                ):
+                    return False
+        return True
+
+    def _ttft_burn_rate(self, fn: FunctionState, ttft_slo_s: float) -> Optional[float]:
+        """Burn rate of the function's TTFT objective over the time-series
+        store's fast window (ISSUE 11): windowed p95 / target. None without
+        a store, without observations inside the window — which is also why
+        this needs no staleness gate: an hour-old spike simply isn't in the
+        window, unlike the latest-wins pushed gauge — or when any other
+        function has live serving replicas (the fleet histogram is unlabeled;
+        see _sole_serving_function). The multi-service case degrades to the
+        per-replica raw-report path."""
+        store = getattr(self.s, "timeseries", None)
+        if store is None or ttft_slo_s <= 0 or not self._sole_serving_function(fn):
+            return None
+        from ..observability.slo import _env_f
+
+        fast_window = _env_f("MODAL_TPU_SLO_FAST_WINDOW_S", 60.0)
+        p95 = store.hist_quantile("modal_tpu_serving_ttft_seconds", 0.95, fast_window)
+        if p95 is None:
+            return None
+        return p95 / ttft_slo_s
+
+    @staticmethod
+    def _burn_step(burn: Optional[float]) -> int:
+        """Scale-up urgency from burn rate: a 10× burn adds replicas faster
+        than a 1.1× one (one *move* per cooldown, sized by severity)."""
+        if burn is None or burn < 2.0:
+            return 1
+        if burn < 8.0:
+            return 2
+        return 3
+
+    def _slo_desired(self, fn: FunctionState, live: list[str]) -> Optional[int]:
+        """Desired replica count from serving telemetry, or None when the
+        function declares no SLO targets (backlog autoscaling applies).
+
+        Signal priority (ISSUE 11): when the supervisor's time-series store
+        has TTFT observations in the fast window, the *burn rate* (windowed
+        p95 / target) drives both the violation decision and the step size —
+        window membership IS the staleness gate. Without a store (or before
+        its first serving samples), fall back to each replica's last raw
+        pushed report, with the explicit activity gate that needs.
+
+        Policy (one move per cooldown window, hysteresis between the up and
         down thresholds so the count doesn't flap):
-        - UP   when any replica's pushed p95 TTFT exceeds target_ttft_ms, or
-               replicas report a non-empty admission queue;
-        - DOWN when every replica's p95 TTFT sits under half the target AND
-               mean tokens/s per replica is below SLO_SCALEDOWN_UTIL ×
-               target_tokens_per_replica.
+        - UP   when the TTFT objective burns (burn > 1, or any replica's
+               pushed p95 over target while active), or replicas report a
+               non-empty admission queue; step size grows with burn rate;
+        - DOWN when TTFT sits comfortably under target (burn < 0.5, or
+               pushed p95 under half target) AND mean tokens/s per replica
+               is below SLO_SCALEDOWN_UTIL × target_tokens_per_replica.
         """
         settings = fn.autoscaler
         ttft_slo_s = (settings.target_ttft_ms or 0.0) / 1000.0
@@ -291,22 +354,28 @@ class Scheduler:
             if report is not None:
                 reports.append(report)
         current = len(live)
-        if not reports:
+        burn = self._ttft_burn_rate(fn, ttft_slo_s)
+        if not reports and burn is None:
             return max(current, settings.min_containers, 1)
-        desired = current
-        worst_ttft = max(r["ttft_p95_s"] for r in reports)
+        worst_ttft = max((r["ttft_p95_s"] for r in reports), default=0.0)
         queued = sum(r["queue_depth"] for r in reports)
         total_tps = sum(r["tokens_per_s"] for r in reports)
-        # a TTFT violation only counts while there IS traffic (queueing or
-        # tokens flowing): the pushed p95 gauge is the LAST window's value
-        # and goes stale when requests stop — without the activity gate a
-        # spike followed by silence would ratchet the fleet to max and pin
-        # it there (scale-down needs a sub-half-target p95 that an idle
-        # replica can never produce)
-        active = queued > 0 or total_tps > 0
-        violated = queued > 0 or (ttft_slo_s > 0 and worst_ttft > ttft_slo_s and active)
+        desired = current
+        if burn is not None:
+            # burn-rate path: no activity gate needed (see _ttft_burn_rate)
+            violated = queued > 0 or burn > 1.0
+            ttft_ok_for_down = burn < 0.5
+        else:
+            # raw-report fallback: a TTFT violation only counts while there
+            # IS traffic (queueing or tokens flowing) — the pushed p95 gauge
+            # is the LAST window's value and goes stale when requests stop;
+            # without the gate a spike followed by silence would ratchet the
+            # fleet to max and pin it there
+            active = queued > 0 or total_tps > 0
+            violated = queued > 0 or (ttft_slo_s > 0 and worst_ttft > ttft_slo_s and active)
+            ttft_ok_for_down = ttft_slo_s <= 0 or worst_ttft < 0.5 * ttft_slo_s or not active
         idle = (
-            (ttft_slo_s <= 0 or worst_ttft < 0.5 * ttft_slo_s or not active)
+            ttft_ok_for_down
             and queued == 0
             and tps_target > 0
             and total_tps / max(1, current) < self.SLO_SCALEDOWN_UTIL * tps_target
@@ -316,7 +385,7 @@ class Scheduler:
         now = time.time()
         if now - fn.slo_last_scale_at >= self.SLO_SCALE_COOLDOWN_S:
             if violated:
-                desired = min(current + 1, max(ceiling, floor))
+                desired = min(current + self._burn_step(burn), max(ceiling, floor))
             elif idle:
                 desired = max(current - 1, floor)
             if desired != current:
@@ -326,7 +395,8 @@ class Scheduler:
                 fn.slo_last_scale_at = now
                 logger.info(
                     f"SLO autoscale {fn.tag}: {current} -> {desired} "
-                    f"(ttft_p95={worst_ttft * 1000:.0f}ms target={settings.target_ttft_ms:.0f}ms "
+                    f"(burn={f'{burn:.2f}x' if burn is not None else 'n/a'} "
+                    f"ttft_p95={worst_ttft * 1000:.0f}ms target={settings.target_ttft_ms:.0f}ms "
                     f"queue={queued:.0f} tokens/s={total_tps:.0f})"
                 )
         return max(desired, floor)

@@ -418,6 +418,16 @@ class ServerState:
         self.journal = None  # Optional[journal.Journal]
         self.idempotency = None  # Optional[journal.IdempotencyCache]
 
+        # fleet SLO observability (ISSUE 11): the supervisor-resident
+        # time-series store + burn-rate evaluator (wired by the supervisor's
+        # sampler loop; None on bare states, e.g. scheduler unit tests).
+        # `alerts` is the journal-backed projection of SLO alert state —
+        # rule name -> last transition dict — rebuilt by replay ("alert"
+        # records) so firing alerts survive crash_restart.
+        self.timeseries = None  # Optional[timeseries.TimeSeriesStore]
+        self.slo = None  # Optional[slo.SLOEvaluator]
+        self.alerts: dict[str, dict] = {}
+
     # -- blob store ---------------------------------------------------------
 
     def blob_path(self, blob_id: str) -> str:

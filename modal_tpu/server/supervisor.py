@@ -66,6 +66,7 @@ class LocalSupervisor:
         self.workers: list[WorkerAgent] = []
         self.uds_path = ""  # control-plane Unix socket (set at bind time)
         self._grpc_server: Optional[grpc.aio.Server] = None
+        self._sampler_task: Optional[asyncio.Task] = None  # ISSUE 11 time-series sampler
         self._chaos_task: Optional[asyncio.Task] = None
         self._chaos_subtasks: set[asyncio.Task] = set()  # strong refs (GC guard)
         # serializes crash_restart: two supervisor_crash chaos events due in
@@ -239,6 +240,58 @@ class LocalSupervisor:
         local_transport.register_local_server(self.server_url, handler_target)
         self._save_ports()
         self.scheduler.start()
+        # fleet SLO observability (ISSUE 11): the supervisor-resident
+        # time-series store samples the merged registry on cadence and the
+        # burn-rate evaluator rides the same tick. Built here (not start())
+        # so a crash_restart rebuilds both against the NEW state — the
+        # evaluator adopts state.alerts, which journal replay just refilled,
+        # so a firing alert survives the restart and can only resolve on
+        # real post-restart samples.
+        from ..observability import timeseries as ts
+        from ..observability.slo import SLOEvaluator
+
+        if ts.sampling_enabled():
+            self.state.timeseries = ts.TimeSeriesStore()
+            self.state.slo = SLOEvaluator(
+                self.state.timeseries, alerts=self.state.alerts, journal=self.state.journal
+            )
+            self._sampler_task = asyncio.create_task(self._sampler_loop(), name="ts-sampler")
+
+    async def _sampler_loop(self) -> None:
+        """Sample the registry into the store + evaluate SLO rules, forever.
+        One loop owns both so alert windows and history always agree."""
+        import time as _time
+
+        from ..observability.catalog import (
+            TIMESERIES_POINTS,
+            TIMESERIES_SAMPLE_SECONDS,
+            TIMESERIES_SAMPLES,
+        )
+
+        store, evaluator = self.state.timeseries, self.state.slo
+        while True:
+            try:
+                t0 = _time.perf_counter()
+                store.sample()
+                TIMESERIES_SAMPLES.inc()
+                TIMESERIES_SAMPLE_SECONDS.observe(_time.perf_counter() - t0)
+                for tier, n in store.point_counts().items():
+                    TIMESERIES_POINTS.set(float(n), tier=tier)
+                evaluator.evaluate()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("time-series sampler iteration failed")
+            await asyncio.sleep(store.interval_s)
+
+    async def _stop_sampler(self) -> None:
+        if self._sampler_task is not None:
+            self._sampler_task.cancel()
+            try:
+                await self._sampler_task
+            except asyncio.CancelledError:
+                pass
+            self._sampler_task = None
 
     async def _chaos_event_loop(self) -> None:
         """Fire scheduled chaos events (worker kill / preempt / heartbeat
@@ -318,6 +371,7 @@ class LocalSupervisor:
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=None)
         await self.scheduler.stop()
+        await self._stop_sampler()  # references the abandoned state
         await self.input_plane.stop()
         await self.blob_server.stop()
         if old_journal is not None:
@@ -380,6 +434,7 @@ class LocalSupervisor:
         for worker in self.workers:
             await worker.stop()
         await self.scheduler.stop()
+        await self._stop_sampler()
         await self.input_plane.stop()
         await self.blob_server.stop()
         if self._grpc_server is not None:

@@ -205,7 +205,12 @@ def serving_asgi_app(engine: ServingEngine, max_new_tokens_limit: int = 4096) ->
         )
         SERVING_STREAM_EVENTS.inc(event="open")
         chaos_this_stream = _consume_stream_reset()
-        with tracing.span("serving.stream", attrs={"request_id": req.id}):
+        # stitch under the request's timeline root (ISSUE 11) so stream
+        # delivery shows up as the `stream` segment of `app attribute
+        # --serving`; falls back to the ambient context for foreign requests
+        with tracing.span(
+            "serving.stream", attrs={"request_id": req.id}, parent=req.trace_context
+        ):
             await send(
                 {
                     "type": "http.response.body",

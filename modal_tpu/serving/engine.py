@@ -45,12 +45,54 @@ from ..observability.catalog import (
     SERVING_PREEMPTIONS,
     SERVING_QUEUE_DEPTH,
     SERVING_REQUESTS,
+    SERVING_TOKENS,
     SERVING_TOKENS_PER_S,
     SERVING_TTFT,
     SERVING_TTFT_P95,
 )
 
 _req_counter = itertools.count()
+_replica_id_cache: dict = {}
+
+
+def replica_id() -> str:
+    """Globally-unique replica prefix for request ids (ISSUE 11 satellite):
+    the container's task id when running under the stack (every container
+    gets MODAL_TPU_TASK_ID from its worker), else host-pid. Request ids were
+    replica-local before — a buffered-degrade refetch after replica death
+    404'd *ambiguously* (the same `gr-0-...` could exist on the new replica
+    for a different request); with the task-id prefix a 404 is unambiguous:
+    that id's replica is gone (docs/SERVING.md degradation matrix)."""
+    cached = _replica_id_cache.get("id")
+    if cached is None:
+        import socket
+
+        cached = os.environ.get("MODAL_TPU_TASK_ID") or f"{socket.gethostname()}-{os.getpid()}"
+        _replica_id_cache["id"] = cached
+    return cached
+
+
+# per-request timeline spans (ISSUE 11): every N generated tokens the engine
+# records a serving.decode progress mark carrying batch occupancy + KV pool
+# attrs; MODAL_TPU_SERVING_SPANS=0 turns the whole per-request timeline off
+# (the A/B knob bench_serving's observability-overhead guard flips)
+SPANS_ENV = "MODAL_TPU_SERVING_SPANS"
+SPAN_TOKENS_ENV = "MODAL_TPU_SERVING_SPAN_TOKENS"
+# chaos (ISSUE 11 acceptance): inject latency into every engine loop
+# iteration — TTFT and tokens/s degrade together, which is exactly the
+# signal shape the burn-rate alerting must catch (docs/CHAOS.md)
+CHAOS_STEP_DELAY_ENV = "MODAL_TPU_CHAOS_SERVING_STEP_DELAY_S"
+
+
+def _spans_enabled() -> bool:
+    return os.environ.get(SPANS_ENV, "1").strip().lower() not in ("0", "false", "no", "off")
+
+
+def _span_mark_tokens() -> int:
+    try:
+        return max(1, int(os.environ.get(SPAN_TOKENS_ENV, "8")))
+    except ValueError:
+        return 8
 
 
 class EngineStopped(RuntimeError):
@@ -73,7 +115,7 @@ class GenRequest:
         eos_token_id: Optional[int] = None,
         trace_context: Optional[Any] = None,
     ):
-        self.id = request_id or f"gr-{next(_req_counter)}-{os.getpid()}"
+        self.id = request_id or f"gr-{replica_id()}-{next(_req_counter)}"
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.eos_token_id = eos_token_id
@@ -86,6 +128,12 @@ class GenRequest:
         self.tokens: list[int] = []
         self.done = False
         self.error: Optional[str] = None
+        # per-request timeline (ISSUE 11): the root span every lifecycle
+        # span (admit → prefill chunks → decode marks → preempt → stream)
+        # parents under; queue_from anchors the NEXT admit span (request
+        # creation, then each preemption)
+        self.root_span: Optional[Any] = None
+        self.queue_from = self.created_at
         self._cond = threading.Condition()
         self._async_waiters: list[tuple[Any, Any]] = []  # (loop, asyncio.Event)
 
@@ -104,6 +152,17 @@ class GenRequest:
             self.error = error
             self.finished_at = time.time()
             self._wake()
+        if self.root_span is not None:
+            self.root_span.attrs.update(
+                {
+                    "request_id": self.id,
+                    "tokens": len(self.tokens),
+                    "preemptions": self.preemptions,
+                    "ttft_s": round(self.ttft_s, 6) if self.ttft_s is not None else None,
+                }
+            )
+            tracing.close_span(self.root_span, status="error" if error else "ok")
+            self.root_span = None
 
     def _wake(self) -> None:
         self._cond.notify_all()
@@ -121,6 +180,16 @@ class GenRequest:
         if self.first_token_at:
             return self.first_token_at - self.created_at
         return None
+
+    def reached_end(self) -> bool:
+        """The ONE completion predicate — `_maybe_finish` and the decode-mark
+        flush both call it, so a future stop condition (stop sequences,
+        budgets) cannot leave the final decode span unflushed."""
+        return len(self.tokens) >= self.max_new_tokens or (
+            self.eos_token_id is not None
+            and bool(self.tokens)
+            and self.tokens[-1] == self.eos_token_id
+        )
 
     def wait_new(self, offset: int, timeout: Optional[float] = None) -> tuple[list[int], bool]:
         """Block until tokens beyond `offset` exist (or done/timeout);
@@ -169,6 +238,10 @@ class _Slot:
     cur_token: int = 0  # token to feed the next decode step
     state: str = "prefill"  # "prefill" | "decode"
     admitted_step: int = 0
+    # decode progress marks (ISSUE 11 timelines): the last serving.decode
+    # span's end time and the token count it covered up to
+    last_mark_t: float = 0.0
+    tokens_at_mark: int = 0
 
 
 class ServingEngine:
@@ -217,6 +290,10 @@ class ServingEngine:
         self.tokens_generated = 0
         self.requests_completed = 0
         self.preemptions = 0
+        try:
+            self.chaos_step_delay = float(os.environ.get(CHAOS_STEP_DELAY_ENV, "0") or 0)
+        except ValueError:
+            self.chaos_step_delay = 0.0
         self._ttft_window: deque[float] = deque(maxlen=100)
         self._rate_window: deque[tuple[float, int]] = deque()
         self._lock = threading.Lock()
@@ -281,6 +358,16 @@ class ServingEngine:
             prompt, max_new_tokens, request_id=request_id, eos_token_id=eos_token_id,
             trace_context=tracing.current_context(),
         )
+        if _spans_enabled():
+            # per-request timeline root (ISSUE 11): parents under the
+            # ambient context when one exists (a .remote() chain), else
+            # starts its own trace — either way every lifecycle span below
+            # stitches under ONE id, and the TTFT histogram's exemplar
+            # resolves to it via `app trace` / `app attribute --serving`
+            req.root_span = tracing.open_span(
+                "serving.request", attrs={"request_id": req.id, "prompt_tokens": len(prompt)}
+            )
+            req.trace_context = req.root_span.context
         with self._work:
             if self._stop:
                 raise EngineStopped("engine stopped")
@@ -317,6 +404,8 @@ class ServingEngine:
                 if self._stop:
                     return
             try:
+                if self.chaos_step_delay > 0:
+                    time.sleep(self.chaos_step_delay)
                 self._admit()
                 self._prefill_one()
                 self._decode_step()
@@ -383,12 +472,18 @@ class ServingEngine:
             req.admitted_at = time.time()
             self._sync_page_gauges()
             if req.trace_context is not None:
+                # queue segment: creation (or last preemption) → slot grant
                 tracing.record_span(
                     "serving.admit",
-                    start=req.created_at,
+                    start=req.queue_from,
                     end=req.admitted_at,
                     parent=req.trace_context,
-                    attrs={"request_id": req.id, "slot": free_idx, "pages": len(pages)},
+                    attrs={
+                        "request_id": req.id,
+                        "slot": free_idx,
+                        "pages": len(pages),
+                        "requeue": req.preemptions > 0,
+                    },
                 )
 
     def _prefill_one(self) -> None:
@@ -422,6 +517,19 @@ class ServingEngine:
             jnp.int32(idx),
             jnp.int32(slot.prefill_done),
         )
+        if req.trace_context is not None and _spans_enabled():
+            tracing.record_span(
+                "serving.prefill_chunk",
+                start=t0,
+                end=time.time(),
+                parent=req.trace_context,
+                attrs={
+                    "request_id": req.id,
+                    "chunk_tokens": len(chunk),
+                    "offset": slot.prefill_done,
+                    "bucket": bucket,
+                },
+            )
         slot.prefill_done += len(chunk)
         slot.pos = slot.prefill_done
         if slot.prefill_done >= len(slot.prefill_tokens):
@@ -440,6 +548,8 @@ class ServingEngine:
                     parent=req.trace_context,
                     attrs={"request_id": req.id, "prompt_tokens": len(slot.prefill_tokens)},
                 )
+            slot.last_mark_t = time.time()
+            slot.tokens_at_mark = len(req.tokens) + 1  # the token appended below
             req._append(int(next_tok))
             if len(req.tokens) == 1:
                 self._note_ttft(req)
@@ -459,6 +569,7 @@ class ServingEngine:
 
     def _note_rate(self, n: int) -> None:
         now = time.time()
+        SERVING_TOKENS.inc(n)
         self._rate_window.append((now, n))
         while self._rate_window and now - self._rate_window[0][0] > 10.0:
             self._rate_window.popleft()
@@ -516,13 +627,35 @@ class ServingEngine:
             SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
         self.allocator.free(slot.pages)
         self.cache = release_slot(self.cache, idx)
-        slot.request.preemptions += 1
+        req = slot.request
+        req.preemptions += 1
         self.preemptions += 1
         SERVING_PREEMPTIONS.inc()
         self._sync_page_gauges()
+        now = time.time()
+        if req.trace_context is not None and _spans_enabled():
+            # flush the open decode interval, then mark the preemption; the
+            # NEXT serving.admit span (anchored at queue_from) covers the
+            # requeue wait as `queue` in the attribution
+            if slot.last_mark_t and slot.state == "decode":
+                tracing.record_span(
+                    "serving.decode",
+                    start=slot.last_mark_t,
+                    end=now,
+                    parent=req.trace_context,
+                    attrs={"request_id": req.id, "tokens": len(req.tokens), "preempted": True},
+                )
+            tracing.record_span(
+                "serving.preempt",
+                start=now,
+                end=now,
+                parent=req.trace_context,
+                attrs={"request_id": req.id, "slot": idx, "tokens_kept": len(req.tokens)},
+            )
+        req.queue_from = now
         logger.debug(
-            f"serving: preempted request {slot.request.id} (slot {idx}, "
-            f"{len(slot.request.tokens)} tokens kept)"
+            f"serving: preempted request {req.id} (slot {idx}, "
+            f"{len(req.tokens)} tokens kept)"
         )
         return True
 
@@ -552,12 +685,37 @@ class ServingEngine:
         self.step_count += 1
         SERVING_BATCH_OCCUPANCY.observe(float(len(decoding)))
         emitted = 0
+        spans_on = _spans_enabled()
+        mark_every = _span_mark_tokens()
         for i, s in decoding:
             s.pos += 1  # the fed token was written at its position
             tok = int(next_host[i])
             s.cur_token = tok
-            s.request._append(tok)
+            req = s.request
+            req._append(tok)
             emitted += 1
+            if spans_on and req.trace_context is not None:
+                if req.reached_end() or len(req.tokens) - s.tokens_at_mark >= mark_every:
+                    # periodic decode progress mark: contiguous [last mark →
+                    # now] coverage, so per-token latency attributes to
+                    # `decode` with the step's batch occupancy + KV pool
+                    # state attached (ISSUE 11 timelines)
+                    now = time.time()
+                    tracing.record_span(
+                        "serving.decode",
+                        start=s.last_mark_t or now,
+                        end=now,
+                        parent=req.trace_context,
+                        attrs={
+                            "request_id": req.id,
+                            "tokens": len(req.tokens),
+                            "batch_occupancy": len(decoding),
+                            "kv_pages_free": self.allocator.free_pages,
+                            "kv_pages_allocated": self.allocator.allocated_pages,
+                        },
+                    )
+                    s.last_mark_t = now
+                    s.tokens_at_mark = len(req.tokens)
             self._maybe_finish(i, s)
         self.tokens_generated += emitted
         self._note_rate(emitted)
@@ -566,10 +724,7 @@ class ServingEngine:
         from ..models.paged_kv import release_slot
 
         req = slot.request
-        finished = len(req.tokens) >= req.max_new_tokens or (
-            req.eos_token_id is not None and req.tokens and req.tokens[-1] == req.eos_token_id
-        )
-        if not finished:
+        if not req.reached_end():
             return
         with self._lock:
             self.slots[idx] = None

@@ -37,6 +37,7 @@ def test_example_hello_world(supervisor):
     assert "[0, 1, 4, 9, 16]" in out
 
 
+@pytest.mark.slow  # re-tier (ISSUE 11): ~14 s; hello/volumes examples keep the smoke coverage
 def test_example_tpu_decode(supervisor):
     out = _run_example("02_tpu_decode.py", supervisor)
     assert "decoded tokens:" in out

@@ -70,6 +70,7 @@ def test_param_count_8b():
     assert 7.9e9 < cfg.param_count() < 8.2e9  # ~8.03B
 
 
+@pytest.mark.slow  # re-tier (ISSUE 11): ~15 s; decode/step coverage stays in the other model tests
 def test_train_demo_mesh():
     from modal_tpu.parallel.train import train_demo
 

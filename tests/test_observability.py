@@ -179,6 +179,7 @@ def test_import_telemetry_traces_container_imports(supervisor, monkeypatch):
     assert all(e["duration_s"] >= 0 for e in roots)
 
 
+@pytest.mark.slow  # re-tier (ISSUE 11): ~19 s jax-profiler dump; profiler toggling stays in test_attribution
 def test_runtime_debug_profile_recorded(supervisor):
     """runtime_debug=True wraps calls in jax.profiler.trace: an xplane dump
     lands in the task state dir and `app profile` lists it (SURVEY §5

@@ -527,8 +527,34 @@ def test_llm_service_cls_end_to_end(supervisor):
         )
         out = json.loads(urllib.request.urlopen(req, timeout=240).read())
         assert len(out["tokens"]) == 8
+        # globally-unique request ids (ISSUE 11): the auto-minted id carries
+        # the replica's container task id, so a buffered-degrade refetch on
+        # a DIFFERENT replica can never collide with a local request
+        assert out["request_id"].startswith("gr-ta-"), out["request_id"]
         stats = json.loads(urllib.request.urlopen(url + "/v1/stats", timeout=30).read())
         assert stats["requests_completed"] >= 1
+        # `modal_tpu top` renders live against the running serving app
+        # (ISSUE 11 acceptance): the replica's pushed telemetry reaches the
+        # supervisor over heartbeats, the sampler folds it into history, and
+        # the dashboard shows the replica row + fleet TTFT
+        from click.testing import CliRunner
+
+        from modal_tpu.cli.entry_point import cli
+
+        deadline = time.time() + 60
+        frame = ""
+        while time.time() < deadline:
+            supervisor.state.timeseries.sample()  # don't wait the 10 s cadence
+            result = CliRunner().invoke(
+                cli, ["top", "--once", "--state-dir", supervisor.state_dir],
+                catch_exceptions=False,
+            )
+            assert result.exit_code == 0, result.output
+            frame = result.output
+            if "ta-" in frame and "TTFT" in frame:
+                break
+            time.sleep(1.0)
+        assert "ta-" in frame, f"no replica row in top frame:\n{frame}"
 
 
 # ---------------------------------------------------------------------------

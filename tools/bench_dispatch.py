@@ -71,9 +71,9 @@ def _timed_calls(fn, n: int) -> list[float]:
 def _quantile(vals: list[float], q: float) -> float:
     # one quantile contract for the whole report: the bench's p50/p95 must
     # agree with the attribution table computed from the same run
-    from modal_tpu.observability.critical_path import _quantile as cp_quantile
+    from modal_tpu.observability.quantile import quantile as shared_quantile
 
-    return cp_quantile(sorted(vals), q)
+    return shared_quantile(sorted(vals), q)
 
 
 def main() -> None:

@@ -113,6 +113,22 @@ NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
         ("put", 1, F.TYPE_MESSAGE, F.LABEL_OPTIONAL, ".modal.tpu.api.FunctionPutOutputsRequest"),
         ("get", 2, F.TYPE_MESSAGE, F.LABEL_OPTIONAL, ".modal.tpu.api.FunctionGetInputsRequest"),
     ],
+    # Fleet SLO observability (ISSUE 11, observability/timeseries.py +
+    # slo.py): windowed history / alert / dashboard queries against the
+    # supervisor-resident time-series store. The response is JSON (like the
+    # heartbeat's telemetry_json): the payload shapes are library-defined and
+    # evolve faster than the wire — query names: describe | series |
+    # quantile | alerts | top. Journal-EXEMPT: history is runtime-transient,
+    # rebuilt by sampling.
+    "MetricsHistoryRequest": [
+        ("query", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("family", 2, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("window_s", 3, F.TYPE_FLOAT, F.LABEL_OPTIONAL, ""),
+        ("q", 4, F.TYPE_FLOAT, F.LABEL_OPTIONAL, ""),
+    ],
+    "MetricsHistoryResponse": [
+        ("payload_json", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+    ],
 }
 
 # (message, field_name, field_number, field_type) — optionally a 5-tuple with
