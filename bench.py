@@ -940,6 +940,18 @@ def _serving_regression_guard(srv: dict) -> None:
         )
     if _BANK["best"] is not None:
         _BANK["best"]["serving_obs_overhead_regression"] = obs_regression
+    # ISSUE 12: shared-prefix TTFT win is a hard floor, not a relative
+    # baseline — the acceptance bar is >= 1.5x p50 TTFT vs prefix-cache-off
+    # on the one-system-prompt workload, every run
+    prefix_speedup = srv.get("prefix_ttft_speedup")
+    prefix_regression = prefix_speedup is not None and prefix_speedup < PREFIX_TTFT_SPEEDUP_FLOOR
+    if prefix_regression:
+        sys.stderr.write(
+            f"bench[serving]: PREFIX REGRESSION shared-prefix TTFT speedup "
+            f"{prefix_speedup:.2f}x < {PREFIX_TTFT_SPEEDUP_FLOOR}x floor\n"
+        )
+    if _BANK["best"] is not None:
+        _BANK["best"]["serving_prefix_regression"] = prefix_regression
     if baseline is not None:
         base_tps = baseline.get("serving_tokens_per_s_per_chip")
         base_p99 = baseline.get("serving_p99_ttft_s")
@@ -969,6 +981,12 @@ def _serving_regression_guard(srv: dict) -> None:
                         # acceptance numbers ride the same baseline file
                         "serving_observability_overhead_pct": obs_overhead,
                         "serving_attribution_gap_share": srv.get("attribution_gap_share"),
+                        # ISSUE 12 serving-depth acceptance numbers
+                        "serving_prefix_ttft_speedup": prefix_speedup,
+                        "serving_prefix_p50_ttft_on_s": srv.get("prefix_p50_ttft_on_s"),
+                        "serving_prefix_p50_ttft_off_s": srv.get("prefix_p50_ttft_off_s"),
+                        "serving_spec_accept_ratio": srv.get("spec_accept_ratio"),
+                        "serving_spec_speedup": srv.get("spec_speedup"),
                         "written_at": time.time(),
                     },
                     f,
@@ -986,6 +1004,9 @@ DISPATCH_REGRESSION_FACTOR = 1.5
 # ISSUE 11: sampler + per-request serving spans must cost <= this much
 # tokens/s vs disabled on the bench_serving load
 OBS_OVERHEAD_LIMIT_PCT = 2.0
+# ISSUE 12: shared-prefix workload must beat prefix-cache-off p50 TTFT by
+# at least this factor (hard acceptance floor, checked every bench run)
+PREFIX_TTFT_SPEEDUP_FLOOR = 1.5
 
 
 def _dispatch_regression_guard(disp: dict) -> None:

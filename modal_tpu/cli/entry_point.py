@@ -696,7 +696,7 @@ def _render_top_frame(payload: dict) -> str:
     lines.append("")
     lines.append(
         f"  {'replica':<14} {'function':<16} {'occup':>6} {'kv free':>8} {'queue':>6} "
-        f"{'ttft p95':>9} {'tok/s':>8} {'mem MB':>8} {'age':>7}"
+        f"{'ttft p95':>9} {'tok/s':>8} {'pfx hit':>8} {'accept':>7} {'mem MB':>8} {'age':>7}"
     )
     if not replicas:
         lines.append("  (no serving replicas pushing telemetry)")
@@ -708,6 +708,8 @@ def _render_top_frame(payload: dict) -> str:
             f"{_fmt_num(r.get('queue_depth'), digits=0):>6} "
             f"{_fmt_num(r.get('ttft_p95_s'), 's', digits=3):>9} "
             f"{_fmt_num(r.get('tokens_per_s')):>8} "
+            f"{_fmt_num(r.get('prefix_hit_pct'), '%', digits=0):>8} "
+            f"{_fmt_num(r.get('spec_accept_ratio'), digits=2):>7} "
             f"{_fmt_num(r.get('memory_bytes'), scale=1e-6, digits=0):>8} "
             f"{_fmt_num(r.get('age_s'), 's', digits=0):>7}"
         )

@@ -49,12 +49,19 @@ class PagePoolExhausted(Exception):
 
 
 class PageAllocator:
-    """Host-side free-list block allocator over the page pool.
+    """Host-side free-list block allocator over the page pool, with
+    per-page refcounts for shared-prefix reuse (ISSUE 12).
 
     Pages are interchangeable (the page table adds the indirection), so this
     is exact-fit by construction: `can_alloc(n)` ⇔ `len(free) >= n`, no
     matter how fragmented the alloc/free history was. Page 0 is reserved as
-    the scratch page and never handed out."""
+    the scratch page and never handed out.
+
+    Refcounts make one physical page serveable to many readers: `alloc`
+    hands a page out at refcount 1, `share` adds a holder, `free` drops one
+    holder and only returns the page to the free list when the last holder
+    lets go. A page with refcount > 1 is copy-on-write for whoever wants to
+    mutate it (`shared()` is the engine's write-barrier predicate)."""
 
     def __init__(self, num_pages: int, page_size: int = DEFAULT_PAGE_SIZE):
         if num_pages < 2:
@@ -62,6 +69,7 @@ class PageAllocator:
         self.num_pages = num_pages
         self.page_size = page_size
         self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() yields 1, 2, ...
+        self._refs: dict[int, int] = {}  # page -> live holder count
         self.high_water = 0
 
     @property
@@ -84,18 +92,43 @@ class PageAllocator:
                 f"need {n} pages, {len(self._free)} free (pool {self.num_pages - 1})"
             )
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
         self.high_water = max(self.high_water, self.allocated_pages)
         return pages
 
+    def share(self, pages: list[int]) -> None:
+        """Add one holder to each page (prefix-cache entries and follower
+        slots each count as a holder)."""
+        for p in pages:
+            if self._refs.get(p, 0) <= 0:
+                raise ValueError(f"share of unallocated page {p}")
+            self._refs[p] += 1
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def shared(self, page: int) -> bool:
+        """True when more than one holder references the page — any write
+        must copy first (the CoW barrier)."""
+        return self._refs.get(page, 0) > 1
+
     def free(self, pages: list[int]) -> None:
+        """Drop one holder per page; the page returns to the free list only
+        at refcount zero. Double frees (more drops than holders) still fail
+        loudly — the refcount IS the detector."""
         if len(set(pages)) != len(pages):
             raise ValueError(f"double free within one batch: {pages}")
         for p in pages:
             if not 0 < p < self.num_pages:
                 raise ValueError(f"page {p} out of range")
-            if p in self._free:
+            if self._refs.get(p, 0) <= 0:
                 raise ValueError(f"double free of page {p}")
-        self._free.extend(pages)
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
 
 
 class PagedKVCache(NamedTuple):
@@ -164,6 +197,32 @@ def release_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
     )
 
 
+@jax.jit
+def copy_page(cache: PagedKVCache, slot: int, table_index: int, dst_page: jax.Array) -> PagedKVCache:
+    """Copy-on-write: duplicate the page the slot's table currently points
+    at (all layers' K and V rows) into `dst_page` and repoint the table.
+    The source page — still referenced by the prefix cache and/or other
+    slots — is never mutated (ISSUE 12 CoW contract)."""
+    src = cache.page_table[slot, table_index]
+    dst = dst_page.astype(jnp.int32)
+    return cache._replace(
+        k_pages=cache.k_pages.at[:, dst].set(cache.k_pages[:, src]),
+        v_pages=cache.v_pages.at[:, dst].set(cache.v_pages[:, src]),
+        page_table=cache.page_table.at[slot, table_index].set(dst),
+    )
+
+
+@jax.jit
+def set_seq_lens(cache: PagedKVCache, new_lens: jax.Array, update: jax.Array) -> PagedKVCache:
+    """Host-directed per-slot length update (speculative decoding: the
+    verify step writes k+1 candidate positions, then the HOST decides how
+    many were accepted — seq_lens is rolled to pos+accepted+1 here, and the
+    rejected positions' KV becomes unattended garbage beyond the length)."""
+    return cache._replace(
+        seq_lens=jnp.where(update, new_lens.astype(jnp.int32), cache.seq_lens)
+    )
+
+
 # -- paged forward internals --------------------------------------------------
 
 
@@ -177,12 +236,32 @@ def _scatter_kv(k_pages, v_pages, k, v, page_ids, offsets):
     )
 
 
-def _paged_attention(q, k_pages, v_pages, page_table, mask):
-    """Gather each slot's page span and attend.
-    q: [S, Sq, H, hd]; k_pages/v_pages: [P, page, n_kv, hd];
-    page_table: [S, pages_per_slot]; mask: [S, 1, Sq, K] additive.
-    Returns [S, Sq, H, hd]."""
+def _paged_attention(q, k_pages, v_pages, page_table, mask, positions=None, attn_impl="gather"):
+    """Attend each slot's page span. q: [S, Sq, H, hd]; k_pages/v_pages:
+    [P, page, n_kv, hd]; page_table: [S, pages_per_slot]; mask: [S, 1, Sq, K]
+    additive. Returns [S, Sq, H, hd].
+
+    attn_impl (static at trace time): "gather" materializes the span via
+    `k_pages[page_table]` and runs the einsum reference; "kernel" /
+    "kernel_interpret" stream pages HBM→VMEM with the Pallas decode kernel
+    (ops/paged_attention.py) — decode only (Sq == 1, `positions` = each
+    slot's token position); multi-token calls (prefill/verify) always take
+    the gather path."""
     s, sq, h, hd = q.shape
+    if attn_impl in ("kernel", "kernel_interpret") and sq == 1 and positions is not None:
+        from ..ops.paged_attention import paged_decode_attention
+
+        n_kv = k_pages.shape[2]
+        n_rep = h // n_kv
+        out = paged_decode_attention(
+            q.reshape(s, n_kv, n_rep, hd),  # repeat_kv order: head = kv*n_rep + rep
+            k_pages,
+            v_pages,
+            page_table,
+            positions,
+            interpret=(attn_impl == "kernel_interpret"),
+        )
+        return out.reshape(s, sq, h, hd)
     page = k_pages.shape[1]
     n_kv = k_pages.shape[2]
     k_span = page_table.shape[1] * page
@@ -198,7 +277,7 @@ def _paged_attention(q, k_pages, v_pages, page_table, mask):
     return jnp.einsum("shqk,skhd->sqhd", probs, v_att)
 
 
-def _paged_layer(cfg, x, layer, positions, write_page_ids, write_offsets, mask, inv_freq, page_table, kp, vp):
+def _paged_layer(cfg, x, layer, positions, write_page_ids, write_offsets, mask, inv_freq, page_table, kp, vp, attn_impl="gather"):
     """One transformer layer over paged KV. x: [S, Sq, D]; positions:
     [S, Sq]; write_page_ids/offsets: flat [S*Sq] scatter targets."""
     from .quant import qmm
@@ -217,7 +296,9 @@ def _paged_layer(cfg, x, layer, positions, write_page_ids, write_offsets, mask, 
         v.reshape(s * sq, cfg.n_kv_heads, hd),
         write_page_ids, write_offsets,
     )
-    attn_out = _paged_attention(q, kp, vp, page_table, mask)
+    attn_out = _paged_attention(
+        q, kp, vp, page_table, mask, positions=positions[:, 0], attn_impl=attn_impl
+    )
     x = x + qmm(attn_out.reshape(s, sq, cfg.n_heads * hd), layer["wo"])
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     gated = jax.nn.silu(qmm(h, layer["w_gate"]).astype(jnp.float32)).astype(x.dtype) * qmm(h, layer["w_up"])
@@ -225,14 +306,14 @@ def _paged_layer(cfg, x, layer, positions, write_page_ids, write_offsets, mask, 
     return x, kp, vp
 
 
-def _run_layers(params, cfg, x, positions, write_page_ids, write_offsets, mask, page_table, cache):
+def _run_layers(params, cfg, x, positions, write_page_ids, write_offsets, mask, page_table, cache, attn_impl="gather"):
     inv_freq = rope_frequencies(cfg)
 
     def body(x_carry, layer_and_pages):
         layer, kp, vp = layer_and_pages
         x_out, kp, vp = _paged_layer(
             cfg, x_carry, layer, positions, write_page_ids, write_offsets,
-            mask, inv_freq, page_table, kp, vp,
+            mask, inv_freq, page_table, kp, vp, attn_impl,
         )
         return x_out, (kp, vp)
 
@@ -301,18 +382,23 @@ def paged_prefill(
     return logits, jnp.argmax(logits).astype(jnp.int32), cache
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("cache",))
 def paged_decode_step(
     params: dict,
     cfg: LlamaConfig,
     tokens: jax.Array,  # [slots] int32 — current token per slot
     cache: PagedKVCache,
     active: jax.Array,  # [slots] bool
+    attn_impl: str = "gather",
 ):
     """One continuous-batching decode step over EVERY slot (fixed shape:
     inactive slots compute on garbage routed to the scratch page). Returns
     (logits [slots, V], next_tokens [slots], cache). Joining or leaving a
-    slot between steps never changes the executable — admission is data."""
+    slot between steps never changes the executable — admission is data.
+
+    attn_impl selects the attention inner: "gather" (dense span gather, runs
+    anywhere) or "kernel"/"kernel_interpret" (Pallas HBM→VMEM page streaming,
+    ops/paged_attention.py) — static, so each choice is its own executable."""
     from .quant import qembed
 
     slots = cache.num_slots
@@ -329,6 +415,7 @@ def paged_decode_step(
 
     x, k_pages, v_pages = _run_layers(
         params, cfg, x, positions[:, None], write_page_ids, write_offsets, mask, rows, cache,
+        attn_impl,
     )
     logits = _logits(params, cfg, x[:, 0, :])  # [slots, V]
     cache = cache._replace(
@@ -337,6 +424,223 @@ def paged_decode_step(
         seq_lens=jnp.where(active, cache.seq_lens + 1, cache.seq_lens),
     )
     return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def paged_verify_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [slots, K1] int32 — [cur, draft_1..draft_k] per slot
+    cache: PagedKVCache,
+    active: jax.Array,  # [slots] bool
+):
+    """Speculative-decoding verify: run K1 = k+1 tokens per slot through the
+    target model in ONE step, writing their KV at positions
+    `seq_lens[s] + [0..k]` and returning every position's logits
+    ([slots, K1, V]) — logits[s, j] is the target's distribution for the
+    token AFTER tokens[s, j].
+
+    seq_lens is deliberately NOT advanced here: acceptance is a host
+    decision (compare draft proposals against the target's own sampled
+    chain), and the host rolls seq_lens forward by accepted+1 via
+    `set_seq_lens`. Rejected positions' KV stays behind as garbage beyond
+    the rolled length — never attended, overwritten by the next writes at
+    those positions. One fixed-shape executable per (cfg, K1): speculation
+    depth is a config, not a shape that churns compiles."""
+    from .quant import qembed
+
+    slots, k1 = tokens.shape
+    page = cache.page_size
+    rows = cache.page_table  # [slots, pages_per_slot]
+    positions = cache.seq_lens[:, None] + jnp.arange(k1, dtype=jnp.int32)[None, :]  # [S, K1]
+    page_idx = jnp.clip(positions // page, 0, rows.shape[1] - 1)
+    write_page_ids = jnp.where(active[:, None], jnp.take_along_axis(rows, page_idx, axis=1), 0)
+    write_offsets = jnp.where(active[:, None], positions % page, 0)
+
+    x = qembed(params["embed"], tokens)  # [slots, K1, D]
+    kv_pos = jnp.arange(cache.kv_span, dtype=jnp.int32)[None, None, None, :]
+    mask = jnp.where(
+        kv_pos <= positions[:, None, :, None], 0.0, -jnp.inf
+    ).astype(jnp.float32)  # [S, 1, K1, K]
+
+    x, k_pages, v_pages = _run_layers(
+        params, cfg, x, positions, write_page_ids.reshape(-1), write_offsets.reshape(-1),
+        mask, rows, cache,
+    )
+    logits = _logits(params, cfg, x)  # [slots, K1, V]
+    cache = cache._replace(k_pages=k_pages, v_pages=v_pages)
+    return logits, cache
+
+
+# -- shared-prefix KV reuse (ISSUE 12) ----------------------------------------
+
+
+class PrefixCacheEntry:
+    """One cached prefix: the exact token prefix and the pages holding its
+    KV. The entry is a page holder (allocator refcount), so its pages stay
+    live after the inserting request completes — that is the whole point:
+    a fleet-wide system prompt prefilled once keeps serving followers."""
+
+    __slots__ = ("tokens", "pages", "last_used", "hits")
+
+    def __init__(self, tokens: tuple, pages: list[int]):
+        self.tokens = tokens
+        self.pages = pages
+        self.last_used = 0.0
+        self.hits = 0
+
+
+class PrefixCache:
+    """Content-keyed prefix → KV-pages lookup over the shared pool.
+
+    Keys are page-granular: an entry for prompt T is indexed under every
+    full-page prefix `T[:j*page]`, so a follower whose prompt extends T (the
+    system-prompt fleet case) finds the longest full-page match in
+    O(pages-in-prompt) dict probes. A hit can extend token-granular into the
+    entry's next, partially-matching page — that page is then refcount-shared
+    and the follower's first write into it triggers copy-on-write
+    (`copy_page`), never a mutation of cached bytes.
+
+    The cache is a holder like any slot: `lookup` refs pages for the caller,
+    `insert` refs them for the entry, `evict_lru`/`clear` un-ref. Pool
+    pressure evicts entries before the engine resorts to preempting live
+    requests (serving/engine.py)."""
+
+    def __init__(self, allocator: PageAllocator):
+        self.allocator = allocator
+        self.page_size = allocator.page_size
+        self._entries: dict[tuple, PrefixCacheEntry] = {}  # full-token key -> entry
+        self._index: dict[tuple, PrefixCacheEntry] = {}  # page-granular prefix -> entry
+        self._clock = 0.0
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def held_pages(self) -> int:
+        return sum(len(e.pages) for e in self._entries.values())
+
+    def _tick(self) -> float:
+        self._clock += 1.0
+        return self._clock
+
+    def lookup(self, tokens: list) -> Optional[tuple[list[int], int, "PrefixCacheEntry"]]:
+        """Longest cached prefix of `tokens` covering at most len(tokens)-1
+        positions (the suffix must still prefill to produce last-token
+        logits). Returns (pages, covered_tokens, entry) with one holder ref
+        taken on every returned page — the caller owns the release — or
+        None. `covered` may end mid-page; that last page arrives
+        refcount-shared and must be CoW'd before the caller writes into it.
+
+        Deliberately side-effect-free beyond the refs: hit/miss counters and
+        the entry's LRU clock move at `commit_use`/`note_miss` — a dry-pool
+        admission retried every loop iteration must not inflate hit stats or
+        keep the contested entry artificially hot against eviction."""
+        page = self.page_size
+        max_cover = len(tokens) - 1
+        for j in range(max_cover // page, 0, -1):
+            entry = self._index.get(tuple(tokens[: j * page]))
+            if entry is None:
+                continue
+            covered = j * page
+            pages = list(entry.pages[:j])
+            # token-granular extension into the entry's next (partial) page
+            if len(entry.tokens) > covered and len(entry.pages) > j:
+                limit = min(page, len(entry.tokens) - covered, max_cover - covered)
+                extra = 0
+                while extra < limit and entry.tokens[covered + extra] == tokens[covered + extra]:
+                    extra += 1
+                if extra > 0:
+                    pages.append(entry.pages[j])
+                    covered += extra
+            self.allocator.share(pages)
+            return pages, covered, entry
+        return None
+
+    def commit_use(self, entry: "PrefixCacheEntry") -> None:
+        """Count a real reuse (the admission actually went through) and
+        refresh the entry's LRU position."""
+        entry.last_used = self._tick()
+        entry.hits += 1
+        self.hits += 1
+
+    def note_miss(self) -> None:
+        self.misses += 1
+
+    def insert(self, tokens: list, pages: list[int]) -> bool:
+        """Cache `tokens`' prefix KV. `pages` is the holding slot's page list
+        (only the prompt-covering prefix is taken); the entry refs them, so
+        they outlive the slot. Needs at least one full page to be indexable.
+        Returns True if a new entry was created."""
+        page = self.page_size
+        full = len(tokens) // page
+        if full < 1:
+            return False
+        key = tuple(tokens)
+        if key in self._entries:
+            return False
+        n_pages = math.ceil(len(tokens) / page)
+        if n_pages > len(pages):
+            return False  # caller's pages don't cover the prompt (shouldn't happen)
+        entry = PrefixCacheEntry(key, list(pages[:n_pages]))
+        self.allocator.share(entry.pages)
+        entry.last_used = self._tick()
+        self._entries[key] = entry
+        for j in range(1, full + 1):
+            # first inserter wins a contested page-prefix key: stable, and
+            # the loser's entry still serves its own exact-match lookups
+            self._index.setdefault(tuple(tokens[: j * page]), entry)
+        return True
+
+    def _drop(self, entry: PrefixCacheEntry) -> None:
+        self._entries.pop(entry.tokens, None)
+        for k in [k for k, e in self._index.items() if e is entry]:
+            del self._index[k]
+        self.allocator.free(entry.pages)
+
+    def evict_lru(self) -> int:
+        """Evict the least-recently-used entry; returns how many of its
+        pages this released (pages still shared with live slots stay
+        allocated — eviction drops the cache's ref, never a reader's)."""
+        if not self._entries:
+            return 0
+        entry = min(self._entries.values(), key=lambda e: e.last_used)
+        released = sum(1 for p in entry.pages if self.allocator.refcount(p) == 1)
+        self._drop(entry)
+        return released
+
+    def clear(self) -> None:
+        for entry in list(self._entries.values()):
+            self._drop(entry)
+
+
+# -- Pallas kernel selection (MODAL_TPU_PAGED_KERNEL; ops/paged_attention.py) --
+
+PAGED_KERNEL_ENV = "MODAL_TPU_PAGED_KERNEL"
+
+
+def resolve_attn_impl() -> str:
+    """Map the env knob to a static attn_impl for `paged_decode_step`:
+
+    - auto (default): the Pallas page-streaming kernel on real TPU, the
+      gather path everywhere else (CPU CI keeps the proven einsum path hot);
+    - 1/on/kernel: force the kernel — interpret-mode off-TPU (parity runs);
+    - interpret: force interpret-mode even on TPU (kernel debugging);
+    - 0/off/gather: force the gather path (the degradation knob)."""
+    import os
+
+    val = os.environ.get(PAGED_KERNEL_ENV, "auto").strip().lower()
+    if val in ("0", "off", "false", "no", "gather"):
+        return "gather"
+    if val == "interpret":
+        return "kernel_interpret"
+    on_tpu = jax.default_backend() == "tpu"
+    if val in ("1", "on", "true", "yes", "kernel"):
+        return "kernel" if on_tpu else "kernel_interpret"
+    # auto
+    return "kernel" if on_tpu else "gather"
 
 
 # prompt-length buckets: one prefill executable per bucket serves every

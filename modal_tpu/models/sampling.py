@@ -203,6 +203,64 @@ def _filter_logits(logits: jax.Array, temperature: float, top_k: int) -> jax.Arr
     return logits
 
 
+# -- batched per-slot sampling (serving tier, ISSUE 12) ------------------------
+# The continuous-batching engine samples every decode step's [slots, V]
+# logits in ONE fixed-shape executable. Unlike `_filter_logits` above,
+# temperature/top_k/top_p here are per-row DATA, not static args — admission
+# mixing greedy and sampled requests never changes the executable.
+
+
+def filter_logits_batched(
+    logits: jax.Array,  # [N, V] float32
+    temperature: jax.Array,  # [N] float — 0 = greedy (handled by caller)
+    top_k: jax.Array,  # [N] int32 — 0 = no top-k cut
+    top_p: jax.Array,  # [N] float — 1.0 = no nucleus cut
+) -> jax.Array:
+    """Per-row temperature / top-k / top-p filtering with all knobs as data.
+    One descending sort serves both cuts; rows with top_k=0 / top_p=1 pass
+    through untouched (the thresholds degenerate to the row minimum)."""
+    n, v = logits.shape
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [N, V]
+    rows = jnp.arange(n)
+    # top-k: mask logits strictly below the k-th largest (k=0 ⇒ keep all)
+    k_eff = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v)
+    kth = sorted_desc[rows, k_eff - 1]  # [N]
+    # top-p: smallest prefix of the sorted distribution with mass >= top_p;
+    # the cutoff logit is where the cumulative softmax first crosses top_p
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cut_idx = jnp.argmax(cum >= top_p[:, None], axis=-1)  # first crossing
+    # top_p >= 1 keeps everything — and guards argmax's all-False → 0 when
+    # float error leaves cum[-1] just under 1.0
+    pth = jnp.where(top_p < 1.0, sorted_desc[rows, cut_idx], -jnp.inf)
+    thresh = jnp.maximum(kth, pth)
+    return jnp.where(scaled < thresh[:, None], -jnp.inf, scaled)
+
+
+@jax.jit
+def sample_step(
+    logits: jax.Array,  # [N, V] float32 — one position's logits per row
+    seeds: jax.Array,  # [N] int32 — per-request PRNG seed
+    indices: jax.Array,  # [N] int32 — the sampled token's index in its stream
+    temperature: jax.Array,  # [N] float32
+    top_k: jax.Array,  # [N] int32
+    top_p: jax.Array,  # [N] float32
+) -> jax.Array:
+    """Sample one token per row. The key for row i is
+    `fold_in(PRNGKey(seeds[i]), indices[i])` — a pure function of THAT
+    request's seed and position, never of batch composition. This is what
+    makes sampled streams bit-reproducible under mid-decode joins and
+    preemption/re-prefill: companions change neither the row's logits (the
+    decode step is row-wise math in one fixed-shape executable) nor its key.
+    temperature <= 0 rows take the raw argmax (exact greedy, key unused)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = filter_logits_batched(logits, temperature, top_k, top_p)
+    keys = jax.vmap(lambda s, i: jax.random.fold_in(jax.random.PRNGKey(s), i))(seeds, indices)
+    sampled = jax.vmap(lambda k, lg: jax.random.categorical(k, lg))(keys, filtered).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
 @partial(jax.jit, static_argnames=("cfg", "num_tokens", "top_k"), donate_argnames=("cache",))
 def sample_tokens(
     params: dict,

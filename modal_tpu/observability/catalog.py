@@ -349,6 +349,35 @@ KV_PAGES_FREE = REGISTRY.gauge(
     "pool, never by num_requests × max_len).",
 )
 
+# -- serving-tier depth (ISSUE 12; sampling, shared-prefix reuse, speculative
+# decoding — serving/engine.py, models/paged_kv.py, docs/SERVING.md) ----------
+
+SERVING_PREFIX_HITS = REGISTRY.counter(
+    "modal_tpu_serving_prefix_cache_hits_total",
+    "Admissions that reused cached prefix KV pages (content-keyed lookup; "
+    "the follower prefills only its suffix).",
+)
+SERVING_PREFIX_MISSES = REGISTRY.counter(
+    "modal_tpu_serving_prefix_cache_misses_total",
+    "Admissions with no cached prefix (prefix cache enabled but cold for "
+    "this prompt content).",
+)
+KV_PAGES_COW = REGISTRY.counter(
+    "modal_tpu_kv_pages_cow_copies_total",
+    "Copy-on-write page copies: a write aimed at a refcount-shared KV page "
+    "copied it first — shared prefix bytes are never mutated.",
+)
+SERVING_SPEC_ACCEPT_RATIO = REGISTRY.gauge(
+    "modal_tpu_serving_spec_accept_ratio",
+    "Draft-token acceptance ratio over the engine's trailing speculative "
+    "window (accepted / proposed; higher = more target steps skipped).",
+)
+SERVING_SAMPLED_TOKENS = REGISTRY.counter(
+    "modal_tpu_serving_sampled_tokens_total",
+    "Tokens emitted via temperature/top-k/top-p sampling (temperature > 0), "
+    "as opposed to greedy argmax.",
+)
+
 # -- fleet SLO observability (ISSUE 11; observability/timeseries.py,
 # observability/slo.py, docs/OBSERVABILITY.md) --------------------------------
 
@@ -455,6 +484,7 @@ SPAN_CATALOG: dict[str, str] = {
     "serving.prefill_chunk": "one prefill chunk's device compute (per-request timeline detail)",
     "serving.decode": "periodic decode progress mark (every N tokens; batch occupancy + KV pages attrs)",
     "serving.preempt": "KV-pool-pressure preemption: slot freed, request requeued with its prefix",
+    "serving.spec_verify": "one speculative round: draft proposals → target verify → acceptance (ISSUE 12)",
     "serving.request": "root of one serving request's lifecycle: submit → done (ISSUE 11 timelines)",
     "serving.stream": "one SSE token stream: open → done/reset (serving/api.py)",
 }

@@ -224,6 +224,15 @@ PUSH_FAMILIES = (
     "modal_tpu_serving_requests_total",
     "modal_tpu_kv_pages_allocated",
     "modal_tpu_kv_pages_free",
+    # ISSUE 12 serving depth: prefix-cache effectiveness and speculative
+    # acceptance per replica (counters delta-merge; the accept-ratio gauge
+    # is per-replica in each task's raw report, latest-wins when merged) —
+    # `modal_tpu top` renders hit% and accept from the same pushed report
+    "modal_tpu_serving_prefix_cache_hits_total",
+    "modal_tpu_serving_prefix_cache_misses_total",
+    "modal_tpu_serving_spec_accept_ratio",
+    "modal_tpu_serving_sampled_tokens_total",
+    "modal_tpu_kv_pages_cow_copies_total",
 )
 
 

@@ -94,6 +94,16 @@ def _replica_rows(state: Any) -> list[dict]:
         queue_depth = _push_gauge(report, "modal_tpu_serving_queue_depth")
         pages_free = _push_gauge(report, "modal_tpu_kv_pages_free")
         pages_alloc = _push_gauge(report, "modal_tpu_kv_pages_allocated")
+        # ISSUE 12: prefix-cache effectiveness + speculative acceptance per
+        # replica (cumulative counters in the raw push → lifetime hit rate)
+        prefix_hits = _push_gauge(report, "modal_tpu_serving_prefix_cache_hits_total")
+        prefix_misses = _push_gauge(report, "modal_tpu_serving_prefix_cache_misses_total")
+        prefix_hit_pct = None
+        if prefix_hits is not None or prefix_misses is not None:
+            lookups = (prefix_hits or 0.0) + (prefix_misses or 0.0)
+            if lookups > 0:
+                prefix_hit_pct = 100.0 * (prefix_hits or 0.0) / lookups
+        spec_accept = _push_gauge(report, "modal_tpu_serving_spec_accept_ratio")
         # batch occupancy rides as a cumulative histogram: report its mean
         occ = (report.get("modal_tpu_serving_batch_occupancy") or {}).get("series") or {}
         occ_mean = None
@@ -127,6 +137,8 @@ def _replica_rows(state: Any) -> list[dict]:
                 "batch_occupancy_mean": occ_mean,
                 "kv_pages_free": pages_free,
                 "kv_pages_allocated": pages_alloc,
+                "prefix_hit_pct": prefix_hit_pct,
+                "spec_accept_ratio": spec_accept,
                 "memory_bytes": hbm or None,
             }
         )

@@ -104,6 +104,12 @@ def _result_payload(req: GenRequest, vocab_size: int) -> dict:
         "num_tokens": len(req.tokens),
         "ttft_s": req.ttft_s,
         "preemptions": req.preemptions,
+        # effective sampling params (same contract as the SSE start event:
+        # what actually ran, post any engine-side degrade)
+        "temperature": req.temperature,
+        "top_k": req.top_k,
+        "top_p": req.top_p,
+        "seed": req.seed,
     }
     if req.error:
         out["error"] = req.error
@@ -115,12 +121,53 @@ def _result_payload(req: GenRequest, vocab_size: int) -> dict:
     return out
 
 
-def serving_asgi_app(engine: ServingEngine, max_new_tokens_limit: int = 4096) -> Callable:
+def _parse_sampling(body: dict, defaults: dict) -> dict:
+    """Parse/validate temperature/top_k/top_p/seed (ISSUE 12). Bodies omit →
+    service-level defaults; NaN/negative temperature, negative top_k, and
+    out-of-range top_p are 400s here, before they reach the engine."""
+    import math as _math
+
+    out = {}
+    temperature = body.get("temperature", defaults.get("temperature", 0.0))
+    try:
+        temperature = float(temperature)
+    except (TypeError, ValueError):
+        raise ValueError(f"temperature must be a number, got {temperature!r}")
+    if _math.isnan(temperature) or _math.isinf(temperature) or temperature < 0:
+        raise ValueError(f"temperature must be finite and >= 0, got {temperature}")
+    top_k = body.get("top_k", defaults.get("top_k", 0))
+    if not isinstance(top_k, int) or isinstance(top_k, bool) or top_k < 0:
+        raise ValueError(f"top_k must be an int >= 0, got {top_k!r}")
+    top_p = body.get("top_p", defaults.get("top_p", 1.0))
+    try:
+        top_p = float(top_p)
+    except (TypeError, ValueError):
+        raise ValueError(f"top_p must be a number, got {top_p!r}")
+    if _math.isnan(top_p) or not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    seed = body.get("seed", defaults.get("seed", 0))
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        raise ValueError(f"seed must be an int, got {seed!r}")
+    out["temperature"] = temperature
+    out["top_k"] = top_k
+    out["top_p"] = top_p
+    out["seed"] = seed
+    return out
+
+
+def serving_asgi_app(
+    engine: ServingEngine,
+    max_new_tokens_limit: int = 4096,
+    sampling_defaults: Optional[dict] = None,
+) -> Callable:
     """Build the ASGI 3 application fronting `engine`. Plug it into
     `@modal_tpu.asgi_app()` (serving/service.py does) or serve it directly
-    with runtime/asgi.py's AsgiHttpServer (tools/bench_serving.py does)."""
+    with runtime/asgi.py's AsgiHttpServer (tools/bench_serving.py does).
+    `sampling_defaults` ({temperature, top_k, top_p, seed}) fills request
+    fields the body omits (llm_service plumbs them from @app.cls kwargs)."""
 
     vocab_size = engine.cfg.vocab_size
+    defaults = dict(sampling_defaults or {})
 
     async def send_json(send, status: int, payload: dict) -> None:
         data = json.dumps(payload).encode()
@@ -159,6 +206,7 @@ def serving_asgi_app(engine: ServingEngine, max_new_tokens_limit: int = 4096) ->
             stream = bool(body.get("stream", False))
             eos = body.get("eos_token_id")
             request_id = str(body.get("request_id", ""))
+            sampling = _parse_sampling(body, defaults)
         except (ValueError, json.JSONDecodeError) as exc:
             await send_json(send, 400, {"error": str(exc)})
             return
@@ -166,6 +214,7 @@ def serving_asgi_app(engine: ServingEngine, max_new_tokens_limit: int = 4096) ->
             req = engine.submit(
                 prompt, max_new, request_id=request_id,
                 eos_token_id=int(eos) if eos is not None else None,
+                **sampling,
             )
         except EngineStopped as exc:
             # backpressure/drain, not a caller mistake: 429 tells clients to
@@ -214,7 +263,19 @@ def serving_asgi_app(engine: ServingEngine, max_new_tokens_limit: int = 4096) ->
             await send(
                 {
                     "type": "http.response.body",
-                    "body": _sse("start", {"request_id": req.id}),
+                    # the echoed sampling params are the request's EFFECTIVE
+                    # ones (a sampling-disabled engine degrades temperature
+                    # to 0 — the client sees what will actually run)
+                    "body": _sse(
+                        "start",
+                        {
+                            "request_id": req.id,
+                            "temperature": req.temperature,
+                            "top_k": req.top_k,
+                            "top_p": req.top_p,
+                            "seed": req.seed,
+                        },
+                    ),
                     "more_body": True,
                 }
             )

@@ -40,11 +40,16 @@ from ..config import logger
 from ..observability import tracing
 from ..observability.catalog import (
     KV_PAGES_ALLOCATED,
+    KV_PAGES_COW,
     KV_PAGES_FREE,
     SERVING_BATCH_OCCUPANCY,
     SERVING_PREEMPTIONS,
+    SERVING_PREFIX_HITS,
+    SERVING_PREFIX_MISSES,
     SERVING_QUEUE_DEPTH,
     SERVING_REQUESTS,
+    SERVING_SAMPLED_TOKENS,
+    SERVING_SPEC_ACCEPT_RATIO,
     SERVING_TOKENS,
     SERVING_TOKENS_PER_S,
     SERVING_TTFT,
@@ -83,9 +88,20 @@ SPAN_TOKENS_ENV = "MODAL_TPU_SERVING_SPAN_TOKENS"
 # signal shape the burn-rate alerting must catch (docs/CHAOS.md)
 CHAOS_STEP_DELAY_ENV = "MODAL_TPU_CHAOS_SERVING_STEP_DELAY_S"
 
+# ISSUE 12 degradation knobs (docs/SERVING.md degradation matrix): each new
+# serving capability individually collapsible to the PR 9 behavior.
+SAMPLING_ENV = "MODAL_TPU_SERVING_SAMPLING"  # 0 → greedy-only engine
+PREFIX_CACHE_ENV = "MODAL_TPU_SERVING_PREFIX_CACHE"  # 0 → no shared-prefix reuse
+SPEC_ENV = "MODAL_TPU_SERVING_SPEC"  # 0 → ignore any configured draft model
+# (the Pallas kernel knob MODAL_TPU_PAGED_KERNEL lives in models/paged_kv.py)
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).strip().lower() not in ("0", "false", "no", "off")
+
 
 def _spans_enabled() -> bool:
-    return os.environ.get(SPANS_ENV, "1").strip().lower() not in ("0", "false", "no", "off")
+    return _env_on(SPANS_ENV)
 
 
 def _span_mark_tokens() -> int:
@@ -114,12 +130,24 @@ class GenRequest:
         request_id: str = "",
         eos_token_id: Optional[int] = None,
         trace_context: Optional[Any] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
     ):
         self.id = request_id or f"gr-{replica_id()}-{next(_req_counter)}"
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.eos_token_id = eos_token_id
         self.trace_context = trace_context
+        # sampling params (ISSUE 12): temperature 0 = greedy; the PRNG key
+        # for this request's token #i is fold_in(PRNGKey(seed), i) — a pure
+        # function of (seed, position), so the stream is bit-reproducible
+        # under mid-decode joins and preemption/re-prefill
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed) & 0x7FFFFFFF  # PRNGKey seed space (int32-safe)
         self.created_at = time.time()
         self.admitted_at = 0.0
         self.first_token_at = 0.0
@@ -232,6 +260,7 @@ class GenRequest:
 class _Slot:
     request: GenRequest
     pages: list[int] = field(default_factory=list)
+    draft_pages: list[int] = field(default_factory=list)  # speculative: draft pool mirror
     pos: int = 0  # tokens written to the slot's pages (mirrors seq_lens)
     prefill_tokens: list[int] = field(default_factory=list)  # prompt (+ regenerated prefix)
     prefill_done: int = 0  # tokens of prefill_tokens already written
@@ -259,10 +288,19 @@ class ServingEngine:
         pages_per_slot: Optional[int] = None,
         prefill_chunk: int = 128,
         max_waiting: int = 1024,
+        draft: Optional[tuple] = None,  # (draft_params, draft_cfg) → speculative decoding
+        spec_k: int = 3,  # draft tokens proposed per speculative round
+        prefix_cache: Optional[bool] = None,  # None = env default (on)
     ):
         import math
 
-        from ..models.paged_kv import DEFAULT_PAGE_SIZE, PageAllocator, PagedKVCache
+        from ..models.paged_kv import (
+            DEFAULT_PAGE_SIZE,
+            PageAllocator,
+            PagedKVCache,
+            PrefixCache,
+            resolve_attn_impl,
+        )
 
         if getattr(cfg, "is_moe", False):
             raise ValueError("MoE configs are not paged-servable yet (dense FFN only)")
@@ -282,14 +320,53 @@ class ServingEngine:
         self.max_waiting = max_waiting
         self.allocator = PageAllocator(num_pages, page_size)
         self.cache = PagedKVCache.create(cfg, max_slots, num_pages, page_size, pages_per_slot)
+        # ISSUE 12 capability knobs, each individually degradable -----------
+        self.attn_impl = resolve_attn_impl()  # "gather" | "kernel" | "kernel_interpret"
+        self.sampling_enabled = _env_on(SAMPLING_ENV)
+        # speculative decoding: a small-config draft proposes spec_k tokens,
+        # the target verifies them in ONE multi-token step
+        self.draft_params: Optional[dict] = None
+        self.draft_cfg: Optional[Any] = None
+        self.spec_k = 0
+        if draft is not None and _env_on(SPEC_ENV):
+            draft_params, draft_cfg = draft
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({draft_cfg.vocab_size}) != target vocab ({cfg.vocab_size})"
+                )
+            if getattr(draft_cfg, "is_moe", False):
+                raise ValueError("MoE draft configs are not paged-servable")
+            self.draft_params = draft_params
+            self.draft_cfg = draft_cfg
+            self.spec_k = max(1, int(spec_k))
+            # the draft mirrors the target's slot/page geometry 1:1 (same
+            # allocator arithmetic ⇒ the pools can never disagree on fit)
+            self.draft_allocator = PageAllocator(num_pages, page_size)
+            self.draft_cache = PagedKVCache.create(
+                draft_cfg, max_slots, num_pages, page_size, pages_per_slot
+            )
+        # shared-prefix KV reuse: content-keyed lookup + CoW pages. Off in
+        # speculative mode: the draft pool holds no shared prefixes, so the
+        # draft would desync from a prefix-skipping target prefill
+        # (documented limit, docs/SERVING.md).
+        want_prefix = _env_on(PREFIX_CACHE_ENV) if prefix_cache is None else bool(prefix_cache)
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.allocator) if (want_prefix and self.spec_k == 0) else None
+        )
         self.slots: list[Optional[_Slot]] = [None] * max_slots
         self.waiting: deque[GenRequest] = deque()
         self.requests: dict[str, GenRequest] = {}  # id -> request (bounded retention)
         self._retired: deque[str] = deque()
         self.step_count = 0
         self.tokens_generated = 0
+        self.sampled_tokens = 0
         self.requests_completed = 0
         self.preemptions = 0
+        self.cow_copies = 0
+        # speculative acceptance over a trailing window (the accept-ratio
+        # gauge the heartbeat pushes per replica)
+        self._spec_window: deque[tuple[int, int]] = deque(maxlen=200)  # (accepted, proposed)
+        self.spec_rounds = 0
         try:
             self.chaos_step_delay = float(os.environ.get(CHAOS_STEP_DELAY_ENV, "0") or 0)
         except ValueError:
@@ -327,6 +404,11 @@ class ServingEngine:
         for req in leftovers:
             req._finish(error="engine stopped")
             SERVING_REQUESTS.inc(outcome="stopped")
+        # release the prefix cache's page holds (its entries are the one
+        # thing that outlives completed requests by design)
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+            self._sync_page_gauges()
 
     # -- submission ---------------------------------------------------------
 
@@ -337,26 +419,56 @@ class ServingEngine:
         *,
         request_id: str = "",
         eos_token_id: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
     ) -> GenRequest:
         """Thread-safe admission into the running loop. Returns immediately;
-        consume via the returned request's wait_new/result."""
+        consume via the returned request's wait_new/result.
+
+        temperature=0 is exact greedy; temperature>0 samples with optional
+        top_k/top_p cuts, keyed by fold_in(PRNGKey(seed), token_index) — the
+        stream is bit-reproducible for a fixed seed regardless of batch
+        companions or preemption. With MODAL_TPU_SERVING_SAMPLING=0 the
+        engine degrades every request to greedy (documented, not an error)."""
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new_tokens > self.max_context:
+        temperature = float(temperature)
+        if temperature != temperature or temperature < 0 or temperature == float("inf"):
+            raise ValueError(f"temperature must be finite and >= 0, got {temperature}")
+        top_k = int(top_k)
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        top_p = float(top_p)
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # speculative mode reserves spec_k positions of slack: a verify round
+        # starting on the request's LAST token still writes k speculative
+        # positions past it, and the page table cannot grow past
+        # pages_per_slot (an out-of-range assign would silently clamp onto a
+        # live table entry and corrupt that slot's KV)
+        effective_context = self.max_context - self.spec_k
+        if len(prompt) + max_new_tokens > effective_context:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) exceeds the "
-                f"engine's context limit ({self.max_context} = pages_per_slot × page_size)"
+                f"engine's context limit ({effective_context} = pages_per_slot × page_size"
+                + (f" − spec_k ({self.spec_k})" if self.spec_k else "")
+                + ")"
             )
         total_pages = self.allocator.num_pages - 1
         if self.allocator.pages_for(len(prompt) + max_new_tokens) > total_pages:
             raise ValueError(
                 f"request needs more KV pages than the whole pool ({total_pages})"
             )
+        if not self.sampling_enabled:
+            temperature = 0.0  # degrade: greedy-only engine (SAMPLING_ENV=0)
         req = GenRequest(
             prompt, max_new_tokens, request_id=request_id, eos_token_id=eos_token_id,
             trace_context=tracing.current_context(),
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=int(seed),
         )
         if _spans_enabled():
             # per-request timeline root (ISSUE 11): parents under the
@@ -423,6 +535,8 @@ class ServingEngine:
                 self._retired.append(s.request.id)
         for s in victims:
             self.allocator.free(s.pages)
+            if s.draft_pages:
+                self.draft_allocator.free(s.draft_pages)
             s.request._finish(error=message)
             SERVING_REQUESTS.inc(outcome="error")
         self._sync_page_gauges()
@@ -431,9 +545,26 @@ class ServingEngine:
         KV_PAGES_ALLOCATED.set(float(self.allocator.allocated_pages))
         KV_PAGES_FREE.set(float(self.allocator.free_pages))
 
+    def _evict_prefix_for(self, shortage: int) -> int:
+        """Drop LRU prefix-cache entries until `shortage` pages came free (or
+        the cache is empty). Cached prefixes are strictly cheaper to lose
+        than live requests — this always runs before a preemption."""
+        released = 0
+        while released < shortage and self.prefix_cache is not None and len(self.prefix_cache):
+            released += self.prefix_cache.evict_lru()
+        if released:
+            self._sync_page_gauges()
+        return released
+
     def _admit(self) -> None:
         """Move waiting requests into free slots while pages allow. FIFO —
-        skipping the head for a smaller request would starve long prompts."""
+        skipping the head for a smaller request would starve long prompts.
+
+        With the prefix cache on, admission first looks the prompt up by
+        content: a hit hands the slot refcounted pages holding an already-
+        prefilled prefix, and only the suffix pays prefill — the fleet-wide
+        system-prompt case prefills once, then every follower's TTFT is the
+        suffix's."""
         import jax.numpy as jnp
 
         from ..models.paged_kv import PagePoolExhausted, assign_pages
@@ -448,27 +579,60 @@ class ServingEngine:
                 req = self.waiting[0]
                 prefill_tokens = req.prompt + req.tokens  # preempted: regen prefix too
                 need = self.allocator.pages_for(len(prefill_tokens) + 1)
-                if not self.allocator.can_alloc(need):
+                shared_pages: list[int] = []
+                covered = 0
+                hit_entry = None
+                if self.prefix_cache is not None:
+                    hit = self.prefix_cache.lookup(prefill_tokens)
+                    if hit is not None:
+                        shared_pages, covered, hit_entry = hit
+                fresh_need = max(0, need - len(shared_pages))
+                draft_need = need if self.spec_k else 0
+                if not self.allocator.can_alloc(fresh_need):
+                    self._evict_prefix_for(fresh_need - self.allocator.free_pages)
+                if not self.allocator.can_alloc(fresh_need) or (
+                    draft_need and not self.draft_allocator.can_alloc(draft_need)
+                ):
+                    if shared_pages:
+                        self.allocator.free(shared_pages)  # drop the lookup's refs
                     return  # pool dry; decode-side preemption or completions will free
                 self.waiting.popleft()
                 SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
                 try:
-                    pages = self.allocator.alloc(need)
+                    pages = shared_pages + self.allocator.alloc(fresh_need)
+                    draft_pages = self.draft_allocator.alloc(draft_need) if draft_need else []
                 except PagePoolExhausted:  # pragma: no cover — guarded above
                     self.waiting.appendleft(req)
                     return
                 slot = _Slot(
                     request=req,
                     pages=pages,
+                    draft_pages=draft_pages,
                     prefill_tokens=prefill_tokens,
+                    prefill_done=covered,
+                    pos=covered,
                     admitted_step=self.step_count,
                 )
                 self.slots[free_idx] = slot
+                if self.prefix_cache is not None:
+                    # counted at admission commit, not per dry-pool retry —
+                    # cache stats, LRU clock, and Prometheus stay consistent
+                    if hit_entry is not None and covered:
+                        self.prefix_cache.commit_use(hit_entry)
+                        SERVING_PREFIX_HITS.inc()
+                    else:
+                        self.prefix_cache.note_miss()
+                        SERVING_PREFIX_MISSES.inc()
             # pad the row to pages_per_slot: assign_pages keys an executable
             # on the page-array SHAPE, so padded admissions all share one
             # compile (growth adds single pages — one more shape, total two)
             row = pages + [0] * (self.pages_per_slot - len(pages))
             self.cache = assign_pages(self.cache, free_idx, 0, jnp.asarray(row, jnp.int32))
+            if draft_pages:
+                drow = draft_pages + [0] * (self.pages_per_slot - len(draft_pages))
+                self.draft_cache = assign_pages(
+                    self.draft_cache, free_idx, 0, jnp.asarray(drow, jnp.int32)
+                )
             req.admitted_at = time.time()
             self._sync_page_gauges()
             if req.trace_context is not None:
@@ -482,9 +646,41 @@ class ServingEngine:
                         "request_id": req.id,
                         "slot": free_idx,
                         "pages": len(pages),
+                        "prefix_tokens": covered,
                         "requeue": req.preemptions > 0,
                     },
                 )
+
+    def _cow_range(self, idx: int, slot: _Slot, start_pos: int, end_pos: int) -> bool:
+        """Copy-on-write barrier: before any write to positions
+        [start_pos, end_pos), every refcount-shared page in that range is
+        copied into a private page (`copy_page`) and the shared original's
+        ref dropped — cached/shared prefix bytes are never mutated. Returns
+        False if a copy needed a page the pool couldn't provide (caller
+        preempts and retries)."""
+        import jax.numpy as jnp
+
+        from ..models.paged_kv import copy_page
+
+        page = self.page_size
+        for t_idx in range(start_pos // page, (max(start_pos, end_pos - 1)) // page + 1):
+            if t_idx >= len(slot.pages):
+                break  # growth's job, not CoW's
+            pid = slot.pages[t_idx]
+            if not self.allocator.shared(pid):
+                continue
+            if not self.allocator.can_alloc(1):
+                self._evict_prefix_for(1)
+            if not self.allocator.can_alloc(1):
+                return False
+            new_page = self.allocator.alloc(1)[0]
+            self.cache = copy_page(self.cache, idx, t_idx, jnp.int32(new_page))
+            self.allocator.free([pid])  # drop this slot's ref; other holders keep it
+            slot.pages[t_idx] = new_page
+            self.cow_copies += 1
+            KV_PAGES_COW.inc()
+            self._sync_page_gauges()
+        return True
 
     def _prefill_one(self) -> None:
         """Advance the oldest prefilling slot by one chunk. One chunk per
@@ -504,6 +700,13 @@ class ServingEngine:
         idx, slot = min(candidates, key=lambda t: t[1].admitted_step)
         req = slot.request
         chunk = slot.prefill_tokens[slot.prefill_done : slot.prefill_done + self.prefill_chunk]
+        if not self._cow_range(idx, slot, slot.prefill_done, slot.prefill_done + len(chunk)):
+            # CoW starved for a page: free capacity the hard way and retry
+            # next iteration. The needy slot itself is a valid victim — if
+            # it alone holds the pool, preempting it (requeue, pages freed)
+            # is the only move that ever unsticks the loop
+            self._preempt_youngest(exclude=())
+            return
         bucket = prefill_bucket(len(chunk), self.max_context)
         padded = np.zeros((bucket,), np.int32)
         padded[: len(chunk)] = chunk
@@ -517,6 +720,18 @@ class ServingEngine:
             jnp.int32(idx),
             jnp.int32(slot.prefill_done),
         )
+        if self.spec_k:
+            # the draft mirrors every prefill chunk (it shares no prefixes,
+            # so its cache must hold the full prompt before proposing)
+            _dl, _dn, self.draft_cache = paged_prefill(
+                self.draft_params,
+                self.draft_cfg,
+                jnp.asarray(padded),
+                jnp.int32(len(chunk)),
+                self.draft_cache,
+                jnp.int32(idx),
+                jnp.int32(slot.prefill_done),
+            )
         if req.trace_context is not None and _spans_enabled():
             tracing.record_span(
                 "serving.prefill_chunk",
@@ -539,6 +754,29 @@ class ServingEngine:
             # (already-emitted tokens re-entered via prefill_tokens and are
             # never re-appended — the continuation after them is new)
             slot.state = "decode"
+            if self.prefix_cache is not None and len(req.prompt) >= self.page_size:
+                # the prompt's KV is now resident — publish it for followers
+                # (entry refs the pages, so they outlive this request; dedup
+                # by exact prompt content inside insert)
+                self.prefix_cache.insert(req.prompt, slot.pages)
+                self._sync_page_gauges()
+            if req.temperature > 0:
+                # first/continuation token sampled with the request's own
+                # (seed, token-index) key — companion-independent by
+                # construction (models/sampling.sample_step)
+                from ..models.sampling import sample_step
+
+                tok_arr = sample_step(
+                    logits[None, :],
+                    jnp.asarray([req.seed], jnp.int32),
+                    jnp.asarray([len(req.tokens)], jnp.int32),
+                    jnp.asarray([req.temperature], jnp.float32),
+                    jnp.asarray([req.top_k], jnp.int32),
+                    jnp.asarray([req.top_p], jnp.float32),
+                )
+                next_tok = int(tok_arr[0])
+                self.sampled_tokens += 1
+                SERVING_SAMPLED_TOKENS.inc()
             slot.cur_token = int(next_tok)
             if req.trace_context is not None:
                 tracing.record_span(
@@ -577,35 +815,73 @@ class ServingEngine:
         SERVING_TOKENS_PER_S.set(sum(c for _, c in self._rate_window) / span)
 
     def _grow_pages(self) -> bool:
-        """Before a decode step, every active slot whose next write crosses a
-        page boundary gets a fresh page; a dry pool preempts the youngest
-        decoding slot and retries. Returns False if nothing can decode."""
+        """Before a decode step, every active slot whose upcoming writes
+        (one token, or k+1 in a speculative round) would cross its page
+        coverage gets fresh pages; shared pages in the write range are CoW'd.
+        A dry pool evicts cached prefixes first, then preempts the youngest
+        slot and retries. Returns False if nothing can decode."""
         import jax.numpy as jnp
 
         from ..models.paged_kv import assign_pages
 
+        lookahead = (self.spec_k + 1) if self.spec_k else 1  # positions written per round
+        span = self.page_size
         while True:
             with self._lock:
-                needy = [
+                decoding = [
                     (i, s)
                     for i, s in enumerate(self.slots)
-                    if s is not None and s.state == "decode" and s.pos >= len(s.pages) * self.page_size
+                    if s is not None and s.state == "decode"
                 ]
+            needy = [
+                (i, s, -(-(s.pos + lookahead) // span) - len(s.pages))
+                for i, s in decoding
+                if s.pos + lookahead > len(s.pages) * span
+            ]
             if not needy:
-                return True
-            short = len(needy) - self.allocator.free_pages
+                break
+            short = sum(n for _i, _s, n in needy) - self.allocator.free_pages
             if short > 0:
+                self._evict_prefix_for(short)
+                short = sum(n for _i, _s, n in needy) - self.allocator.free_pages
+            if short > 0 or (
+                self.spec_k
+                and sum(n for _i, _s, n in needy) > self.draft_allocator.free_pages
+            ):
                 if not self._preempt_youngest(exclude=()):
                     return False  # nothing left to preempt
                 continue
-            for i, s in needy:
-                page = self.allocator.alloc(1)
-                s.pages.extend(page)
-                self.cache = assign_pages(
-                    self.cache, i, len(s.pages) - 1, jnp.asarray(page, jnp.int32)
-                )
+            for i, s, n in needy:
+                pages = self.allocator.alloc(n)
+                for p in pages:
+                    s.pages.append(p)
+                    self.cache = assign_pages(
+                        self.cache, i, len(s.pages) - 1, jnp.asarray([p], jnp.int32)
+                    )
+                if self.spec_k:
+                    dpages = self.draft_allocator.alloc(n)
+                    for p in dpages:
+                        s.draft_pages.append(p)
+                        self.draft_cache = assign_pages(
+                            self.draft_cache, i, len(s.draft_pages) - 1, jnp.asarray([p], jnp.int32)
+                        )
             self._sync_page_gauges()
-            return True
+            break
+        # CoW barrier over this round's write window (a slot resuming inside
+        # a shared partial page, or an inserter decoding into the page its
+        # own prompt was published from)
+        with self._lock:
+            decoding = [
+                (i, s)
+                for i, s in enumerate(self.slots)
+                if s is not None and s.state == "decode"
+            ]
+        for i, s in decoding:
+            if not self._cow_range(i, s, s.pos, s.pos + lookahead):
+                if not self._preempt_youngest(exclude=()):
+                    return False
+                return self._grow_pages()  # geometry changed; re-run
+        return True
 
     def _preempt_youngest(self, exclude: tuple[int, ...]) -> bool:
         """Free the most-recently-admitted slot's pages and requeue its
@@ -627,6 +903,9 @@ class ServingEngine:
             SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
         self.allocator.free(slot.pages)
         self.cache = release_slot(self.cache, idx)
+        if slot.draft_pages:
+            self.draft_allocator.free(slot.draft_pages)
+            self.draft_cache = release_slot(self.draft_cache, idx)
         req = slot.request
         req.preemptions += 1
         self.preemptions += 1
@@ -659,12 +938,32 @@ class ServingEngine:
         )
         return True
 
+    def _sampling_arrays(self, decoding: list, np) -> tuple:
+        """Per-slot (seeds, indices, temps, top_ks, top_ps) for sample_step.
+        indices[i] = the slot's NEXT token index (len of its stream) — the
+        fold_in coordinate that makes sampling companion-independent."""
+        seeds = np.zeros((self.max_slots,), np.int32)
+        indices = np.zeros((self.max_slots,), np.int32)
+        temps = np.zeros((self.max_slots,), np.float32)
+        top_ks = np.zeros((self.max_slots,), np.int32)
+        top_ps = np.ones((self.max_slots,), np.float32)
+        for i, s in decoding:
+            req = s.request
+            seeds[i] = req.seed
+            indices[i] = len(req.tokens)
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+        return seeds, indices, temps, top_ks, top_ps
+
     def _decode_step(self) -> None:
         import jax.numpy as jnp
         import numpy as np
 
         from ..models.paged_kv import paged_decode_step
 
+        if self.spec_k:
+            return self._spec_round()
         if not self._grow_pages():
             return
         with self._lock:
@@ -678,9 +977,25 @@ class ServingEngine:
         for i, s in decoding:
             tokens[i] = s.cur_token
             active[i] = True
-        _logits, next_tokens, self.cache = paged_decode_step(
-            self.params, self.cfg, jnp.asarray(tokens), self.cache, jnp.asarray(active)
+        logits, next_tokens, self.cache = paged_decode_step(
+            self.params, self.cfg, jnp.asarray(tokens), self.cache, jnp.asarray(active),
+            self.attn_impl,
         )
+        if any(s.request.temperature > 0 for _i, s in decoding):
+            # one extra fixed-shape dispatch ONLY when a sampling request is
+            # in the batch — a pure-greedy batch keeps the PR 9 single-
+            # dispatch hot path (and sample_step's temp-0 rows are exact
+            # argmax, so mixed batches stay bit-identical for greedy slots)
+            from ..models.sampling import sample_step
+
+            seeds, indices, temps, top_ks, top_ps = self._sampling_arrays(decoding, np)
+            next_tokens = sample_step(
+                logits, jnp.asarray(seeds), jnp.asarray(indices),
+                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            )
+            n_sampled = sum(1 for _i, s in decoding if s.request.temperature > 0)
+            self.sampled_tokens += n_sampled
+            SERVING_SAMPLED_TOKENS.inc(n_sampled)
         next_host = np.asarray(next_tokens)
         self.step_count += 1
         SERVING_BATCH_OCCUPANCY.observe(float(len(decoding)))
@@ -720,6 +1035,169 @@ class ServingEngine:
         self.tokens_generated += emitted
         self._note_rate(emitted)
 
+    def _spec_round(self) -> None:
+        """One speculative decoding round (ISSUE 12): the draft proposes
+        spec_k tokens per slot (k+1 small decode steps — the extra feed
+        writes the last proposal's KV so a fully-accepted round leaves the
+        draft cache complete), the target verifies all of them in ONE
+        `paged_verify_step`, and emission takes the longest prefix where the
+        draft matched the target's own sampled/greedy chain, plus the
+        target's correction token.
+
+        Exactness: emitted tokens are ALWAYS the target's chain — the draft
+        only decides how many land per round. At temperature 0 that chain is
+        the target argmax chain; at temperature>0 it is the same
+        fold_in(seed, index)-keyed chain the non-speculative path samples.
+        Acceptance rate is a throughput knob, never a correctness one."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.paged_kv import paged_decode_step, paged_verify_step, set_seq_lens
+        from ..models.sampling import sample_step
+
+        if not self._grow_pages():
+            return
+        with self._lock:
+            decoding = [
+                (i, s) for i, s in enumerate(self.slots) if s is not None and s.state == "decode"
+            ]
+        if not decoding:
+            return
+        k, k1 = self.spec_k, self.spec_k + 1
+        cur = np.zeros((self.max_slots,), np.int32)
+        active = np.zeros((self.max_slots,), bool)
+        for i, s in decoding:
+            cur[i] = s.cur_token
+            active[i] = True
+        active_j = jnp.asarray(active)
+        seeds, indices, temps, top_ks, top_ps = self._sampling_arrays(decoding, np)
+        seeds_j, temps_j = jnp.asarray(seeds), jnp.asarray(temps)
+        top_ks_j, top_ps_j = jnp.asarray(top_ks), jnp.asarray(top_ps)
+
+        t0 = time.time()
+        # 1) draft chain: propose k tokens with the SAME (seed, index) keys
+        # the target will sample with — a good draft then agrees often even
+        # at temperature > 0 (identical gumbel noise, similar logits)
+        proposals = np.zeros((self.max_slots, k), np.int32)
+        feed = jnp.asarray(cur)
+        for j in range(k):
+            dlogits, _g, self.draft_cache = paged_decode_step(
+                self.draft_params, self.draft_cfg, feed, self.draft_cache, active_j,
+                self.attn_impl,
+            )
+            prop = sample_step(
+                dlogits, seeds_j, jnp.asarray(indices + j), temps_j, top_ks_j, top_ps_j
+            )
+            proposals[:, j] = np.asarray(prop)
+            feed = prop
+        _dl, _dg, self.draft_cache = paged_decode_step(
+            self.draft_params, self.draft_cfg, feed, self.draft_cache, active_j, self.attn_impl
+        )
+
+        # 2) target verifies [cur, d_1..d_k] in one fixed-shape step
+        fed = np.concatenate([cur[:, None], proposals], axis=1)  # [slots, k1]
+        vlogits, self.cache = paged_verify_step(
+            self.params, self.cfg, jnp.asarray(fed), self.cache, active_j
+        )
+
+        # 3) the target's own chain at every verified position
+        flat = vlogits.reshape(self.max_slots * k1, vlogits.shape[-1])
+        idx_f = (indices[:, None] + np.arange(k1, dtype=np.int32)[None, :]).reshape(-1)
+        targets = np.asarray(
+            sample_step(
+                flat,
+                jnp.asarray(np.repeat(seeds, k1)),
+                jnp.asarray(idx_f.astype(np.int32)),
+                jnp.asarray(np.repeat(temps, k1)),
+                jnp.asarray(np.repeat(top_ks, k1)),
+                jnp.asarray(np.repeat(top_ps, k1)),
+            )
+        ).reshape(self.max_slots, k1)
+
+        # 4) host acceptance + emission
+        self.step_count += 1
+        SERVING_BATCH_OCCUPANCY.observe(float(len(decoding)))
+        spans_on = _spans_enabled()
+        mark_every = _span_mark_tokens()
+        new_lens = np.zeros((self.max_slots,), np.int32)
+        update = np.zeros((self.max_slots,), bool)
+        total_emitted = 0
+        total_accepted = 0
+        n_sampled = 0
+        for i, s in decoding:
+            req = s.request
+            emitted = 0
+            for j in range(k1):
+                tok = int(targets[i, j])
+                req._append(tok)
+                emitted += 1
+                if req.temperature > 0:
+                    n_sampled += 1
+                if req.reached_end() or j == k:
+                    break
+                if int(proposals[i, j]) != tok:
+                    break  # draft diverged: tok IS the target's correction
+                total_accepted += 1
+            new_lens[i] = s.pos + emitted
+            update[i] = True
+            s.pos += emitted
+            s.cur_token = int(targets[i, emitted - 1])
+            total_emitted += emitted
+            if spans_on and req.trace_context is not None:
+                if req.reached_end() or len(req.tokens) - s.tokens_at_mark >= mark_every:
+                    now = time.time()
+                    tracing.record_span(
+                        "serving.decode",
+                        start=s.last_mark_t or now,
+                        end=now,
+                        parent=req.trace_context,
+                        attrs={
+                            "request_id": req.id,
+                            "tokens": len(req.tokens),
+                            "batch_occupancy": len(decoding),
+                            "speculative": True,
+                            "kv_pages_free": self.allocator.free_pages,
+                            "kv_pages_allocated": self.allocator.allocated_pages,
+                        },
+                    )
+                    s.last_mark_t = now
+                    s.tokens_at_mark = len(req.tokens)
+
+        # 5) roll both pools' lengths to the accepted frontier — the verify
+        # wrote k+1 positions, only pos+emitted of them are real; the draft
+        # over-advanced by its k+1 feeds and rolls back to match. BEFORE any
+        # slot release: release_slot zeroes the slot's length, and this roll
+        # must not scribble a stale value back onto a freed slot
+        self.cache = set_seq_lens(self.cache, jnp.asarray(new_lens), jnp.asarray(update))
+        self.draft_cache = set_seq_lens(self.draft_cache, jnp.asarray(new_lens), jnp.asarray(update))
+        for i, s in decoding:
+            self._maybe_finish(i, s)
+
+        self.spec_rounds += 1
+        self._spec_window.append((total_accepted, k * len(decoding)))
+        acc = sum(a for a, _p in self._spec_window)
+        prop_total = max(1, sum(p for _a, p in self._spec_window))
+        SERVING_SPEC_ACCEPT_RATIO.set(acc / prop_total)
+        if n_sampled:
+            self.sampled_tokens += n_sampled
+            SERVING_SAMPLED_TOKENS.inc(n_sampled)
+        if spans_on:
+            rep = min(decoding, key=lambda t: t[1].admitted_step)[1].request
+            if rep.trace_context is not None:
+                tracing.record_span(
+                    "serving.spec_verify",
+                    start=t0,
+                    end=time.time(),
+                    parent=rep.trace_context,
+                    attrs={
+                        "proposed": k * len(decoding),
+                        "accepted": total_accepted,
+                        "batch": len(decoding),
+                    },
+                )
+        self.tokens_generated += total_emitted
+        self._note_rate(total_emitted)
+
     def _maybe_finish(self, idx: int, slot: _Slot) -> None:
         from ..models.paged_kv import release_slot
 
@@ -731,6 +1209,9 @@ class ServingEngine:
             self._retired.append(req.id)
         self.allocator.free(slot.pages)
         self.cache = release_slot(self.cache, idx)
+        if slot.draft_pages:
+            self.draft_allocator.free(slot.draft_pages)
+            self.draft_cache = release_slot(self.draft_cache, idx)
         self.requests_completed += 1
         SERVING_REQUESTS.inc(outcome="ok")
         self._sync_page_gauges()
@@ -742,12 +1223,15 @@ class ServingEngine:
         with self._lock:
             active = sum(1 for s in self.slots if s is not None)
             waiting = len(self.waiting)
+        acc = sum(a for a, _p in self._spec_window)
+        prop = sum(p for _a, p in self._spec_window)
         return {
             "max_slots": self.max_slots,
             "active_slots": active,
             "waiting": waiting,
             "steps": self.step_count,
             "tokens_generated": self.tokens_generated,
+            "sampled_tokens": self.sampled_tokens,
             "requests_completed": self.requests_completed,
             "preemptions": self.preemptions,
             "kv_pages_total": self.allocator.num_pages - 1,
@@ -755,6 +1239,16 @@ class ServingEngine:
             "kv_pages_free": self.allocator.free_pages,
             "kv_pages_high_water": self.allocator.high_water,
             "kv_pool_bytes": self.cache.pool_bytes(),
+            "attn_impl": self.attn_impl,
+            "sampling_enabled": self.sampling_enabled,
+            "prefix_cache_entries": len(self.prefix_cache) if self.prefix_cache else 0,
+            "prefix_cache_pages": self.prefix_cache.held_pages if self.prefix_cache else 0,
+            "prefix_cache_hits": self.prefix_cache.hits if self.prefix_cache else 0,
+            "prefix_cache_misses": self.prefix_cache.misses if self.prefix_cache else 0,
+            "kv_pages_cow_copies": self.cow_copies,
+            "spec_k": self.spec_k,
+            "spec_rounds": self.spec_rounds,
+            "spec_accept_ratio": round(acc / prop, 4) if prop else None,
             "tokens_per_s": SERVING_TOKENS_PER_S.value(),
             "ttft_p95_s": SERVING_TTFT_P95.value(),
         }

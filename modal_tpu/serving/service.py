@@ -37,6 +37,15 @@ def llm_service(
     max_containers: int = 4,
     target_ttft_ms: float = 0.0,
     target_tokens_per_replica: float = 0.0,
+    # ISSUE 12: service-level sampling defaults (request bodies override;
+    # POST /v1/generate validates both) + serving-depth knobs
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    sampling_seed: int = 0,
+    draft_model: Optional[str] = None,  # small config → speculative decoding
+    spec_k: int = 3,
+    prefix_cache: Optional[bool] = None,  # None = env default (on)
     **cls_kwargs: Any,
 ) -> Any:
     """Register a serving class on `app` and return it (an `@app.cls`
@@ -72,6 +81,12 @@ def llm_service(
                 from modal_tpu.models.quant import quantize_params
 
                 params = quantize_params(params)
+            draft = None
+            if draft_model:
+                # draft weights: a separate checkpoint is a future knob; the
+                # small-config draft initializes from the same seed today
+                draft_cfg = get_config(draft_model)
+                draft = (init_params(draft_cfg, jax.random.PRNGKey(seed)), draft_cfg)
             from modal_tpu.serving.engine import ServingEngine
 
             self.engine = ServingEngine(
@@ -82,6 +97,9 @@ def llm_service(
                 page_size=page_size,
                 pages_per_slot=pages_per_slot,
                 prefill_chunk=prefill_chunk,
+                draft=draft,
+                spec_k=spec_k,
+                prefix_cache=prefix_cache,
             ).start()
 
         @modal_tpu.exit()
@@ -92,7 +110,15 @@ def llm_service(
         def serve(self):
             from modal_tpu.serving.api import serving_asgi_app
 
-            return serving_asgi_app(self.engine)
+            return serving_asgi_app(
+                self.engine,
+                sampling_defaults={
+                    "temperature": temperature,
+                    "top_k": top_k,
+                    "top_p": top_p,
+                    "seed": sampling_seed,
+                },
+            )
 
     # rename BEFORE decoration: @app.cls registers under __name__, and the
     # deployed class/function tag must match the caller's `name`
