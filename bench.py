@@ -997,6 +997,86 @@ def _serving_regression_guard(srv: dict) -> None:
             sys.stderr.write(f"bench[serving]: baseline write failed: {exc}\n")
 
 
+def _run_analysis_phase(timeout_s: float) -> dict | None:
+    """`modal_tpu lint --json` in a subprocess (the orchestrator never
+    imports modal_tpu). Returns the parsed payload's summary numbers
+    (ISSUE 15: analysis_findings_total / analysis_baseline_size)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["MODAL_TPU_AUTO_LOCAL_SERVER"] = "0"
+    sys.stderr.write(f"bench[analysis]: lint starting (budget {timeout_s:.0f}s)\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "modal_tpu.cli", "lint", "--json"],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("bench[analysis]: timed out\n")
+        return None
+    try:
+        payload = json.loads(out.stdout)
+    except ValueError:
+        sys.stderr.write(f"bench[analysis]: unparseable output (rc={out.returncode})\n")
+        return None
+    counts = payload.get("counts", {})
+    return {
+        "findings_total": counts.get("total", -1),
+        "baseline_size": payload.get("baseline_size", -1),
+        "suppressed_inline": counts.get("suppressed_inline", 0),
+        "suppressed_baseline": counts.get("suppressed_baseline", 0),
+        "modules_scanned": payload.get("modules_scanned", 0),
+    }
+
+
+def _analysis_regression_guard(analysis: dict) -> None:
+    """ISSUE 15 satellite: the suppression baseline may only SHRINK — a
+    grown baseline (or any unsuppressed finding) flags analysis_regression
+    and keeps the old BENCH_analysis.json numbers until the debt is paid."""
+    path = os.path.join(REPO_ROOT, "BENCH_analysis.json")
+    baseline = None
+    try:
+        with open(path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        pass
+    size = analysis.get("baseline_size", -1)
+    regression = analysis.get("findings_total", 0) != 0
+    if baseline is not None and size >= 0:
+        prev = baseline.get("analysis_baseline_size")
+        if prev is not None and size > prev:
+            regression = True
+            sys.stderr.write(
+                f"bench[analysis]: REGRESSION baseline grew {prev} -> {size} "
+                "(suppressions may only shrink)\n"
+            )
+    if analysis.get("findings_total", 0) != 0:
+        sys.stderr.write(
+            f"bench[analysis]: REGRESSION {analysis.get('findings_total')} unsuppressed finding(s)\n"
+        )
+    if _BANK["best"] is not None:
+        _BANK["best"]["analysis_regression"] = regression
+    if not regression:
+        try:
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "analysis_baseline_size": size,
+                        "analysis_findings_total": analysis.get("findings_total"),
+                        "analysis_suppressed_inline": analysis.get("suppressed_inline"),
+                        "analysis_modules_scanned": analysis.get("modules_scanned"),
+                        "written_at": time.time(),
+                    },
+                    f,
+                    indent=1,
+                )
+                f.write("\n")
+        except OSError as exc:
+            sys.stderr.write(f"bench[analysis]: baseline write failed: {exc}\n")
+
+
 # dispatch-regression tolerance (ISSUE 8 satellite): the floor may wobble
 # with host noise, but a p50 >1.5x the recorded baseline (or calls/s below
 # baseline/1.5) flags dispatch_regression=true in the banked result.
@@ -1149,6 +1229,16 @@ def _orchestrate() -> None:
             # ISSUE 8 satellite: floor guard — record + tolerance-check the
             # dispatch baseline so later PRs can't silently regress it
             _dispatch_regression_guard(disp)
+    # Phase 2.85: static-analysis gate (modal_tpu lint --json, ISSUE 15):
+    # analysis_findings_total must stay 0 and analysis_baseline_size may only
+    # shrink — a grown suppression baseline flags analysis_regression exactly
+    # like a slower dispatch floor would.
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_ANALYSIS", "1") == "1" and _remaining() > 60:
+        analysis = _run_analysis_phase(min(120.0, _remaining()))
+        if analysis is not None and _BANK["best"] is not None:
+            for k, v in analysis.items():
+                _BANK["best"][f"analysis_{k}"] = v
+            _analysis_regression_guard(analysis)
     # Phase 2.9: serving-tier microbench (tools/bench_serving.py): 32
     # concurrent SSE clients vs the sequential greedy baseline — serving_*
     # fields (ISSUE 9 acceptance: >=2x tokens/s/chip, p99 TTFT, first token

@@ -39,7 +39,8 @@ class AuthTokenManager:
 
     async def _refresh_token(self) -> None:
         lock = self._get_lock()
-        async with lock:
+        # single-flight by design: one AuthTokenGet per expiry, waiters reuse it
+        async with lock:  # lint: disable=lock-across-await
             if self._token and not self._needs_refresh():
                 return  # another coroutine refreshed while we waited
             resp = await self._stub.AuthTokenGet(api_pb2.AuthTokenGetRequest())

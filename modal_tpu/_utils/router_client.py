@@ -42,7 +42,8 @@ class TaskRouterClient:
     async def connect(self) -> TaskRouterStub:
         """Resolve + dial with the bounded retry budget (the sandbox may
         still be scheduling and the worker still booting)."""
-        async with self._lock:
+        # single-flight by design: one resolve+dial flight, waiters get its stub
+        async with self._lock:  # lint: disable=lock-across-await
             if self._stub is not None:
                 return self._stub
             delay = CONNECT_BASE_DELAY

@@ -1,0 +1,38 @@
+"""Static-analysis pass suite (ISSUE 15): the correctness-tooling analogue
+of the observability tier.
+
+The repo's worst shipped bugs were all one *static* class — a lock held
+across an ``await`` wedging every producer, ``journal.group()`` held across
+an ``await`` deferring concurrent handlers' flushes, blocking sleeps on the
+event loop stalling the sub-10 ms dispatch path (docs/DISPATCH.md) — and
+each was found by review, not tooling. This package generalizes the three
+ad-hoc AST parity checks that have kept SPAN_CATALOG / journal coverage /
+RPC instrumentation green since PR 2/5/7 into a first-class, dependency-free
+framework:
+
+- ``core``        — module loader (shared source walker), one-walk
+                    ``ModuleIndex``, ``Finding`` model, inline
+                    ``# lint: disable=<rule>`` suppressions, baseline file.
+- ``concurrency`` — lock-across-await + blocking-in-async passes.
+- ``jit_purity``  — tracing-time side effects in jitted functions (they
+                    bake into traces and poison the prewarm compile cache).
+- ``knobs``       — env-knob catalog parity + degradation symmetry.
+- ``knob_catalog``— the declared ``MODAL_TPU_*`` knob inventory.
+
+``modal_tpu lint`` (cli/entry_point.py) runs the suite; a tier-1 test pins
+it clean over ``modal_tpu/``. See docs/ANALYSIS.md.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    ModuleIndex,
+    SourceModule,
+    all_passes,
+    default_baseline_path,
+    iter_source_files,
+    load_baseline,
+    load_modules,
+    module_from_source,
+    run_analysis,
+)

@@ -1,0 +1,282 @@
+"""Concurrency passes: the two shipped-bug classes from PR 8.
+
+``lock-across-await`` — a mutual-exclusion context (``threading.Lock`` /
+``asyncio.Lock`` / ``journal.group()``) held across a suspension point
+(``await`` / ``yield`` = gRPC stream write / ``async for``). Both PR 8
+shipped bugs were this shape: the keep-alive yield inside the output
+condition lock let one stalled stream consumer block every producer's
+``notify_all``, and ``journal.group()`` across an ``await`` deferred
+concurrent handlers' flushes. The asyncio-Condition idiom — ``await
+cond.wait()`` while holding ``async with cond`` — *releases* the lock
+during the wait and is exempt.
+
+``blocking-in-async`` — synchronous calls that stall the event loop inside
+``async def`` bodies: ``time.sleep``, sync ``subprocess``/``requests``/
+``urllib``, unbounded ``queue.get`` (no timeout, not awaited), and sync
+file ``open()``/``.read()`` on the dispatch/serving hot-path modules where
+a blocked loop stalls every in-flight call (docs/DISPATCH.md's sub-10 ms
+budget).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleIndex,
+    SourceModule,
+    dotted_name,
+    register,
+)
+
+# --------------------------------------------------------------------------
+# Rule 1: lock-across-await
+# --------------------------------------------------------------------------
+
+
+def _classify_ctx(expr: ast.AST) -> str | None:
+    """'lock' | 'condition' | 'journal-group' | None for a with-item's
+    context expression."""
+    target = expr.func if isinstance(expr, ast.Call) else expr
+    d = dotted_name(target)
+    if not d:
+        return None
+    dl = d.lower()
+    last = dl.rsplit(".", 1)[-1]
+    if isinstance(expr, ast.Call) and (last == "group" or last.endswith("journal_group")):
+        return "journal-group"
+    if "condition" in dl or last == "cond" or last.endswith("_cond"):
+        return "condition"
+    if "lock" in dl:
+        return "lock"
+    return None
+
+
+def _is_ctx_wait(susp: ast.AST, ctx: str) -> bool:
+    """True for the Condition idiom: ``await <ctx>.wait()``, ``await
+    <ctx>.wait_for(pred)``, or ``await asyncio.wait_for(<ctx>.wait(), t)``
+    — the wait releases the lock, so nothing is held across it."""
+    if not isinstance(susp, ast.Await) or not isinstance(susp.value, ast.Call):
+        return False
+    call = susp.value
+    d = dotted_name(call)
+    if d in (f"{ctx}.wait", f"{ctx}.wait_for"):
+        return True
+    if d.rsplit(".", 1)[-1] == "wait_for" and call.args:
+        inner = call.args[0]
+        if isinstance(inner, ast.Call) and dotted_name(inner) == f"{ctx}.wait":
+            return True
+    return False
+
+
+_SUSP_LABEL = {
+    ast.Await: "await",
+    ast.Yield: "yield (gRPC stream write suspends for the full flow-controlled send)",
+    ast.YieldFrom: "yield from",
+    ast.AsyncFor: "async for (implicit await per item)",
+    ast.AsyncWith: "async with (implicit await in __aenter__/__aexit__)",
+}
+
+
+def _run_lock_across_await(modules: list[SourceModule], ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        idx: ModuleIndex = mod.index
+        for w in idx.withs:
+            fn = idx.enclosing_function(w)
+            in_async = isinstance(fn, ast.AsyncFunctionDef) or isinstance(w, ast.AsyncWith)
+            if not in_async:
+                continue  # sync code blocking on a lock is threads doing their job
+            for item in w.items:
+                kind = _classify_ctx(item.context_expr)
+                if kind is None:
+                    continue
+                ctx_name = dotted_name(
+                    item.context_expr.func
+                    if isinstance(item.context_expr, ast.Call)
+                    else item.context_expr
+                )
+                for susp in idx.body_suspensions(w.body):
+                    if kind == "condition" and _is_ctx_wait(susp, ctx_name):
+                        continue
+                    label = _SUSP_LABEL[type(susp)]
+                    findings.append(
+                        Finding(
+                            rule="lock-across-await",
+                            path=mod.relpath,
+                            line=susp.lineno,
+                            scope=idx.qualname(w),
+                            token=f"{ctx_name}@{label.split(' ')[0]}",
+                            message=(
+                                f"{kind} context `{ctx_name}` (with at line {w.lineno}) is "
+                                f"held across a suspension point: {label}"
+                            ),
+                            anchor_lines=(w.lineno,),
+                        )
+                    )
+    return findings
+
+
+register(
+    AnalysisPass(
+        rule="lock-across-await",
+        description=(
+            "lock/journal.group() contexts held across await/yield/async-for "
+            "(the PR 8 keep-alive + group-commit bug class)"
+        ),
+        hint=(
+            "move the await/yield outside the context, or shrink the context to "
+            "the shared-state mutation; if the hold is intentional, add "
+            "`# lint: disable=lock-across-await` with a reason on the with line"
+        ),
+        run=_run_lock_across_await,
+    )
+)
+
+# --------------------------------------------------------------------------
+# Rule 2: blocking-in-async
+# --------------------------------------------------------------------------
+
+# calls that block the loop wherever they appear in an async def
+_BLOCKING_CALLS: dict[str, str] = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)` or a thread",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)` or a thread",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)` or a thread",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)` or a thread",
+    "requests.get": "use an async client or `await asyncio.to_thread(...)`",
+    "requests.post": "use an async client or `await asyncio.to_thread(...)`",
+    "requests.put": "use an async client or `await asyncio.to_thread(...)`",
+    "requests.delete": "use an async client or `await asyncio.to_thread(...)`",
+    "requests.request": "use an async client or `await asyncio.to_thread(...)`",
+    "urllib.request.urlopen": "use the async HTTP helpers in _utils/blob_utils.py",
+    "socket.create_connection": "use `asyncio.open_connection(...)`",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+}
+
+# dispatch/serving hot-path modules (package-relative): a blocked loop here
+# stalls every in-flight call, so sync file IO is flagged too
+HOT_PATH_RELPATHS = {
+    "functions.py",
+    "parallel_map.py",
+    "client.py",
+    "proto/rpc.py",
+    "_utils/local_transport.py",
+    "_utils/coalescer.py",
+    "_utils/blob_utils.py",
+    "server/services.py",
+    "server/input_plane.py",
+    "server/task_router.py",
+    "server/blob_server.py",
+    "serving/api.py",
+    "serving/engine.py",
+}
+
+_QUEUEISH = ("queue", "inbox", "outbox")
+
+
+def _is_queueish(receiver: str) -> bool:
+    last = receiver.rsplit(".", 1)[-1].lower()
+    return last == "q" or any(part in last for part in _QUEUEISH)
+
+
+# a q.get() handed to one of these is an asyncio coroutine being scheduled,
+# not a sync queue blocking the loop
+_ASYNC_CONSUMERS = {"ensure_future", "create_task", "wait_for", "shield", "gather"}
+
+
+def _async_consumed(idx: ModuleIndex, node: ast.AST) -> bool:
+    if idx.under_await(node):
+        return True
+    cur = idx.parent.get(node)
+    while cur is not None and not isinstance(
+        cur, (ast.stmt, ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    ):
+        if isinstance(cur, ast.Call) and dotted_name(cur.func).rsplit(".", 1)[-1] in _ASYNC_CONSUMERS:
+            return True
+        cur = idx.parent.get(cur)
+    return False
+
+
+def _run_blocking_in_async(modules: list[SourceModule], ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        idx: ModuleIndex = mod.index
+        hot = mod.relpath in HOT_PATH_RELPATHS
+        for call in idx.calls:
+            fn = idx.enclosing_function(call)
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            d = dotted_name(call)
+            scope = idx.qualname(call)
+            if d in _BLOCKING_CALLS:
+                findings.append(
+                    Finding(
+                        rule="blocking-in-async",
+                        path=mod.relpath,
+                        line=call.lineno,
+                        scope=scope,
+                        token=d,
+                        message=f"blocking call `{d}(...)` on the event loop (async def {fn.name})",
+                        hint=_BLOCKING_CALLS[d],
+                    )
+                )
+                continue
+            # unbounded queue.get: blocks the loop until a producer shows up
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "get"
+                and not call.args
+                and not any(k.arg in ("timeout", "block") for k in call.keywords)
+                and _is_queueish(dotted_name(call.func.value))
+                and not _async_consumed(idx, call)
+            ):
+                recv = dotted_name(call.func.value)
+                findings.append(
+                    Finding(
+                        rule="blocking-in-async",
+                        path=mod.relpath,
+                        line=call.lineno,
+                        scope=scope,
+                        token=f"{recv}.get",
+                        message=(
+                            f"unbounded `{recv}.get()` (no timeout, not awaited) inside "
+                            f"async def {fn.name} — a sync queue here wedges the loop"
+                        ),
+                        hint="await an asyncio.Queue, or pass a timeout and poll",
+                    )
+                )
+                continue
+            # sync file IO on the hot path
+            if hot and d == "open" and not _async_consumed(idx, call):
+                findings.append(
+                    Finding(
+                        rule="blocking-in-async",
+                        path=mod.relpath,
+                        line=call.lineno,
+                        scope=scope,
+                        token="open",
+                        message=(
+                            f"sync file open/read/write inside async def {fn.name} on a "
+                            f"dispatch/serving hot-path module — stalls every in-flight call"
+                        ),
+                        hint="offload to `await asyncio.to_thread(...)` or move off the hot path",
+                    )
+                )
+    return findings
+
+
+register(
+    AnalysisPass(
+        rule="blocking-in-async",
+        description=(
+            "time.sleep / sync subprocess / requests / unbounded queue.get / "
+            "hot-path file IO inside async def bodies"
+        ),
+        hint="use the asyncio equivalent or offload to a thread",
+        run=_run_blocking_in_async,
+    )
+)

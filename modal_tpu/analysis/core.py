@@ -1,0 +1,462 @@
+"""Shared infrastructure for the static-analysis pass suite.
+
+Design goals (ISSUE 15):
+
+- **Dependency-free**: stdlib ``ast`` only, so the suite runs in CI, in the
+  tier-1 test, and inside ``bench.py`` without pulling anything in.
+- **One parse + one walk per file**: every pass consumes the same
+  ``ModuleIndex`` (node lists + parent links built in a single traversal),
+  the discipline the three migrated parity checks in
+  ``tests/test_api_parity.py`` now share.
+- **Actionable findings**: every ``Finding`` carries file:line, a rule id,
+  the enclosing scope, and a fix hint.
+- **Two suppression planes**: inline ``# lint: disable=<rule>`` on the
+  finding (or its anchoring statement) line for intentional-by-design
+  sites, and ``tools/analysis_baseline.json`` entries — keyed by
+  (rule, path, scope, token), NOT line numbers, so they survive edits —
+  each with a mandatory one-line justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+# --------------------------------------------------------------------------
+# Source walker (the ONE exclusion list; adopted by the parity tests too)
+# --------------------------------------------------------------------------
+
+EXCLUDED_DIRS = {"__pycache__"}
+# generated files: findings there are noise nobody can act on
+EXCLUDED_RELPATHS = {"proto/api_pb2.py"}
+
+
+def package_root() -> str:
+    """Absolute path of the ``modal_tpu`` package dir being analyzed."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def iter_source_files(root: Optional[str] = None) -> Iterator[tuple[str, str]]:
+    """Yield ``(abs_path, relpath)`` for every analyzable ``.py`` under
+    ``root`` (default: the modal_tpu package), skipping ``__pycache__`` and
+    generated files. Deterministic (sorted) so finding order is stable."""
+    root = os.path.abspath(root or package_root())
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d not in EXCLUDED_DIRS)
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel in EXCLUDED_RELPATHS:
+                continue
+            yield path, rel
+
+
+# --------------------------------------------------------------------------
+# Modules + the one-walk index
+# --------------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\s-]+)")
+
+
+@dataclass
+class SourceModule:
+    path: str  # absolute
+    relpath: str  # relative to the scanned package root (posix)
+    text: str
+    tree: ast.Module
+    _index: Optional["ModuleIndex"] = field(default=None, repr=False)
+    _suppressions: Optional[dict[int, set[str]]] = field(default=None, repr=False)
+
+    @property
+    def index(self) -> "ModuleIndex":
+        if self._index is None:
+            self._index = ModuleIndex(self)
+        return self._index
+
+    @property
+    def suppressions(self) -> dict[int, set[str]]:
+        """line number -> set of rule ids disabled on that line."""
+        if self._suppressions is None:
+            sup: dict[int, set[str]] = {}
+            for lineno, line in enumerate(self.text.splitlines(), 1):
+                m = _DISABLE_RE.search(line)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                    sup[lineno] = rules
+            self._suppressions = sup
+        return self._suppressions
+
+    def is_suppressed(self, rule: str, lines: tuple[int, ...]) -> bool:
+        for line in lines:
+            rules = self.suppressions.get(line)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+def module_from_source(text: str, relpath: str = "<fixture>.py") -> SourceModule:
+    """Build an in-memory module (rule fixture tests use this)."""
+    return SourceModule(path=relpath, relpath=relpath, text=text, tree=ast.parse(text))
+
+
+def load_modules(root: Optional[str] = None) -> list[SourceModule]:
+    mods = []
+    for path, rel in iter_source_files(root):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            # un-parseable source can't be analyzed; the test suite would
+            # fail to import it long before lint matters
+            continue
+        mods.append(SourceModule(path=path, relpath=rel, text=text, tree=tree))
+    return mods
+
+
+def dotted_name(node: Any) -> str:
+    """``a.b.c`` for Attribute/Name chains ('' when not a plain chain).
+    For Call nodes, resolves the callee chain."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        # chain rooted in a call/subscript (e.g. ``get_lock().acquire``):
+        # keep the attribute tail so classification still sees the name
+        parts.append("")
+    else:
+        return ""
+    return ".".join(reversed(parts)).strip(".")
+
+
+_FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class ModuleIndex:
+    """Everything the passes need, built in ONE traversal of the tree:
+    typed node lists plus parent links (for scope/await lookups)."""
+
+    def __init__(self, module: SourceModule):
+        self.module = module
+        self.parent: dict[ast.AST, ast.AST] = {}
+        self.calls: list[ast.Call] = []
+        self.strings: list[ast.Constant] = []
+        self.functions: list[ast.FunctionDef | ast.AsyncFunctionDef] = []
+        self.classes: list[ast.ClassDef] = []
+        self.withs: list[ast.With | ast.AsyncWith] = []
+        self.globals_: list[ast.Global] = []
+        stack: list[ast.AST] = [module.tree]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+                stack.append(child)
+            if isinstance(node, ast.Call):
+                self.calls.append(node)
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                self.strings.append(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append(node)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                self.withs.append(node)
+            elif isinstance(node, ast.Global):
+                self.globals_.append(node)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest enclosing def/lambda (None at module level)."""
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_TYPES):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted scope name of the enclosing defs/classes (for stable
+        baseline keys); '<module>' at top level."""
+        names: list[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.append(cur.name)
+            elif isinstance(cur, ast.Lambda):
+                names.append("<lambda>")
+            cur = self.parent.get(cur)
+        return ".".join(reversed(names)) or "<module>"
+
+    def under_await(self, node: ast.AST) -> bool:
+        """True when ``node`` sits anywhere inside an ``await`` expression
+        (``await q.get()``, ``await wait_for(q.get(), t)`` …)."""
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(cur, (ast.stmt, *_FUNC_TYPES)):
+            if isinstance(cur, ast.Await):
+                return True
+            cur = self.parent.get(cur)
+        return False
+
+    def body_suspensions(self, body: list[ast.stmt]) -> list[ast.AST]:
+        """Await/Yield/YieldFrom/AsyncFor/inner-AsyncWith nodes reachable in
+        ``body`` without descending into nested function definitions (an
+        await inside a nested def is not *held* across the outer context)."""
+        out: list[ast.AST] = []
+        stack: list[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_TYPES):
+                continue
+            if isinstance(node, (ast.Await, ast.Yield, ast.YieldFrom, ast.AsyncFor, ast.AsyncWith)):
+                out.append(node)
+                if isinstance(node, (ast.AsyncFor, ast.AsyncWith)):
+                    # still scan inside: each await within is its own finding
+                    stack.extend(ast.iter_child_nodes(node))
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return sorted(out, key=lambda n: (n.lineno, n.col_offset))
+
+
+# --------------------------------------------------------------------------
+# Findings
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, e.g. "modal_tpu/server/services.py"
+    line: int
+    message: str
+    hint: str = ""
+    scope: str = "<module>"
+    token: str = ""  # short stable slug (callee / knob / ctx name)
+    # extra lines where an inline disable comment counts (e.g. the `with`
+    # statement a lock-across-await finding anchors to)
+    anchor_lines: tuple[int, ...] = ()
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity used by the baseline file."""
+        return f"{self.rule}:{self.path}:{self.scope}:{self.token}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "scope": self.scope,
+            "token": self.token,
+            "message": self.message,
+            "hint": self.hint,
+            "key": self.key,
+        }
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "tools", "analysis_baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> dict[str, str]:
+    """{finding-key: justification}. Missing file = empty baseline."""
+    path = path or default_baseline_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    entries = data.get("entries", {})
+    for key, reason in entries.items():
+        if not isinstance(reason, str) or not reason.strip():
+            raise ValueError(
+                f"baseline entry {key!r} has no justification — every baselined "
+                f"finding needs a one-line reason ({path})"
+            )
+    return dict(entries)
+
+
+def save_baseline(entries: dict[str, str], path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    payload = {
+        "version": 1,
+        "comment": (
+            "Suppressed static-analysis findings (modal_tpu lint). Keys are "
+            "rule:path:scope:token (line-free, survives edits). Every entry "
+            "MUST carry a one-line justification. This file may only shrink: "
+            "bench.py flags analysis_regression when it grows."
+        ),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return path
+
+
+# --------------------------------------------------------------------------
+# Pass registry + runner
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class AnalysisContext:
+    """What project-level passes need beyond the module list."""
+
+    src_root: str  # the scanned package dir
+    tests_root: Optional[str]  # where degradation-symmetry greps for toggles
+    path_prefix: str  # prepended to module relpaths in findings
+
+
+@dataclass
+class AnalysisPass:
+    rule: str
+    description: str
+    hint: str
+    run: Callable[[list[SourceModule], AnalysisContext], list[Finding]]
+
+
+_REGISTRY: list[AnalysisPass] = []
+
+
+def register(p: AnalysisPass) -> AnalysisPass:
+    _REGISTRY.append(p)
+    return p
+
+
+def all_passes() -> list[AnalysisPass]:
+    # importing the pass modules populates the registry
+    from . import concurrency, jit_purity, knobs  # noqa: F401
+
+    return list(_REGISTRY)
+
+
+def run_pass(
+    rule: str, modules: list[SourceModule], tests_root: Optional[str] = None
+) -> list[Finding]:
+    """Run ONE registered pass over in-memory modules (fixture tests and
+    docs examples use this; no baseline/suppression filtering)."""
+    for p in all_passes():
+        if p.rule == rule:
+            ctx = AnalysisContext(src_root="", tests_root=tests_root, path_prefix="modal_tpu")
+            return p.run(modules, ctx)
+    raise ValueError(f"unknown rule {rule!r}")
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding]  # unsuppressed — these fail the build
+    suppressed_inline: list[Finding]
+    suppressed_baseline: list[Finding]
+    baseline: dict[str, str]
+    rules: list[str]
+    modules_scanned: int
+
+    @property
+    def stale_baseline_keys(self) -> list[str]:
+        """Baseline entries nothing matches anymore — prune candidates
+        (the baseline may only shrink; stale entries hide that progress)."""
+        live = {f.key for f in self.suppressed_baseline}
+        return sorted(k for k in self.baseline if k not in live)
+
+    def counts(self) -> dict:
+        by_rule: dict[str, int] = {}
+        for f in self.findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        return {
+            "total": len(self.findings),
+            "by_rule": by_rule,
+            "suppressed_inline": len(self.suppressed_inline),
+            "suppressed_baseline": len(self.suppressed_baseline),
+            "baseline_stale": len(self.stale_baseline_keys),
+        }
+
+    def to_json(self) -> dict:
+        """The ``modal_tpu lint --json`` payload (shape pinned by
+        tests/test_analysis.py — bench.py parses it)."""
+        return {
+            "version": 1,
+            "rules": self.rules,
+            "modules_scanned": self.modules_scanned,
+            "findings": [f.to_dict() for f in self.findings],
+            "counts": self.counts(),
+            "baseline_size": len(self.baseline),
+            "stale_baseline_keys": self.stale_baseline_keys,
+        }
+
+
+def run_analysis(
+    src_root: Optional[str] = None,
+    rules: Optional[list[str]] = None,
+    baseline_path: Optional[str] = None,
+    tests_root: Optional[str] = None,
+    modules: Optional[list[SourceModule]] = None,
+) -> AnalysisResult:
+    """Run the pass suite over a source tree (default: this repo's
+    ``modal_tpu/`` package, with ``tests/`` as the toggle-grep root)."""
+    src_root = os.path.abspath(src_root or package_root())
+    if tests_root is None:
+        candidate = os.path.join(os.path.dirname(src_root), "tests")
+        tests_root = candidate if os.path.isdir(candidate) else None
+    if modules is None:
+        modules = load_modules(src_root)
+    prefix = os.path.basename(src_root)
+    ctx = AnalysisContext(src_root=src_root, tests_root=tests_root, path_prefix=prefix)
+
+    passes = all_passes()
+    known = [p.rule for p in passes]
+    if rules:
+        unknown = sorted(set(rules) - set(known))
+        if unknown:
+            raise ValueError(f"unknown rule(s) {unknown}; known: {known}")
+        passes = [p for p in passes if p.rule in set(rules)]
+
+    baseline = load_baseline(baseline_path)
+    by_rel = {m.relpath: m for m in modules}
+    findings: list[Finding] = []
+    sup_inline: list[Finding] = []
+    sup_base: list[Finding] = []
+    for p in passes:
+        for f in p.run(modules, ctx):
+            if not f.hint:
+                f.hint = p.hint
+            # findings are emitted with package-relative paths; publish them
+            # repo-relative so editors/CI land on the right file
+            rel_in_pkg = f.path
+            if not f.path.startswith(prefix + "/") and f.path != prefix:
+                f.path = f"{prefix}/{f.path}"
+            mod = by_rel.get(rel_in_pkg)
+            anchors = (f.line, *f.anchor_lines)
+            if mod is not None and mod.is_suppressed(f.rule, anchors):
+                sup_inline.append(f)
+            elif f.key in baseline:
+                sup_base.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return AnalysisResult(
+        findings=findings,
+        suppressed_inline=sup_inline,
+        suppressed_baseline=sup_base,
+        baseline=baseline,
+        rules=[p.rule for p in passes],
+        modules_scanned=len(modules),
+    )

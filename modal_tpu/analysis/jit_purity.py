@@ -1,0 +1,172 @@
+"""jit-purity pass: tracing-time side effects in functions handed to
+``jax.jit`` / ``pjit`` / ``pallas_call`` / ``shard_map``.
+
+A jitted function's Python body runs ONCE, at trace time. Reads of
+``os.environ`` / ``config`` / wall clocks / stdlib ``random`` are baked
+into the compiled executable as constants — silently wrong on the next
+call with a different environment, and poison for the PR 6 prewarm
+compile cache (the same program text must lower to the same executable
+everywhere, per the "Automatic Full Compilation … to Cloud TPUs" paper's
+AOT premise). ``jax.random`` is the pure, key-threaded API and is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleIndex,
+    SourceModule,
+    dotted_name,
+    register,
+)
+
+_JIT_WRAPPERS = {"jit", "pjit", "pallas_call", "shard_map"}
+
+# dotted-name prefixes whose evaluation at trace time is a side effect
+_IMPURE_PREFIXES = (
+    "os.environ",
+    "os.getenv",
+    "os.putenv",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "config.",
+)
+_IMPURE_EXACT = {"config"}  # config[...] subscripts / bare references
+_IMPURE_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "datetime.now",
+    "datetime.datetime.now",
+    "datetime.utcnow",
+}
+
+
+def _impure_ref(d: str) -> bool:
+    if not d:
+        return False
+    if d in _IMPURE_EXACT or d in _IMPURE_CALLS:
+        return True
+    for p in _IMPURE_PREFIXES:
+        if d == p.rstrip(".") or d.startswith(p):
+            return True
+    return False
+
+
+def _jitted_targets(idx: ModuleIndex) -> list[tuple[ast.AST, str, int]]:
+    """(function-or-lambda node, wrapper name, report line) for everything
+    this module hands to a jit-family wrapper: decorators (bare,
+    ``jax.jit(...)``-style, and ``partial(jax.jit, ...)``) plus direct
+    ``jit(fn)`` / ``pallas_call(kernel, ...)`` calls on locally-defined
+    functions or inline lambdas."""
+    by_name: dict[str, ast.AST] = {f.name: f for f in idx.functions}
+    targets: list[tuple[ast.AST, str, int]] = []
+
+    def wrapper_of(dec: ast.AST) -> str | None:
+        d = dotted_name(dec)
+        last = d.rsplit(".", 1)[-1] if d else ""
+        if last in _JIT_WRAPPERS:
+            return last
+        if isinstance(dec, ast.Call):
+            dl = dotted_name(dec.func).rsplit(".", 1)[-1]
+            if dl in _JIT_WRAPPERS:
+                return dl
+            if dl == "partial" and dec.args:
+                inner = dotted_name(dec.args[0]).rsplit(".", 1)[-1]
+                if inner in _JIT_WRAPPERS:
+                    return inner
+        return None
+
+    for fn in idx.functions:
+        for dec in fn.decorator_list:
+            w = wrapper_of(dec)
+            if w:
+                targets.append((fn, w, fn.lineno))
+    for call in idx.calls:
+        last = dotted_name(call.func).rsplit(".", 1)[-1]
+        if last not in _JIT_WRAPPERS or not call.args:
+            continue
+        arg0 = call.args[0]
+        if isinstance(arg0, ast.Lambda):
+            targets.append((arg0, last, call.lineno))
+        elif isinstance(arg0, ast.Name) and arg0.id in by_name:
+            targets.append((by_name[arg0.id], last, call.lineno))
+    return targets
+
+
+def _run_jit_purity(modules: list[SourceModule], ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        idx: ModuleIndex = mod.index
+        seen: set[tuple[int, int]] = set()  # (fn lineno, impure lineno) dedupe
+        for fn, wrapper, _line in _jitted_targets(idx):
+            name = getattr(fn, "name", "<lambda>")
+            body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+            stack: list[ast.AST] = list(body) if isinstance(body, list) else [body]
+            while stack:
+                node = stack.pop()
+                # nested defs DO count: their trace-time execution is inside
+                # the jitted trace
+                stack.extend(ast.iter_child_nodes(node))
+                impure: str | None = None
+                if isinstance(node, (ast.Attribute, ast.Name)):
+                    # skip attribute sub-chains (handled at the outermost node)
+                    parent = idx.parent.get(node)
+                    if isinstance(parent, ast.Attribute):
+                        continue
+                    d = dotted_name(node)
+                    if _impure_ref(d) and d not in _IMPURE_CALLS:
+                        impure = d
+                elif isinstance(node, ast.Call):
+                    d = dotted_name(node)
+                    if d in _IMPURE_CALLS:
+                        impure = d
+                elif isinstance(node, ast.Global):
+                    impure = "global " + ", ".join(node.names)
+                if impure is None:
+                    continue
+                key = (getattr(fn, "lineno", 0), node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        rule="jit-purity",
+                        path=mod.relpath,
+                        line=node.lineno,
+                        scope=idx.qualname(node),
+                        token=impure.split("(")[0],
+                        message=(
+                            f"`{impure}` inside `{name}` (passed to {wrapper}) executes at "
+                            f"trace time — its value bakes into the compiled executable and "
+                            f"poisons the prewarm compile cache"
+                        ),
+                        anchor_lines=(getattr(fn, "lineno", node.lineno),),
+                    )
+                )
+    return findings
+
+
+register(
+    AnalysisPass(
+        rule="jit-purity",
+        description=(
+            "os.environ/config/time/random reads and global mutation inside "
+            "functions passed to jax.jit/pjit/pallas_call/shard_map"
+        ),
+        hint=(
+            "resolve the value OUTSIDE the jitted function and pass it as an "
+            "argument (or thread a jax.random key); trace-time reads are "
+            "constants by the time the executable runs"
+        ),
+        run=_run_jit_purity,
+    )
+)

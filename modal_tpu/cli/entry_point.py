@@ -213,6 +213,79 @@ def server(port: int, workers: int, state_dir: Optional[str]) -> None:
 
 
 # ---------------------------------------------------------------------------
+# lint — the static-analysis pass suite (modal_tpu/analysis/, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+@cli.command("lint")
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable dump (shape pinned by tests; bench.py parses it).")
+@click.option("--rule", "rules", multiple=True, help="Run only this rule id (repeatable; default: all).")
+@click.option(
+    "--update-baseline",
+    is_flag=True,
+    help="Rewrite tools/analysis_baseline.json: keep live entries, add current "
+    "findings as TODO-justified, prune stale keys. Requires the full rule set.",
+)
+@click.option("--src-root", default=None, help="Package dir to analyze (default: the installed modal_tpu).")
+def lint_cmd(as_json: bool, rules: tuple[str, ...], update_baseline: bool, src_root: Optional[str]) -> None:
+    """Run the concurrency/contract static-analysis suite (docs/ANALYSIS.md):
+    lock-across-await, blocking-in-async, jit-purity, knob-parity,
+    degradation-symmetry. Exit 1 on any unsuppressed finding — the same gate
+    the tier-1 test enforces. Suppress intentionally-kept findings inline
+    (`# lint: disable=<rule>`) or in tools/analysis_baseline.json with a
+    one-line justification."""
+    from ..analysis import run_analysis
+    from ..analysis.core import save_baseline
+
+    if update_baseline and rules:
+        raise click.ClickException(
+            "--update-baseline needs the full rule set (a filtered run would "
+            "prune other rules' entries as stale)"
+        )
+
+    try:
+        res = run_analysis(src_root=src_root, rules=list(rules) or None)
+    except ValueError as exc:
+        raise click.ClickException(str(exc))
+
+    if update_baseline:
+        if src_root:
+            # a custom tree can't see the default tree's findings — its
+            # entries would all look "stale". Keep everything, only add.
+            entries = dict(res.baseline)
+            pruned = 0
+        else:
+            entries = {f.key: res.baseline[f.key] for f in res.suppressed_baseline if f.key in res.baseline}
+            pruned = len(res.stale_baseline_keys)
+        for f in res.findings:
+            entries.setdefault(f.key, "TODO: justify (added by --update-baseline)")
+        path = save_baseline(entries)
+        click.echo(
+            f"baseline rewritten: {len(entries)} entr{'y' if len(entries) == 1 else 'ies'} "
+            f"({len(res.findings)} newly added, {pruned} stale pruned) -> {path}"
+        )
+        return
+
+    if as_json:
+        click.echo(json.dumps(res.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in res.findings:
+            click.echo(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+            if f.hint:
+                click.echo(f"    hint: {f.hint}")
+        c = res.counts()
+        click.echo(
+            f"{c['total']} finding(s) in {res.modules_scanned} module(s); "
+            f"suppressed: {c['suppressed_inline']} inline, {c['suppressed_baseline']} baselined "
+            f"(baseline size {len(res.baseline)})"
+        )
+        for key in res.stale_baseline_keys:
+            click.echo(f"  stale baseline entry (nothing matches; prune it): {key}")
+    if res.findings:
+        sys.exit(1)
+
+
+# ---------------------------------------------------------------------------
 # app
 # ---------------------------------------------------------------------------
 

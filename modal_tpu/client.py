@@ -218,7 +218,9 @@ class _Client:
 
         host, port_s = server_url.removeprefix("grpc://").rsplit(":", 1)
         try:
-            probe = socket.create_connection((host, int(port_s)), timeout=0.25)
+            # one 250 ms-bounded probe, before any RPC traffic exists on this
+            # loop — nothing else is in flight to stall
+            probe = socket.create_connection((host, int(port_s)), timeout=0.25)  # lint: disable=blocking-in-async
             probe.close()
             return server_url  # a real server is listening
         except OSError:
@@ -261,7 +263,9 @@ class _Client:
             cls._client_from_env_lock = None
         if cls._client_from_env_lock is None:
             cls._client_from_env_lock = asyncio.Lock()
-        async with cls._client_from_env_lock:
+        # single-flight by design: concurrent from_env callers must wait for
+        # ONE handshake instead of racing dials
+        async with cls._client_from_env_lock:  # lint: disable=lock-across-await
             if cls._client_from_env is None or cls._client_from_env._closed:
                 server_url = await cls._maybe_boot_local_server(config["server_url"])
                 token_id = config.get("token_id")

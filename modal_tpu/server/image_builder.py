@@ -192,7 +192,8 @@ class ImageBuilder:
             with open(meta_path) as f:
                 return BuiltImage.from_json(f.read())
         lock = self._locks.setdefault(key, asyncio.Lock())
-        async with lock:
+        # single-flight by design: one build per image key, waiters reuse it
+        async with lock:  # lint: disable=lock-across-await
             # cross-process (standalone worker_main agents sharing a state
             # dir): flock serializes the build; in-process the asyncio lock
             # already did. The build happens IN final_dir — venv shebangs are
@@ -232,7 +233,8 @@ class ImageBuilder:
         marker = os.path.join(seed_dir, ".complete")
         if not os.path.exists(marker):
             lock = self._locks.setdefault(f"snapshot-{blob_id}", asyncio.Lock())
-            async with lock:
+            # single-flight by design: one snapshot extraction per blob
+            async with lock:  # lint: disable=lock-across-await
                 # cross-process (standalone worker agents sharing a state
                 # dir): same flock discipline as the layer-build path — two
                 # processes extracting into one tmp dir would corrupt the

@@ -145,7 +145,9 @@ class InputPlaneServicer:
                     grpc.StatusCode.NOT_FOUND, f"function {sub.function_id} not found"
                 )
         resp = api_pb2.AttemptStartBatchResponse()
-        with self.control._journal_group():
+        # group-commit across the per-item awaits is the DESIGN (one flush per
+        # batch, committed before return; groups are task-scoped — PR 8)
+        with self.control._journal_group():  # lint: disable=lock-across-await
             for sub in request.requests:
                 fn = self.s.functions.get(sub.function_id)
                 if fn is None:

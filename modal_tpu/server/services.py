@@ -850,7 +850,10 @@ class ModalTPUServicer:
                     grpc.StatusCode.NOT_FOUND, f"function {sub.function_id} not found"
                 )
         resp = api_pb2.FunctionMapBatchResponse()
-        with self._journal_group():
+        # group-commit across the sub-handler awaits is the DESIGN: N records,
+        # one flush, committed before this RPC returns; journal.group() is
+        # task-scoped, so interleaved handlers keep their per-record flush
+        with self._journal_group():  # lint: disable=lock-across-await
             for sub in request.requests:
                 if sub.function_id not in self.s.functions:
                     # vanished BETWEEN validation and execution (app-stop
@@ -1420,7 +1423,8 @@ class ModalTPUServicer:
                     pass
 
     async def FunctionPutOutputs(self, request: api_pb2.FunctionPutOutputsRequest, context) -> api_pb2.FunctionPutOutputsResponse:
-        with self._journal_group():
+        # task-scoped group-commit (see FunctionMapBatch): intentional hold
+        with self._journal_group():  # lint: disable=lock-across-await
             return await self._put_outputs(request)
 
     async def FunctionExchange(self, request: api_pb2.FunctionExchangeRequest, context) -> api_pb2.FunctionGetInputsResponse:
@@ -1434,7 +1438,8 @@ class ModalTPUServicer:
 
         if request.HasField("put") and request.put.outputs:
             DISPATCH_EXCHANGES.inc(carried="with_outputs")
-            with self._journal_group():
+            # task-scoped group-commit (see FunctionMapBatch): intentional hold
+            with self._journal_group():  # lint: disable=lock-across-await
                 await self._put_outputs(request.put)
         else:
             DISPATCH_EXCHANGES.inc(carried="claim_only")
@@ -2001,11 +2006,16 @@ class ModalTPUServicer:
             while len(cluster.reported) < cluster.size:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "gang rendezvous timeout")
+                    break
                 try:
                     await asyncio.wait_for(cluster.condition.wait(), timeout=remaining)
                 except asyncio.TimeoutError:
                     pass
+        if len(cluster.reported) < cluster.size:
+            # abort OUTSIDE the condition lock: the status write suspends for
+            # the full gRPC send, and holding the lock there would stall every
+            # other gang member's rendezvous report (lock-across-await)
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "gang rendezvous timeout")
         rank = cluster.task_ids.index(request.task_id)
         rank0_addr = cluster.reported[cluster.task_ids[0]]
         coordinator_host = rank0_addr.rsplit(":", 1)[0] if ":" in rank0_addr else rank0_addr

@@ -341,7 +341,9 @@ class LocalSupervisor:
         if not _journal_enabled():
             logger.warning("supervisor_crash chaos event ignored: journaling is off")
             return None
-        async with self._crash_lock:
+        # serialization IS the point: overlapping crash_restarts would tear
+        # down the same servers twice
+        async with self._crash_lock:  # lint: disable=lock-across-await
             return await self._crash_restart_locked()
 
     async def _crash_restart_locked(self) -> Optional[dict]:

@@ -140,7 +140,9 @@ class TaskRouterServicer:
         # per-exec_id lock: a retried start racing the original's subprocess
         # spawn must not create a second process
         lock = self._start_locks.setdefault(exec_id, asyncio.Lock())
-        async with lock:
+        # held across the spawn by design: the idempotency re-check and the
+        # subprocess creation must be one atomic step per exec_id
+        async with lock:  # lint: disable=lock-across-await
             if exec_id in self._execs:  # idempotent retry
                 return api_pb2.TaskExecStartResponse(exec_id=exec_id)
             env = dict(task.env)
@@ -359,7 +361,9 @@ class TaskRouterServicer:
         if st is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "exec not found")
         await self._authorize(context, st.token)
-        async with st.stdin_lock:  # serialize with any still-blocked write
+        # serialize with any still-blocked write: stdin bytes must land in
+        # offset order, so overlapping writers WAIT — that is the contract
+        async with st.stdin_lock:  # lint: disable=lock-across-await
             data = request.data
             # offset-dedupe: drop the prefix we've already accepted
             if request.offset < st.stdin_acked:

@@ -201,7 +201,8 @@ class WarmPool:
         veteran keeps serving successive placements from the same PID)."""
         if self._stopped or self._draining:
             return
-        async with self._ensure_lock:
+        # single-flight by design: concurrent converge ticks would double-spawn
+        async with self._ensure_lock:  # lint: disable=lock-across-await
             await self._ensure_locked()
 
     async def _ensure_locked(self) -> None:

@@ -173,32 +173,57 @@ def test_fastapi_endpoint_alias_and_web_server(supervisor):
         assert body["path"] == "/anything?q=1"
 
 
+# ---------------------------------------------------------------------------
+# AST parity checks, migrated onto the shared analysis framework (ISSUE 15):
+# ONE parse + ONE walk per source file (modal_tpu.analysis.core.ModuleIndex),
+# shared by all three checks through a module-scoped fixture — and the same
+# source walker `modal_tpu lint` uses, so exclusion rules (__pycache__,
+# generated api_pb2.py) live in exactly one place.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def src_modules():
+    from modal_tpu.analysis.core import load_modules
+
+    return {m.relpath: m for m in load_modules()}
+
+
+def _implemented_rpcs(module, class_name: str) -> set[str]:
+    """RPC handler names (async def, Uppercase first letter) a servicer
+    class implements — from the AST, no import of the server stack needed."""
+    import ast
+
+    for cls in module.index.classes:
+        if cls.name == class_name:
+            return {
+                node.name
+                for node in cls.body
+                if isinstance(node, ast.AsyncFunctionDef) and node.name[:1].isupper()
+            }
+    raise AssertionError(f"class {class_name} not found in {module.relpath}")
+
+
 @pytest.mark.observability
-def test_every_implemented_rpc_is_instrumented():
+def test_every_implemented_rpc_is_instrumented(src_modules):
     """Instrumentation parity: every RPC a servicer implements must be
     covered by the metrics catalog's RPC instruments. Coverage comes from
     proto/rpc.py wrapping each *registered* handler at build time, so an RPC
     implemented on a servicer but absent from the registry would be both
     unreachable and silently uninstrumented — fail it loudly here."""
-    import inspect
-
     from modal_tpu.observability import METRIC_CATALOG, instrumented_rpc_names
-    from modal_tpu.server.input_plane import InputPlaneServicer
-    from modal_tpu.server.services import ModalTPUServicer
-    from modal_tpu.server.task_router import TaskRouterServicer
 
     instrumented = instrumented_rpc_names()
-    for servicer in (ModalTPUServicer, InputPlaneServicer, TaskRouterServicer):
-        implemented = {
-            name
-            for name, fn in vars(servicer).items()
-            if name[:1].isupper()
-            and (inspect.iscoroutinefunction(fn) or inspect.isasyncgenfunction(fn))
-        }
-        assert implemented, f"{servicer.__name__} implements no RPCs?"
+    for relpath, class_name in (
+        ("server/services.py", "ModalTPUServicer"),
+        ("server/input_plane.py", "InputPlaneServicer"),
+        ("server/task_router.py", "TaskRouterServicer"),
+    ):
+        implemented = _implemented_rpcs(src_modules[relpath], class_name)
+        assert implemented, f"{class_name} implements no RPCs?"
         missing = implemented - instrumented
         assert not missing, (
-            f"{servicer.__name__} implements RPCs with no instrumentation "
+            f"{class_name} implements RPCs with no instrumentation "
             f"(not in proto/rpc.py registry → no latency/count metrics): {sorted(missing)}"
         )
     # the instruments those wrappers feed must exist in the catalog
@@ -208,24 +233,16 @@ def test_every_implemented_rpc_is_instrumented():
 
 
 @pytest.mark.recovery
-def test_every_mutating_rpc_is_journal_covered():
+def test_every_mutating_rpc_is_journal_covered(src_modules):
     """Journal-coverage parity (server/journal.py): every RPC the control
     plane implements must be classified — journaled (its effects replay
     after a crash), read-only, or explicitly exempt WITH a reason. An RPC
     that mutates ServerState but is none of the three would silently lose
     state across a supervisor restart — fail it loudly here, so adding an
     RPC forces a durability decision."""
-    import inspect
-
     from modal_tpu.server.journal import _APPLIERS, EXEMPT_RPCS, IDEMPOTENT_RPCS, JOURNALED_RPCS
-    from modal_tpu.server.services import ModalTPUServicer
 
-    implemented = {
-        name
-        for name, fn in vars(ModalTPUServicer).items()
-        if name[:1].isupper()
-        and (inspect.iscoroutinefunction(fn) or inspect.isasyncgenfunction(fn))
-    }
+    implemented = _implemented_rpcs(src_modules["server/services.py"], "ModalTPUServicer")
     assert implemented, "servicer implements no RPCs?"
     classified = JOURNALED_RPCS | set(EXEMPT_RPCS)
     # RPCs not classified at all must be read-only BY DECLARATION: the
@@ -269,57 +286,46 @@ def test_every_mutating_rpc_is_journal_covered():
 
 
 @pytest.mark.observability
-def test_every_emitted_span_is_in_catalog():
+def test_every_emitted_span_is_in_catalog(src_modules):
     """Span-catalog parity (ISSUE 7 satellite): every span name emitted
     anywhere in the tree must be declared in observability/catalog.py's
     SPAN_CATALOG, so new code can't ship span names the attribution /
     waterfall tooling has never heard of. Literal first arguments of
-    tracing.span/open_span/record_span calls are extracted by AST walk;
-    f-strings reduce to their literal prefix (matched against the catalog's
-    `prefix.*` entries)."""
+    tracing.span/open_span/record_span calls are extracted from the shared
+    ModuleIndex (same walk the other parity checks use); f-strings reduce
+    to their literal prefix (matched against the catalog's `prefix.*`
+    entries)."""
     import ast
-    import os
 
     from modal_tpu.observability.catalog import SPAN_CATALOG, declared_span_name
 
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    src_root = os.path.join(pkg_root, "modal_tpu")
     emitted: dict[str, list[str]] = {}
-    for dirpath, _dirs, files in os.walk(src_root):
-        for fname in files:
-            if not fname.endswith(".py"):
+    for mod in src_modules.values():
+        for node in mod.index.calls:
+            if not node.args:
                 continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read())
-                except SyntaxError:
+            func = node.func
+            name = getattr(func, "attr", None) or getattr(func, "id", None)
+            if name not in ("span", "open_span", "record_span"):
+                continue
+            # only tracing.* calls (skip unrelated same-named methods)
+            if isinstance(func, ast.Attribute):
+                owner = func.value
+                owner_name = getattr(owner, "attr", None) or getattr(owner, "id", None)
+                if owner_name not in ("tracing", "_tracing"):
                     continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                func = node.func
-                name = getattr(func, "attr", None) or getattr(func, "id", None)
-                if name not in ("span", "open_span", "record_span"):
-                    continue
-                # only tracing.* calls (skip unrelated same-named methods)
-                if isinstance(func, ast.Attribute):
-                    owner = func.value
-                    owner_name = getattr(owner, "attr", None) or getattr(owner, "id", None)
-                    if owner_name not in ("tracing", "_tracing"):
-                        continue
-                first = node.args[0]
-                if isinstance(first, ast.Constant) and isinstance(first.value, str):
-                    emitted.setdefault(first.value, []).append(path)
-                elif isinstance(first, ast.JoinedStr):
-                    # f"rpc.server.{name}" → prefix "rpc.server."
-                    prefix = ""
-                    for part in first.values:
-                        if isinstance(part, ast.Constant) and isinstance(part.value, str):
-                            prefix += part.value
-                        else:
-                            break
-                    emitted.setdefault(prefix, []).append(path)
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                emitted.setdefault(first.value, []).append(mod.relpath)
+            elif isinstance(first, ast.JoinedStr):
+                # f"rpc.server.{name}" → prefix "rpc.server."
+                prefix = ""
+                for part in first.values:
+                    if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                        prefix += part.value
+                    else:
+                        break
+                emitted.setdefault(prefix, []).append(mod.relpath)
     assert emitted, "AST walk found no span emissions — extractor broken?"
     # sanity: the walker sees the well-known sites
     assert "function.call" in emitted and "user.execute" in emitted
