@@ -850,7 +850,9 @@ def _run_attempt(mode: str, timeout_s: float) -> dict | None:
     return None
 
 
-def _run_microbench(label: str, script: str, sentinel: str, timeout_s: float) -> dict | None:
+def _run_microbench(
+    label: str, script: str, sentinel: str, timeout_s: float, extra_args: list[str] | None = None
+) -> dict | None:
     """Run a tools/ microbench in a subprocess (CPU, hermetic tmp state) and
     parse its one sentinel-prefixed JSON line. Shared by the recovery and
     coldstart phases so their env scrubbing can't drift."""
@@ -863,7 +865,7 @@ def _run_microbench(label: str, script: str, sentinel: str, timeout_s: float) ->
     sys.stderr.write(f"bench[{label}]: microbench starting (budget {timeout_s:.0f}s)\n")
     try:
         out = subprocess.run(
-            [sys.executable, os.path.join(REPO_ROOT, "tools", script)],
+            [sys.executable, os.path.join(REPO_ROOT, "tools", script), *(extra_args or [])],
             capture_output=True,
             timeout=timeout_s,
             text=True,
@@ -905,6 +907,84 @@ def _run_serving_bench(timeout_s: float) -> dict | None:
     continuous-batching engine vs the sequential greedy baseline (ISSUE 9:
     tokens/s/chip, p50/p99 TTFT, first-token-before-completion)."""
     return _run_microbench("serving", "bench_serving.py", "SERVING_BENCH_RESULT", timeout_s)
+
+
+def _run_control_bench(timeout_s: float) -> dict | None:
+    """tools/bench_control_plane.py: sharded-control-plane placement latency
+    (routed put-inputs p50/p99), sustained calls/s, and the mid-run
+    shard-kill takeover-to-first-placement time (ISSUE 16). The bench round
+    runs a scaled load so it fits its budget; the CLI default
+    (``python tools/bench_control_plane.py``) is the paper-scale 1M-input /
+    10k-call run, reachable here via MODAL_TPU_BENCH_CONTROL_INPUTS/_CALLS."""
+    inputs = os.environ.get("MODAL_TPU_BENCH_CONTROL_INPUTS", "100000")
+    calls = os.environ.get("MODAL_TPU_BENCH_CONTROL_CALLS", "1000")
+    return _run_microbench(
+        "control",
+        "bench_control_plane.py",
+        "CONTROL_BENCH_RESULT",
+        timeout_s,
+        extra_args=["--inputs", inputs, "--calls", calls],
+    )
+
+
+def _control_regression_guard(ctl: dict) -> None:
+    """ISSUE 16 satellite: control_placement_p99_s / control_takeover_s
+    (lower is better) and control_calls_per_s (higher is better) recorded in
+    BENCH_control.json with the same >1.5x tolerance discipline as the
+    dispatch floor — a clean run rewrites the baseline, a regressed one keeps
+    the old numbers so the flag stays red until the floor is recovered."""
+    path = os.path.join(REPO_ROOT, "BENCH_control.json")
+    baseline = None
+    try:
+        with open(path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        pass
+    p99 = ctl.get("control_placement_p99_s")
+    takeover = ctl.get("control_takeover_s")
+    cps = ctl.get("control_calls_per_s")
+    regression = False
+    if baseline is not None:
+        base_p99 = baseline.get("control_placement_p99_s")
+        base_takeover = baseline.get("control_takeover_s")
+        base_cps = baseline.get("control_calls_per_s")
+        if base_p99 and p99 and p99 > base_p99 * DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[control]: REGRESSION placement p99 {p99:.4f}s vs baseline {base_p99:.4f}s\n"
+            )
+        if base_takeover and takeover and takeover > base_takeover * DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[control]: REGRESSION takeover {takeover:.2f}s vs baseline {base_takeover:.2f}s\n"
+            )
+        if base_cps and cps and cps < base_cps / DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[control]: REGRESSION calls/s {cps:.1f} vs baseline {base_cps:.1f}\n"
+            )
+    if _BANK["best"] is not None:
+        _BANK["best"]["control_regression"] = regression
+    if not regression:
+        try:
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "control_placement_p99_s": p99,
+                        "control_placement_p50_s": ctl.get("control_placement_p50_s"),
+                        "control_takeover_s": takeover,
+                        "control_calls_per_s": cps,
+                        "control_inputs_per_s": ctl.get("control_inputs_per_s"),
+                        "shards": ctl.get("shards"),
+                        "inputs": ctl.get("inputs"),
+                        "written_at": time.time(),
+                    },
+                    f,
+                    indent=1,
+                )
+                f.write("\n")
+        except OSError as exc:
+            sys.stderr.write(f"bench[control]: baseline write failed: {exc}\n")
 
 
 def _serving_regression_guard(srv: dict) -> None:
@@ -1254,6 +1334,17 @@ def _orchestrate() -> None:
                 else:
                     _BANK["best"][f"serving_{k}"] = v
             _serving_regression_guard(srv)
+    # Phase 2.95: sharded-control-plane microbench (tools/bench_control_plane.py):
+    # routed placement p50/p99, calls/s, and the mid-run shard-kill
+    # takeover-to-first-placement time — control_* fields (ISSUE 16
+    # acceptance evidence) + BENCH_control.json regression guard.
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_CONTROL", "1") == "1" and _remaining() > 150:
+        ctl = _run_control_bench(min(300.0, _remaining()))
+        if ctl is not None and _BANK["best"] is not None:
+            for k, v in ctl.items():
+                key = k if k.startswith("control_") else f"control_{k}"
+                _BANK["best"][key] = v
+            _control_regression_guard(ctl)
     # Phase 3: poll the relay for a bounded window (never against our own
     # total deadline — the round-3 killer), attempting TPU whenever it answers.
     while (

@@ -65,6 +65,10 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "fraction of targeted RPCs that receive injected latency"),
         _k("MODAL_TPU_CHAOS_SUPERVISOR_CRASH_AFTER", "csv", "", "docs/CHAOS.md",
            "crash+journal-recover the supervisor after N mutating RPCs (list = repeat)"),
+        _k("MODAL_TPU_CHAOS_SHARD_KILL_AFTER", "csv", "", "docs/CHAOS.md",
+           "kill shard S dead after N outputs ('S:N', list = repeat); director must take over"),
+        _k("MODAL_TPU_CHAOS_SHARD_PARTITION", "csv", "", "docs/CHAOS.md",
+           "partition shard S from health probes after N outputs for D seconds ('S:N:D')"),
         _k("MODAL_TPU_CHAOS_WARM_KILL_HANDOFF", "int", "0", "docs/CHAOS.md",
            "kill the next N warm-pool interpreters mid-handoff"),
         _k("MODAL_TPU_CHAOS_STREAM_RESETS", "int", "0", "docs/CHAOS.md",
@@ -115,6 +119,9 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "records since snapshot that trigger periodic compaction"),
         _k("MODAL_TPU_IDEMPOTENCY_MAX", "int", "8192", "docs/RECOVERY.md",
            "journal-backed RPC-dedupe seen-set capacity"),
+        # -- sharded control plane (docs/CONTROL_PLANE.md) ------------------
+        _k("MODAL_TPU_SHARDS", "int", "1", "docs/CONTROL_PLANE.md",
+           "control-plane shard count; 1 = the monolith (no director, no routing)"),
         # -- observability (docs/OBSERVABILITY.md) --------------------------
         _k("MODAL_TPU_TRACE", "bool", "1", "docs/OBSERVABILITY.md",
            "distributed tracing (span JSONL sink under <state_dir>/traces)", gate=True),

@@ -81,7 +81,14 @@ class ChaosEvent:
     requeued, checkpoint flush), ``worker_kill`` (SIGKILL the worker's
     containers, no grace), ``heartbeat_blackhole`` (drop heartbeat RPCs for
     `duration_s`), ``supervisor_crash`` (abandon the control plane's state
-    and rebuild it from the write-ahead journal — server/journal.py).
+    and rebuild it from the write-ahead journal — server/journal.py),
+    ``shard_kill`` (kill supervisor shard `shard_index` dead — no drain, no
+    flush; the director's health loop must take its partition over from the
+    journal — server/shards.py), ``shard_partition`` (network-partition shard
+    `shard_index` from the director for `duration_s`: probes fail while the
+    shard itself keeps running, exercising false-death fencing),
+    ``director_blackhole`` (drop director-routed RPCs for `duration_s`;
+    clients must ride their shard map + retry loops).
     """
 
     kind: str
@@ -89,6 +96,7 @@ class ChaosEvent:
     worker_index: int = 0
     grace_s: float = 5.0
     duration_s: float = 10.0
+    shard_index: int = 0  # target shard for shard_kill/shard_partition
     fired: bool = False
 
 
@@ -148,6 +156,13 @@ class ChaosPolicy:
         - MODAL_TPU_CHAOS_STREAM_RESETS (int N: abort the next N
           FunctionStreamOutputs streams mid-flight; clients must degrade to
           the unary poll rung — docs/DISPATCH.md)
+        - MODAL_TPU_CHAOS_SHARD_KILL_AFTER ("shard:outputs" pairs, e.g.
+          "1:50,2:200": kill shard 1 dead after 50 outputs, shard 2 after
+          200; bare ints target shard 1 — the director must journal-takeover
+          each dead partition, server/shards.py)
+        - MODAL_TPU_CHAOS_SHARD_PARTITION ("shard:outputs[:duration_s]":
+          network-partition the shard from the director's health probes —
+          the shard stays alive, probes fail)
         """
         if os.environ.get("MODAL_TPU_CHAOS", "") not in ("1", "true", "yes"):
             return None
@@ -163,6 +178,34 @@ class ChaosPolicy:
                 logger.warning(
                     f"ignoring malformed MODAL_TPU_CHAOS_SUPERVISOR_CRASH_AFTER token {part!r}"
                 )
+        for env_name, kind in (
+            ("MODAL_TPU_CHAOS_SHARD_KILL_AFTER", "shard_kill"),
+            ("MODAL_TPU_CHAOS_SHARD_PARTITION", "shard_partition"),
+        ):
+            for part in filter(
+                None, (p.strip() for p in os.environ.get(env_name, "").split(","))
+            ):
+                # "shard:outputs[:duration_s]"; a bare int targets shard 1
+                # (shard 0 is the home partition — killing it is legal but a
+                # deliberate choice, not a default)
+                try:
+                    pieces = part.split(":")
+                    if len(pieces) == 1:
+                        shard, after, duration = 1, int(pieces[0]), 10.0
+                    else:
+                        shard, after = int(pieces[0]), int(pieces[1])
+                        duration = float(pieces[2]) if len(pieces) > 2 else 10.0
+                    events.append(
+                        ChaosEvent(
+                            kind=kind,
+                            after_outputs=after,
+                            shard_index=shard,
+                            duration_s=duration,
+                        )
+                    )
+                except ValueError:
+                    # a typo'd knob must not kill the shard fleet at boot
+                    logger.warning(f"ignoring malformed {env_name} token {part!r}")
         default_rate = float(os.environ.get("MODAL_TPU_CHAOS_ERROR_RATE", "0") or 0)
         rates: dict[str, float] = {}
         spec = os.environ.get("MODAL_TPU_CHAOS_RPCS", "")

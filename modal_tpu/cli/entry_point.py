@@ -756,6 +756,14 @@ def _render_top_frame(payload: dict) -> str:
         f"mem {_fmt_num(fleet.get('device_memory_bytes'), ' MB', scale=1e-6, digits=0)}   "
         f"call err/s {_fmt_num(fleet.get('call_errors_per_s'), digits=2)}"
     )
+    if fleet.get("control_shards_active"):
+        # sharded control plane row (server/shards.py); absent on a monolith
+        lines.append(
+            f"  shards active {_fmt_num(fleet.get('control_shards_active'), digits=0)}   "
+            f"placement p95 {_fmt_num(fleet.get('placement_p95_s'), 's', digits=4)}   "
+            f"reroutes/s {_fmt_num(fleet.get('director_reroutes_per_s'), digits=2)}   "
+            f"last takeover {_fmt_num(fleet.get('shard_takeover_s'), 's', digits=3)}"
+        )
     spark = _sparkline(payload.get("tokens_sparkline") or [])
     if spark:
         lines.append(f"  tokens/s (10m) {spark}")
@@ -860,6 +868,18 @@ def journal_group() -> None:
     """Inspect/compact the control plane's write-ahead journal."""
 
 
+def _shard_dirs(root: str) -> list[str]:
+    """Shard state dirs under a sharded-control-plane root (server/shards.py):
+    <root>/shard-<i>/ with a journal. Empty for a monolith root."""
+    import glob as _glob
+
+    return sorted(
+        d
+        for d in _glob.glob(os.path.join(root, "shard-*"))
+        if os.path.isdir(os.path.join(d, "journal"))
+    )
+
+
 def _open_journal(state_dir: Optional[str]):
     from ..config import config as _config
     from ..server.journal import Journal
@@ -879,7 +899,28 @@ def _open_journal(state_dir: Optional[str]):
 @click.option("--json", "as_json", is_flag=True, help="Machine-readable status.")
 def journal_status(state_dir: Optional[str], as_json: bool) -> None:
     """Journal health: sequence position, snapshot coverage, segment sizes,
-    record counts by type."""
+    record counts by type. A sharded root (<root>/shard-*/) gets a per-shard
+    summary."""
+    from ..config import config as _config
+    from ..server.journal import Journal
+
+    root = state_dir or _config["state_dir"]
+    shards = _shard_dirs(root)
+    if shards:
+        statuses = []
+        for sdir in shards:
+            j = Journal(sdir)
+            statuses.append(j.status())
+            j.close()
+        if as_json:
+            click.echo(json.dumps({"shards": statuses}, indent=2, sort_keys=True))
+            return
+        click.echo(f"sharded control plane root {root} ({len(shards)} shard journal(s))")
+        for sdir, st in zip(shards, statuses):
+            click.echo(f"  {os.path.basename(sdir):<10} seq {st['seq']:<8} "
+                       f"snapshot<={st['snapshot_seq']:<8} {st['segments']} segment(s) "
+                       f"{st['tail_records']} tail  {st['bytes']} bytes")
+        return
     j = _open_journal(state_dir)
     st = j.status()
     j.close()
@@ -901,39 +942,60 @@ def journal_compact(state_dir: Optional[str], force: bool) -> None:
     """Offline compaction: replay the journal into a fresh state, write a
     snapshot, prune covered segments. A LIVE supervisor compacts itself
     periodically — refuse if one appears to be running (its open segment
-    would race this tool) unless --force."""
-    import urllib.request
-
+    would race this tool) unless --force. A sharded root refuses if ANY
+    shard is live (a takeover could be replaying a sibling's segments),
+    then compacts every shard journal in sequence."""
     from ..config import config as _config
-    from ..server.journal import recover_state, synthesize_records
-    from ..server.state import ServerState
 
     root = state_dir or _config["state_dir"]
+    shards = _shard_dirs(root)
+    targets = shards or [root]
+    if not force:
+        for target in targets:
+            url = _live_supervisor_url(target)
+            if url is not None:
+                what = f"shard {os.path.basename(target)}" if shards else "a live supervisor"
+                raise click.ClickException(
+                    f"{what} answers at {url} — live planes compact their own journals; "
+                    "use --force to compact anyway (risks racing an open segment or a takeover)"
+                )
+    for target in targets:
+        prefix = f"{os.path.basename(target)}: " if shards else ""
+        click.echo(prefix + _compact_one(target))
+
+
+def _live_supervisor_url(root: str) -> Optional[str]:
+    """The supervisor's metrics breadcrumb, iff something still answers it."""
+    import urllib.request
+
     url_file = os.path.join(root, "observability", "metrics_url")
-    if not force and os.path.exists(url_file):
-        with open(url_file) as f:
-            url = f.read().strip()
-        try:
-            urllib.request.urlopen(url, timeout=2).read()
-            raise click.ClickException(
-                f"a live supervisor answers at {url} — it compacts its own journal; "
-                "use --force to compact anyway (risks racing its open segment)"
-            )
-        except click.ClickException:
-            raise
-        except Exception:  # noqa: BLE001 — dead breadcrumb: safe to compact
-            pass
-    j = _open_journal(state_dir)
+    if not os.path.exists(url_file):
+        return None
+    with open(url_file) as f:
+        url = f.read().strip()
+    try:
+        urllib.request.urlopen(url, timeout=2).read()
+        return url
+    except Exception:  # noqa: BLE001 — dead breadcrumb: safe to compact
+        return None
+
+
+def _compact_one(root: str) -> str:
+    from ..server.journal import IdempotencyCache, Journal, recover_state, synthesize_records
+    from ..server.state import ServerState
+
+    jdir = os.path.join(root, "journal")
+    if not os.path.isdir(jdir):
+        raise click.ClickException(f"no journal at {jdir}")
+    j = Journal(root)
     before = j.status()
     state = ServerState(root)
-    from ..server.journal import IdempotencyCache
-
     state.idempotency = IdempotencyCache(journal=None)
     report = recover_state(state, j)
     j.write_snapshot(synthesize_records(state))
     after = j.status()
     j.close()
-    click.echo(
+    return (
         f"compacted: {before['tail_records']} tail record(s) -> snapshot at seq {after['snapshot_seq']} "
         f"({before['bytes']} -> {after['bytes']} bytes); "
         f"replayed {report['records_applied']} record(s), {report['open_calls']} open call(s)"

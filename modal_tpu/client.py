@@ -185,6 +185,19 @@ class _Client:
                 uds_path=resp.uds_path,
                 blob_local_dir=resp.blob_local_dir,
             )
+        # sharded control plane (server/shards.py): a shard map with more
+        # than one owner upgrades the stub to direct-to-shard routing — the
+        # director stays out of the unary data path entirely
+        if resp.shard_map_json:
+            import json as _json
+
+            from ._utils.shard_router import ShardRouterStub
+
+            shard_map = _json.loads(resp.shard_map_json)
+            if isinstance(self._stub, ShardRouterStub):
+                self._stub.update_map(shard_map)
+            elif len(shard_map.get("urls") or []) > 1:
+                self._stub = ShardRouterStub(self, self._stub, shard_map)
 
     async def __aenter__(self) -> "_Client":
         await self._open()
@@ -227,7 +240,18 @@ class _Client:
             pass
         from .server.supervisor import LocalSupervisor
 
-        sup = LocalSupervisor(num_workers=1, port=int(port_s))
+        # MODAL_TPU_SHARDS>1 auto-boots the sharded control plane instead
+        # (server/shards.py); 1 is the monolith degradation contract
+        try:
+            num_shards = int(os.environ.get("MODAL_TPU_SHARDS", "1") or 1)
+        except ValueError:
+            num_shards = 1
+        if num_shards > 1:
+            from .server.shards import ShardedSupervisor
+
+            sup: Any = ShardedSupervisor(num_shards=num_shards, num_workers=1, port=int(port_s))
+        else:
+            sup = LocalSupervisor(num_workers=1, port=int(port_s))
         try:
             await sup.start()
         except Exception as exc:  # noqa: BLE001 — e.g. lost a port race

@@ -432,6 +432,29 @@ CHAOS_EVENTS = REGISTRY.counter(
     ("kind",),
 )
 
+# -- sharded control plane (ISSUE 16, server/shards.py) ----------------------
+
+CONTROL_SHARDS_ACTIVE = REGISTRY.gauge(
+    "modal_tpu_control_shards_active",
+    "Supervisor shards currently serving their partitions (dead/fenced shards excluded).",
+)
+SHARD_TAKEOVER_SECONDS = REGISTRY.gauge(
+    "modal_tpu_shard_takeover_seconds",
+    "Duration of the last journal-fed partition takeover (dead shard's segments replayed "
+    "into a surviving shard), by adopted partition.",
+    ("partition",),
+)
+SHARD_PLACEMENT_LATENCY = REGISTRY.histogram(
+    "modal_tpu_shard_placement_latency_seconds",
+    "Director-observed latency of routing one app-scoped RPC to its owning shard.",
+)
+DIRECTOR_REROUTES = REGISTRY.counter(
+    "modal_tpu_director_reroutes_total",
+    "RPCs the director re-routed away from their home shard (takeover reassignment or "
+    "shard-death retarget), by reason.",
+    ("reason",),
+)
+
 
 def observe_peak_rss() -> float:
     """Sample ru_maxrss into the PEAK_RSS_BYTES gauge; returns bytes."""
@@ -479,6 +502,8 @@ SPAN_CATALOG: dict[str, str] = {
     "coldstart.preinit": "warm-pool opt-in jax backend pre-initialization",
     "recovery.replay": "journal replay into a fresh ServerState",
     "recovery.crash_restart": "chaos supervisor crash + same-port rebuild",
+    "control.takeover": "journal-fed partition takeover: dead shard's segments replayed into a survivor",
+    "director.route": "placement director routing one app-scoped RPC to its owning shard",
     "serving.admit": "serving-tier admission: queue wait → decode-slot + KV pages",
     "serving.prefill": "serving-tier prompt prefill (chunked; ends at the first token)",
     "serving.prefill_chunk": "one prefill chunk's device compute (per-request timeline detail)",

@@ -221,6 +221,10 @@ _RPC_NAMES = [
     # slo.py): windowed metric history, burn-rate alert states, and the
     # `modal_tpu top` dashboard payload from the supervisor-resident store
     "MetricsHistory",
+    # Sharded control plane (ISSUE 16, server/shards.py): director↔shard
+    # administration — shard status probes, journal-fed partition takeover,
+    # and epoch fencing of stale shards
+    "ShardControl",
     # Workspace (identity/membership/settings; billing is NG)
     "WorkspaceNameLookup",
     "WorkspaceMemberList",

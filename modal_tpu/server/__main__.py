@@ -1,7 +1,15 @@
-"""Standalone control plane: ``python -m modal_tpu.server --port 9900 --workers 1``."""
+"""Standalone control plane: ``python -m modal_tpu.server --port 9900 --workers 1``.
+
+``--shards N`` (or MODAL_TPU_SHARDS=N) boots the horizontally-sharded control
+plane instead (server/shards.py): N supervisor shards behind a placement
+director on ``--port``.  ``--shard-index`` / ``--blob-dir`` are how the
+director spawns ONE subprocess shard — a plain monolith that mints
+partition-``i`` ids and shares the fleet blob store.
+"""
 
 import argparse
 import asyncio
+import os
 
 from .supervisor import serve_forever
 
@@ -11,9 +19,42 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=9900)
     parser.add_argument("--workers", type=int, default=1)
     parser.add_argument("--state-dir", type=str, default=None)
+    parser.add_argument(
+        "--shards",
+        type=int,
+        default=int(os.environ.get("MODAL_TPU_SHARDS", "1") or 1),
+        help="number of control-plane shards (>1 boots the sharded plane)",
+    )
+    parser.add_argument(
+        "--subprocess-shards",
+        action="store_true",
+        help="run each shard as its own OS process (kill -9-able; chaos soak)",
+    )
+    parser.add_argument(
+        "--shard-index",
+        type=int,
+        default=0,
+        help="partition namespace for minted ids (set by the director)",
+    )
+    parser.add_argument(
+        "--blob-dir",
+        type=str,
+        default=None,
+        help="shared blob store directory (set by the director)",
+    )
     args = parser.parse_args()
     try:
-        asyncio.run(serve_forever(port=args.port, num_workers=args.workers, state_dir=args.state_dir))
+        asyncio.run(
+            serve_forever(
+                port=args.port,
+                num_workers=args.workers,
+                state_dir=args.state_dir,
+                shards=args.shards,
+                subprocess_shards=args.subprocess_shards,
+                shard_index=args.shard_index,
+                blob_dir=args.blob_dir,
+            )
+        )
     except KeyboardInterrupt:
         pass
 

@@ -167,6 +167,11 @@ def top_payload(state: Any) -> dict:
                 "modal_tpu_task_results_total", w, label_filter="FAILURE"
             ),
             "preemptions_per_s": store.counter_rate("modal_tpu_serving_preemptions_total", w),
+            # sharded control plane (server/shards.py): zero/absent = monolith
+            "placement_p95_s": store.hist_quantile(
+                "modal_tpu_shard_placement_latency_seconds", 0.95, w
+            ),
+            "director_reroutes_per_s": store.counter_rate("modal_tpu_director_reroutes_total", w),
         }
         for name, key in (
             ("modal_tpu_serving_tokens_per_second", "tokens_per_s"),
@@ -175,6 +180,8 @@ def top_payload(state: Any) -> dict:
             ("modal_tpu_kv_pages_allocated", "kv_pages_allocated"),
             ("modal_tpu_scheduler_queue_depth", "scheduler_queue_depth"),
             ("modal_tpu_device_memory_bytes", "device_memory_bytes"),
+            ("modal_tpu_control_shards_active", "control_shards_active"),
+            ("modal_tpu_shard_takeover_seconds", "shard_takeover_s"),
         ):
             stats = store.gauge_stats(name, w)
             fleet[key] = stats["last"] if stats else None

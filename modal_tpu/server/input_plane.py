@@ -33,7 +33,7 @@ from ..config import logger
 from .._utils.jwt_utils import verify_jwt
 from ..proto import api_pb2
 from ..proto.rpc import build_generic_handler
-from .state import FunctionCallState, ServerState, make_id
+from .state import FunctionCallState, ServerState
 
 AUTH_METADATA_KEY = "x-modal-tpu-auth-token"
 
@@ -63,7 +63,7 @@ class InputPlaneServicer:
     ATTEMPT_TTL_S = 3600.0
 
     def _mint_attempt(self, call_id: str, input_id: str, supersedes: str = "") -> str:
-        token = make_id("at")
+        token = self.s.make_id("at")
         self.s.attempts[token] = (call_id, input_id, time.monotonic())
         # journaled so a client awaiting this attempt across a control-plane
         # restart resumes instead of NOT_FOUND-ing (server/journal.py)
@@ -87,7 +87,7 @@ class InputPlaneServicer:
 
     def _start_call(self, function_id: str, call_type: int) -> FunctionCallState:
         call = FunctionCallState(
-            function_call_id=make_id("fc"),
+            function_call_id=self.s.make_id("fc"),
             function_id=function_id,
             call_type=call_type,
         )

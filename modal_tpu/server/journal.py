@@ -143,6 +143,9 @@ EXEMPT_RPCS: dict[str, str] = {
     "ProfileControl": "profiling toggle is runtime-transient; an operator re-issues it after a restart",
     "MetricsHistory": "read-only history query; rollups are runtime-transient, rebuilt by sampling "
     "(alert TRANSITIONS are journaled separately by the SLO evaluator, record type 'alert')",
+    "ShardControl": "director↔shard topology administration; shard maps and epochs are runtime "
+    "state rebuilt by the director's health loop (the takeover IT TRIGGERS replays+compacts "
+    "journals, which is the durable part)",
     # on-disk content-addressed stores are already durable
     "MountPutFile": "content-addressed block store on disk is already durable",
     "MountGetOrCreate": "manifest is stored as an on-disk block",
@@ -1265,12 +1268,18 @@ def synthesize_records(s) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def recover_state(state, journal: Journal) -> dict:
+def recover_state(state, journal: Journal, preserve_live_workers: bool = False) -> dict:
     """Replay snapshot + tail into ``state`` and run the post-passes:
     orphaned claimed inputs requeue (claims aren't journaled, so recovered
     inputs are already pending unless an output marked them done), journaled
     workers enter adoption_pending, and id counters advance past every
-    recovered id. Returns a recovery report dict."""
+    recovered id. Returns a recovery report dict.
+
+    ``preserve_live_workers=True`` is the shard-takeover mode
+    (server/shards.py): the journal being replayed belongs to a DEAD sibling
+    shard and ``state`` is a LIVE surviving shard — its own already-heartbeating
+    workers must keep their placements, so only workers the replay newly
+    introduced are put into adoption_pending."""
     from ..observability import tracing
     from ..observability.catalog import (
         RECOVERIES,
@@ -1281,6 +1290,7 @@ def recover_state(state, journal: Journal) -> dict:
     from .state import bump_id_counter
 
     t0 = time.time()
+    live_worker_ids = frozenset(state.workers) if preserve_live_workers else frozenset()
     snap_records, tail = journal.replay()
     applied = 0
     skipped = 0
@@ -1335,10 +1345,14 @@ def recover_state(state, journal: Journal) -> dict:
     # post-pass 3: recovered workers await re-adoption — no placements until
     # their next heartbeat proves they survived the control-plane crash
     now = time.time()
-    for worker in state.workers.values():
+    pending_adoption = 0
+    for worker_id, worker in state.workers.items():
+        if worker_id in live_worker_ids:
+            continue  # takeover mode: the survivor's own workers stay placed
         worker.adoption_pending = True
         worker.recovered_at = now
         worker.last_heartbeat = 0.0
+        pending_adoption += 1
     open_calls = sum(1 for c in state.function_calls.values() if c.num_done < c.num_inputs)
     took = time.time() - t0
     RECOVERY_SECONDS.set(took)
@@ -1352,7 +1366,7 @@ def recover_state(state, journal: Journal) -> dict:
             "records_skipped": skipped,
             "inputs_requeued": requeued,
             "open_calls": open_calls,
-            "workers_pending_adoption": len(state.workers),
+            "workers_pending_adoption": pending_adoption,
         },
     )
     report = {
@@ -1360,7 +1374,7 @@ def recover_state(state, journal: Journal) -> dict:
         "records_skipped": skipped,
         "inputs_requeued": requeued,
         "open_calls": open_calls,
-        "workers_pending_adoption": len(state.workers),
+        "workers_pending_adoption": pending_adoption,
         "seconds": round(took, 4),
     }
     logger.warning(f"control plane recovered from journal: {report}")

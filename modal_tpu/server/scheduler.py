@@ -34,7 +34,7 @@ from ..observability.catalog import (
 )
 from ..proto import api_pb2
 from ..tpu_config import parse_tpu_config, slice_info_proto
-from .state import ClusterState, FunctionState, ServerState, TaskState_, WorkerState, make_id
+from .state import ClusterState, FunctionState, ServerState, TaskState_, WorkerState
 
 SCHEDULE_INTERVAL = 0.05
 # how long a placement may look unsatisfiable before its backlog is failed
@@ -461,7 +461,7 @@ class Scheduler:
         from ..serialization import serialize
         from .state import FunctionCallState
 
-        call_id = make_id("fc")
+        call_id = self.s.make_id("fc")
         call = FunctionCallState(
             function_id=fn.function_id,
             function_call_id=call_id,
@@ -630,7 +630,7 @@ class Scheduler:
             worker = self._pick_worker(chips_needed, placement=self._fn_placement(fn))
         if worker is None:
             return None
-        task_id = make_id("ta")
+        task_id = self.s.make_id("ta")
         chip_ids = worker.free_chips()[:chips_needed] if chips_needed else []
         if chips_needed and len(chip_ids) < chips_needed:
             # never launch under-allocated: the container would contend for
@@ -736,7 +736,7 @@ class Scheduler:
         if chosen is None:
             return False  # not enough capacity; retry next tick
         cluster = ClusterState(
-            cluster_id=make_id("cl"),
+            cluster_id=self.s.make_id("cl"),
             function_id=fn.function_id,
             size=group_size,
             coordinator_port=find_free_port(),
@@ -829,7 +829,7 @@ class Scheduler:
         worker = self._pick_worker(chips_needed, placement=sb_placement)
         if worker is None:
             return None
-        task_id = make_id("ta")
+        task_id = self.s.make_id("ta")
         chip_ids = worker.free_chips()[:chips_needed] if chips_needed else []
         if chips_needed and len(chip_ids) < chips_needed:
             return None  # never launch under-allocated (same rule as _launch_task)

@@ -45,7 +45,6 @@ from .state import (
     TaskState_,
     VolumeState,
     WorkerState,
-    make_id,
 )
 
 # how long a ProfileControl "stop" keeps broadcasting on heartbeats before
@@ -66,6 +65,7 @@ class ModalTPUServicer:
         self.s = state
         self.scheduler = None  # wired by the supervisor (sandbox placement)
         self.chaos = None  # ChaosPolicy, wired by the supervisor when attached
+        self.supervisor = None  # LocalSupervisor backref (ShardControl admin)
         # real throttling control surfaced to containers on every GetInputs
         # response (reference rate_limit_sleep_duration)
         self.rate_limit_sleep_duration = 0.0
@@ -223,7 +223,7 @@ class ModalTPUServicer:
         an immediate local grant."""
         import secrets as _secrets
 
-        flow_id = make_id("tf")
+        flow_id = self.s.make_id("tf")
         self.s.pending_token_flows[flow_id] = {
             "token_id": "tk-" + _secrets.token_hex(8),
             "token_secret": "ts-" + _secrets.token_hex(16),
@@ -344,7 +344,7 @@ class ModalTPUServicer:
     # ------------------------------------------------------------------
 
     async def AppCreate(self, request: api_pb2.AppCreateRequest, context) -> api_pb2.AppCreateResponse:
-        app_id = make_id("ap")
+        app_id = self.s.make_id("ap")
         app = AppState(
             app_id=app_id,
             description=request.description,
@@ -367,7 +367,7 @@ class ModalTPUServicer:
         if app_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
                 await context.abort(grpc.StatusCode.NOT_FOUND, f"app {request.app_name!r} not found")
-            app_id = make_id("ap")
+            app_id = self.s.make_id("ap")
             self.s.apps[app_id] = AppState(
                 app_id=app_id,
                 name=request.app_name,
@@ -615,7 +615,7 @@ class ModalTPUServicer:
     async def FunctionCreate(self, request: api_pb2.FunctionCreateRequest, context) -> api_pb2.FunctionCreateResponse:
         if request.app_id and request.app_id not in self.s.apps:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"app {request.app_id} not found")
-        function_id = request.existing_function_id or make_id("fu")
+        function_id = request.existing_function_id or self.s.make_id("fu")
         definition = request.function
         if definition.webhook_type != api_pb2.WEB_ENDPOINT_TYPE_UNSPECIFIED:
             # web functions serve HTTP, not a queue: at least one warm
@@ -662,7 +662,7 @@ class ModalTPUServicer:
         parent = self.s.functions.get(request.function_id)
         if parent is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "function not found")
-        bound_id = make_id("fu")
+        bound_id = self.s.make_id("fu")
         bound_def = api_pb2.Function()
         bound_def.CopyFrom(parent.definition)
         # with_options variant: MERGE rebind-time overrides — only fields the
@@ -773,7 +773,7 @@ class ModalTPUServicer:
     # ------------------------------------------------------------------
 
     def _enqueue_input(self, fn: FunctionState, call: FunctionCallState, item: api_pb2.FunctionPutInputsItem) -> InputState:
-        input_id = make_id("in")
+        input_id = self.s.make_id("in")
         inp = InputState(
             input_id=input_id,
             function_call_id=call.function_call_id,
@@ -803,7 +803,7 @@ class ModalTPUServicer:
         fn = self.s.functions.get(request.function_id)
         if fn is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"function {request.function_id} not found")
-        call_id = make_id("fc")
+        call_id = self.s.make_id("fc")
         call = FunctionCallState(
             function_call_id=call_id,
             function_id=request.function_id,
@@ -1259,6 +1259,37 @@ class ModalTPUServicer:
             q=request.q,
         )
         return api_pb2.MetricsHistoryResponse(payload_json=json.dumps(payload))
+
+    async def ShardControl(self, request, context) -> api_pb2.ShardControlResponse:
+        """Sharded control plane administration (ISSUE 16, server/shards.py):
+        the placement director drives shard health probes, journal-fed
+        partition takeover, and epoch fencing through this RPC so subprocess
+        shards are orchestrated identically to in-process ones. Journal-EXEMPT
+        (topology is runtime state; the takeover it triggers replays+compacts
+        journals, which is the durable part)."""
+        sup = self.supervisor
+        if sup is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "shard administration requires a supervisor-attached servicer",
+            )
+        if request.action == "status":
+            return api_pb2.ShardControlResponse(payload_json=json.dumps(sup.shard_status()))
+        if request.action == "adopt":
+            report = await sup.adopt_partition(request.journal_dir, request.partition)
+            return api_pb2.ShardControlResponse(payload_json=json.dumps(report))
+        if request.action == "fence":
+            # fencing stops the very gRPC server carrying this call: run it as
+            # a task so the response gets out before the listener dies
+            t = asyncio.create_task(sup.fence(request.epoch))
+            sup._chaos_subtasks.add(t)
+            t.add_done_callback(sup._chaos_subtasks.discard)
+            return api_pb2.ShardControlResponse(
+                payload_json=json.dumps({"fencing": True, "epoch": request.epoch})
+            )
+        await context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT, f"unknown shard action {request.action!r}"
+        )
 
     def _scaledown_blocked(self, fn, task) -> bool:
         """Is this container one of the `min_containers` oldest live ones for
@@ -2053,7 +2084,7 @@ class ModalTPUServicer:
                 api_pb2.AppCreateRequest(description="sandbox", app_state=api_pb2.APP_STATE_EPHEMERAL), context
             )
             app_id = resp.app_id
-        sandbox_id = make_id("sb")
+        sandbox_id = self.s.make_id("sb")
         sb = SandboxState_(
             sandbox_id=sandbox_id,
             app_id=app_id,
@@ -2324,7 +2355,7 @@ class ModalTPUServicer:
     # ------------------------------------------------------------------
 
     async def WorkerRegister(self, request: api_pb2.WorkerRegisterRequest, context) -> api_pb2.WorkerRegisterResponse:
-        worker_id = request.worker_id or make_id("wk")
+        worker_id = request.worker_id or self.s.make_id("wk")
         stale = self.s.workers.get(worker_id)
         if stale is not None:
             # re-registration under an existing id (worker survived a
@@ -2417,7 +2448,7 @@ class ModalTPUServicer:
         if not os.path.isdir(workdir):
             raise FileNotFoundError(f"sandbox workdir {workdir} not found on this host")
         data = await tar_dir(workdir)
-        blob_id = make_id("bl")
+        blob_id = self.s.make_id("bl")
         path = self.s.blob_path(blob_id)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -2443,7 +2474,7 @@ class ModalTPUServicer:
                     status=api_pb2.GENERIC_STATUS_FAILURE, exception=f"fs snapshot failed: {exc}"
                 )
             )
-        image_id = make_id("im")
+        image_id = self.s.make_id("im")
         definition = api_pb2.Image(fs_snapshot_blob_id=blob_id)
         self.s.images[image_id] = ImageState(image_id=image_id, definition=definition, built=True)
         return api_pb2.SandboxSnapshotFsRequestResponse(
@@ -2463,7 +2494,7 @@ class ModalTPUServicer:
             blob_id = await self._snapshot_sandbox_fs(sb)
         except (OSError, ValueError) as exc:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, f"snapshot failed: {exc}")
-        snapshot_id = make_id("sn")
+        snapshot_id = self.s.make_id("sn")
         definition = api_pb2.Sandbox()
         definition.CopyFrom(sb.definition)
         self.s.sandbox_snapshots[snapshot_id] = SandboxSnapshotState(
@@ -2494,7 +2525,7 @@ class ModalTPUServicer:
         definition = api_pb2.Sandbox()
         definition.CopyFrom(snap.definition)
         if snap.fs_blob_id:
-            image_id = make_id("im")
+            image_id = self.s.make_id("im")
             self.s.images[image_id] = ImageState(
                 image_id=image_id,
                 definition=api_pb2.Image(fs_snapshot_blob_id=snap.fs_blob_id),
@@ -2721,7 +2752,7 @@ class ModalTPUServicer:
         key = hashlib.sha256(request.image.SerializeToString(deterministic=True)).hexdigest()[:16]
         image_id = self.s.images_by_hash.get(key)
         if image_id is None:
-            image_id = make_id("im")
+            image_id = self.s.make_id("im")
             metadata = api_pb2.ImageMetadata(
                 image_builder_version=request.builder_version or "2026.07",
                 python_version="local",
@@ -2778,7 +2809,7 @@ class ModalTPUServicer:
             await context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION, f"missing file content: {missing[:3]}"
             )
-        mount_id = make_id("mo")
+        mount_id = self.s.make_id("mo")
         # store manifest as a block so workers can materialize it
         manifest = json.dumps(
             [
@@ -2799,7 +2830,7 @@ class ModalTPUServicer:
 
     async def VolumeGetOrCreate(self, request: api_pb2.VolumeGetOrCreateRequest, context) -> api_pb2.VolumeGetOrCreateResponse:
         if request.object_creation_type == EPHEMERAL or not request.deployment_name:
-            volume_id = make_id("vo")
+            volume_id = self.s.make_id("vo")
             self.s.volumes[volume_id] = VolumeState(
                 volume_id=volume_id,
                 version=request.version,
@@ -2820,7 +2851,7 @@ class ModalTPUServicer:
         if volume_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
                 await context.abort(grpc.StatusCode.NOT_FOUND, f"volume {request.deployment_name!r} not found")
-            volume_id = make_id("vo")
+            volume_id = self.s.make_id("vo")
             self.s.volumes[volume_id] = VolumeState(
                 volume_id=volume_id, name=request.deployment_name, version=request.version
             )
@@ -3008,7 +3039,7 @@ class ModalTPUServicer:
         if request.object_creation_type in (ANONYMOUS, EPHEMERAL) or (
             not request.deployment_name and request.env_dict
         ):
-            secret_id = make_id("st")
+            secret_id = self.s.make_id("st")
             self.s.secrets[secret_id] = SecretState(secret_id=secret_id, env_dict=dict(request.env_dict))
             self._j("secret", secret_id=secret_id, env=dict(request.env_dict))
             return api_pb2.SecretGetOrCreateResponse(secret_id=secret_id)
@@ -3017,7 +3048,7 @@ class ModalTPUServicer:
         if secret_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS) and not request.env_dict:
                 await context.abort(grpc.StatusCode.NOT_FOUND, f"secret {request.deployment_name!r} not found")
-            secret_id = make_id("st")
+            secret_id = self.s.make_id("st")
             self.s.secrets[secret_id] = SecretState(
                 secret_id=secret_id, name=request.deployment_name, env_dict=dict(request.env_dict)
             )
@@ -3074,7 +3105,7 @@ class ModalTPUServicer:
         key = (self._resolve_environment(request.environment_name), request.name)
         if key in self.s.deployed_proxies:
             await context.abort(grpc.StatusCode.ALREADY_EXISTS, f"proxy {request.name!r} exists")
-        proxy_id = make_id("pr")
+        proxy_id = self.s.make_id("pr")
         # static IP from a private range, never reusing one a live proxy
         # holds (a count-derived octet would collide after deletes) — the
         # worker exports it to containers as their egress address (locally:
@@ -3181,7 +3212,7 @@ class ModalTPUServicer:
 
     async def DictGetOrCreate(self, request: api_pb2.DictGetOrCreateRequest, context) -> api_pb2.DictGetOrCreateResponse:
         if request.object_creation_type == EPHEMERAL or not request.deployment_name:
-            dict_id = make_id("di")
+            dict_id = self.s.make_id("di")
             self.s.dicts[dict_id] = DictState(
                 dict_id=dict_id,
                 ephemeral=request.object_creation_type == EPHEMERAL,
@@ -3199,7 +3230,7 @@ class ModalTPUServicer:
         if dict_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
                 await context.abort(grpc.StatusCode.NOT_FOUND, f"dict {request.deployment_name!r} not found")
-            dict_id = make_id("di")
+            dict_id = self.s.make_id("di")
             self.s.dicts[dict_id] = DictState(dict_id=dict_id, name=request.deployment_name)
             self.s.deployed_dicts[key] = dict_id
             self._j(
@@ -3285,7 +3316,7 @@ class ModalTPUServicer:
 
     async def QueueGetOrCreate(self, request: api_pb2.QueueGetOrCreateRequest, context) -> api_pb2.QueueGetOrCreateResponse:
         if request.object_creation_type == EPHEMERAL or not request.deployment_name:
-            queue_id = make_id("qu")
+            queue_id = self.s.make_id("qu")
             self.s.queues[queue_id] = QueueState(
                 queue_id=queue_id,
                 ephemeral=request.object_creation_type == EPHEMERAL,
@@ -3303,7 +3334,7 @@ class ModalTPUServicer:
         if queue_id is None:
             if request.object_creation_type not in (CREATE_IF_MISSING, FAIL_IF_EXISTS):
                 await context.abort(grpc.StatusCode.NOT_FOUND, f"queue {request.deployment_name!r} not found")
-            queue_id = make_id("qu")
+            queue_id = self.s.make_id("qu")
             self.s.queues[queue_id] = QueueState(queue_id=queue_id, name=request.deployment_name)
             self.s.deployed_queues[key] = queue_id
             self._j(

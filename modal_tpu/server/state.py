@@ -18,28 +18,47 @@ from typing import Any, Optional
 
 from ..proto import api_pb2
 
-_id_counters: dict[str, itertools.count] = {}
+# Sharded control plane (server/shards.py): every object id embeds its home
+# partition so any id-carrying RPC is routable statelessly — the numeric part
+# is `partition * PARTITION_STRIDE + local_counter`. Partition 0 stays inside
+# the stride, so single-shard deployments (MODAL_TPU_SHARDS=1, the historical
+# monolith) mint byte-identical 8-digit ids to every release before sharding.
+PARTITION_STRIDE = 10**8
+
+_id_counters: dict[tuple[int, str], itertools.count] = {}
 
 
-def make_id(prefix: str) -> str:
-    counter = _id_counters.setdefault(prefix, itertools.count(1))
-    return f"{prefix}-{next(counter):08d}"
+def make_id(prefix: str, namespace: int = 0) -> str:
+    counter = _id_counters.setdefault((namespace, prefix), itertools.count(1))
+    return f"{prefix}-{namespace * PARTITION_STRIDE + next(counter):08d}"
+
+
+def partition_of_id(object_id: str) -> Optional[int]:
+    """Home partition embedded in an object id, or None when the id doesn't
+    follow the `prefix-NNNNNNNN` scheme (content-hashed blob ids, external
+    names). Routing falls back to the placement director for those."""
+    _, _, num = object_id.rpartition("-")
+    if not num.isdigit():
+        return None
+    return int(num) // PARTITION_STRIDE
 
 
 def bump_id_counter(existing_id: str) -> None:
     """Advance the prefix counter past an id recovered from the journal so a
     fresh make_id can never re-issue it (server/journal.py recover_state).
     Counters only ever move forward — safe with several supervisors sharing
-    one process (tests)."""
+    one process (tests, in-process shards). Namespace-aware: replaying a dead
+    shard's journal during takeover bumps the DEAD partition's counters, so a
+    respawned shard fenced back in can never re-mint a migrated id either."""
     prefix, _, num = existing_id.rpartition("-")
     if not prefix or not num.isdigit():
         return
-    floor = int(num) + 1
-    counter = _id_counters.setdefault(prefix, itertools.count(1))
+    namespace, floor = int(num) // PARTITION_STRIDE, int(num) % PARTITION_STRIDE + 1
+    counter = _id_counters.setdefault((namespace, prefix), itertools.count(1))
     # itertools.count has no peek: draw once to learn the position, then
     # replace with whichever is further along
     current = next(counter)
-    _id_counters[prefix] = itertools.count(max(current, floor))
+    _id_counters[(namespace, prefix)] = itertools.count(max(current, floor))
 
 
 @dataclass
@@ -342,10 +361,18 @@ class SandboxSnapshotState:
 class ServerState:
     """All control-plane state + the on-disk stores."""
 
-    def __init__(self, state_dir: str):
+    def __init__(self, state_dir: str, shard_index: int = 0, blob_dir: Optional[str] = None):
         self.state_dir = state_dir
-        self.blob_dir = os.path.join(state_dir, "blobs")
-        self.block_dir = os.path.join(state_dir, "volume_blocks")
+        # Which control-plane partition this state natively mints ids into
+        # (server/shards.py). 0 for the monolith — ids and journals are then
+        # identical to the pre-sharding layout.
+        self.shard_index = shard_index
+        # Shards share one blob/block store (blob ids are content-addressed or
+        # presigned-URL-only, so any shard can serve any blob) — the sharded
+        # supervisor passes a common data dir here; the monolith keeps the
+        # per-state-dir default.
+        self.blob_dir = blob_dir or os.path.join(state_dir, "blobs")
+        self.block_dir = os.path.join(os.path.dirname(self.blob_dir), "volume_blocks")
         os.makedirs(self.blob_dir, exist_ok=True)
         os.makedirs(self.block_dir, exist_ok=True)
 
@@ -427,6 +454,13 @@ class ServerState:
         self.timeseries = None  # Optional[timeseries.TimeSeriesStore]
         self.slo = None  # Optional[slo.SLOEvaluator]
         self.alerts: dict[str, dict] = {}
+
+    def make_id(self, prefix: str) -> str:
+        """Mint an id in this shard's home partition (module-level make_id
+        namespaced by shard_index). All servicer/scheduler/input-plane id
+        minting goes through here so migrated partitions keep routing to
+        their journaled home while new objects land on the live shard."""
+        return make_id(prefix, self.shard_index)
 
     # -- blob store ---------------------------------------------------------
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import os
-from typing import Optional
+from typing import Any, Optional
 
 import grpc
 
@@ -45,6 +45,8 @@ class LocalSupervisor:
         hosts_per_slice: int = 0,  # 0 = all workers share slice 0
         chaos: Optional[ChaosPolicy] = None,  # one policy object, every layer
         recover: Optional[bool] = None,  # None = auto: recover iff a journal exists
+        shard_index: int = 0,  # home partition for minted ids (server/shards.py)
+        blob_dir: Optional[str] = None,  # shared blob store across shards
     ):
         self.num_workers = num_workers
         self.port = port
@@ -53,12 +55,20 @@ class LocalSupervisor:
         self.worker_tpu_type = worker_tpu_type
         self.hosts_per_slice = hosts_per_slice
         self.recover = recover
+        self.shard_index = shard_index
+        self._blob_dir_override = blob_dir
+        # epoch fencing (server/shards.py): a fenced shard has been replaced
+        # by a takeover and must never serve or journal its partition again
+        self.fenced = False
+        self.fenced_at_epoch = 0
         self.recovery_report: Optional[dict] = None  # set when start() replayed a journal
-        self.state = ServerState(self.state_dir)
+        self.takeover_reports: list[dict] = []  # one per adopted partition
+        self.state = ServerState(self.state_dir, shard_index=shard_index, blob_dir=blob_dir)
         # chaos: explicit policy, else env-driven (MODAL_TPU_CHAOS=1)
         self.chaos = chaos if chaos is not None else ChaosPolicy.from_env()
         self.servicer = servicer_cls(self.state)
         self.servicer.chaos = self.chaos
+        self.servicer.supervisor = self  # ShardControl delegates here
         self.scheduler = Scheduler(self.state, self.servicer)
         self.servicer.scheduler = self.scheduler
         self.blob_server = BlobServer(self.state, chaos=self.chaos)
@@ -346,12 +356,16 @@ class LocalSupervisor:
         async with self._crash_lock:  # lint: disable=lock-across-await
             return await self._crash_restart_locked()
 
-    async def _crash_restart_locked(self) -> Optional[dict]:
-        import time as _time
-
-        t0 = _time.time()
+    async def crash_abandon(self) -> tuple[int, int, int]:
+        """The teardown half of a simulated crash: kill container
+        subprocesses, drop every serving surface with no drain and no state
+        flush, abandon the ServerState. The journal handle is closed but its
+        segments STAY on disk — they are the substrate a same-dir restart
+        recovers (crash_restart) or a sibling shard's takeover replays
+        (chaos shard_kill, server/shards.py). Returns the (grpc, blob,
+        input-plane) ports for a same-port rebuild."""
         old_journal = self.state.journal
-        grpc_port, blob_port, input_port = (
+        ports = (
             self.port,
             self.blob_server.port,
             getattr(self.input_plane, "port", 0),
@@ -372,16 +386,27 @@ class LocalSupervisor:
         local_transport.unregister_local_server(self.state.input_plane_url)
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=None)
+            self._grpc_server = None
         await self.scheduler.stop()
         await self._stop_sampler()  # references the abandoned state
         await self.input_plane.stop()
         await self.blob_server.stop()
         if old_journal is not None:
             old_journal.close()
+        return ports
+
+    async def _crash_restart_locked(self) -> Optional[dict]:
+        import time as _time
+
+        t0 = _time.time()
+        grpc_port, blob_port, input_port = await self.crash_abandon()
         # rebuild the whole control plane from the journal
-        self.state = ServerState(self.state_dir)
+        self.state = ServerState(
+            self.state_dir, shard_index=self.shard_index, blob_dir=self._blob_dir_override
+        )
         self.servicer = type(self.servicer)(self.state)
         self.servicer.chaos = self.chaos
+        self.servicer.supervisor = self
         self.scheduler = Scheduler(self.state, self.servicer)
         self.servicer.scheduler = self.scheduler
         self.blob_server = BlobServer(self.state, port=blob_port, chaos=self.chaos)
@@ -401,6 +426,95 @@ class LocalSupervisor:
             f"control plane crash-restarted in {_time.time() - t0:.2f}s: {self.recovery_report}"
         )
         return self.recovery_report
+
+    async def adopt_partition(self, source_state_dir: str, partition: int = -1) -> dict:
+        """Leader takeover (server/shards.py, docs/CONTROL_PLANE.md): rehydrate
+        a DEAD sibling shard's partition from that shard's journal into THIS
+        shard's live state. The PR 5 typed records are the replication
+        substrate — takeover is recover_state pointed at someone else's
+        segments. Post-replay, the adopted state is compacted into OUR journal
+        (making it the single durable record of the merged partitions) and the
+        source segments are archived so a respawned stale shard can never
+        replay them (split-brain fence, half one: the director's epoch bump is
+        half two)."""
+        import time as _time
+
+        from ..observability.catalog import SHARD_TAKEOVER_SECONDS
+        from .journal import archive_existing, synthesize_records
+
+        t0 = _time.time()
+        source = Journal(source_state_dir)
+        try:
+            report = recover_state(self.state, source, preserve_live_workers=True)
+        finally:
+            source.close()
+        archive_existing(source_state_dir)
+        if self.state.journal is not None:
+            await self.state.journal.compact_async(synthesize_records(self.state))
+        # requeued inputs of the adopted partition want placement immediately
+        self.state.schedule_event.set()
+        took = _time.time() - t0
+        report = dict(
+            report, partition=partition, source=source_state_dir, seconds=round(took, 4)
+        )
+        self.takeover_reports.append(report)
+        SHARD_TAKEOVER_SECONDS.set(took, partition=str(partition))
+        tracing.record_span("control.takeover", start=t0, end=_time.time(), attrs=report)
+        logger.warning(f"shard {self.shard_index} adopted partition {partition}: {report}")
+        return report
+
+    async def fence(self, epoch: int) -> None:
+        """Epoch fencing (the split-brain test's subject): this shard's
+        partition was either taken over while it was presumed dead (stale
+        rejoiner) or is ABOUT to be (false death: the director lost contact
+        but the shard still lives). Either way it must stop serving — clients
+        get UNAVAILABLE, re-hello the director, and land on the successor.
+        The journal is closed but NOT archived: in the false-death case the
+        successor replays these very segments next (adopt_partition is the
+        single archive point, stamping the tombstone AFTER a successful
+        replay)."""
+        if self.fenced:
+            return
+        self.fenced = True
+        self.fenced_at_epoch = epoch
+        from .._utils import local_transport
+
+        local_transport.unregister_local_server(self.server_url)
+        local_transport.unregister_local_server(self.state.input_plane_url)
+        for worker in self.workers:
+            worker.kill_containers()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=None)
+            self._grpc_server = None
+        await self.scheduler.stop()
+        await self._stop_sampler()
+        await self.input_plane.stop()
+        await self.blob_server.stop()
+        if self.state.journal is not None:
+            self.state.journal.close()
+            self.state.journal = None
+        logger.warning(f"shard {self.shard_index} fenced at epoch {epoch}")
+
+    def shard_status(self) -> dict:
+        """Health/topology snapshot for the director's probe loop and the
+        shard-aware `modal_tpu journal status`."""
+        j = self.state.journal
+        return {
+            "shard_index": self.shard_index,
+            "state_dir": self.state_dir,
+            "url": self.server_url,
+            "fenced": self.fenced,
+            "fenced_at_epoch": self.fenced_at_epoch,
+            "workers": len(self.state.workers),
+            "open_calls": sum(
+                1 for c in self.state.function_calls.values() if c.num_done < c.num_inputs
+            ),
+            "journal_seq": j.seq if j is not None else 0,
+            "takeovers": len(self.takeover_reports),
+            # the director's shared chaos clock (subprocess shards report
+            # their output count through the health probe)
+            "chaos_outputs_seen": self.chaos.outputs_seen if self.chaos is not None else 0,
+        }
 
     async def stop(self) -> None:
         # bounded: a supervisor that cannot shut down must not hang its host
@@ -435,6 +549,8 @@ class LocalSupervisor:
             await asyncio.gather(*self._chaos_subtasks, return_exceptions=True)
         for worker in self.workers:
             await worker.stop()
+        if self.fenced:
+            return  # fence() already tore down the serving surfaces + journal
         await self.scheduler.stop()
         await self._stop_sampler()
         await self.input_plane.stop()
@@ -446,9 +562,35 @@ class LocalSupervisor:
 
 
 async def serve_forever(
-    port: int = 9900, num_workers: int = 1, state_dir: Optional[str] = None
+    port: int = 9900,
+    num_workers: int = 1,
+    state_dir: Optional[str] = None,
+    shards: int = 1,
+    subprocess_shards: bool = False,
+    shard_index: int = 0,
+    blob_dir: Optional[str] = None,
 ) -> None:
-    sup = LocalSupervisor(num_workers=num_workers, port=port, state_dir=state_dir)
+    if shards > 1:
+        # sharded control plane (server/shards.py): shards==1 stays on this
+        # code path untouched — the degradation contract docs/CONTROL_PLANE.md
+        # leans on (the director is never even constructed)
+        from .shards import ShardedSupervisor
+
+        sup: Any = ShardedSupervisor(
+            num_shards=shards,
+            num_workers=num_workers,
+            port=port,
+            state_dir=state_dir,
+            subprocess_shards=subprocess_shards,
+        )
+    else:
+        sup = LocalSupervisor(
+            num_workers=num_workers,
+            port=port,
+            state_dir=state_dir,
+            shard_index=shard_index,
+            blob_dir=blob_dir,
+        )
     await sup.start()
     print(f"modal_tpu control plane listening on {sup.server_url}", flush=True)
     try:

@@ -229,6 +229,48 @@ class WorkerAgent:
         )
         self.worker_id = resp.worker_id
 
+    async def rehome(self, server_url: str, server_uds: str = "") -> None:
+        """Point this agent at a NEW control plane (shard takeover,
+        server/shards.py): the shard that owned this worker died and a
+        surviving shard adopted its partition from the journal. Rebuild the
+        channel/stub exactly like start() and re-announce under the SAME
+        worker_id — the successor's journal-replayed WorkerState sits in
+        adoption_pending, so the re-registration adopts it in place and
+        in-flight maps resume on this worker without a fresh identity."""
+        from .._utils import local_transport
+
+        old_channels = [self._channel, getattr(self, "_uds_channel", None)]
+        self.server_url = server_url
+        self.server_uds = server_uds
+        self._uds_channel = None
+        self._channel = create_channel(self.server_url)
+        self._stub = ModalTPUStub(self._channel)
+        if local_transport.fastpath_enabled():
+            uds_ok = (
+                local_transport.uds_enabled()
+                and local_transport.usable_uds_path(self.server_uds)
+                and os.path.exists(self.server_uds)
+            )
+            if uds_ok or local_transport.resolve_local_server(self.server_url) is not None:
+                uds_stub = None
+                if uds_ok:
+                    self._uds_channel = create_channel(f"unix://{self.server_uds}")
+                    uds_stub = ModalTPUStub(self._uds_channel)
+                self._stub = local_transport.FastPathStub(
+                    self.server_url,
+                    self._stub,
+                    uds_path=self.server_uds if uds_ok else "",
+                    uds_stub=uds_stub,
+                )
+        for ch in old_channels:
+            if ch is not None:
+                try:
+                    await ch.close()
+                except Exception:  # noqa: BLE001 — the old plane is dead anyway
+                    pass
+        await self._register()
+        logger.warning(f"worker {self.worker_id} rehomed to {server_url}")
+
     async def stop(self) -> None:
         self._stopped = True
         for task in self._tasks:

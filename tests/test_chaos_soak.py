@@ -268,3 +268,207 @@ def test_soak_fault_sequence_is_seed_reproducible():
                 policy.decide(rpc)
     assert a.fault_log == b.fault_log and a.fault_log, "seeded chaos must be reproducible"
     assert a.injected == b.injected
+
+
+# ---------------------------------------------------------------------------
+# Sharded control plane (server/shards.py, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_sharded_supervisor(port: int, state_dir: str, tmp_path) -> "subprocess.Popen":
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MODAL_TPU_JAX_PLATFORM"] = "cpu"
+    env["MODAL_TPU_AUTO_LOCAL_SERVER"] = "0"
+    env["MODAL_TPU_STATE_DIR"] = state_dir
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(os.path.join(str(tmp_path), f"sharded-{time.time_ns()}.log"), "wb")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "modal_tpu.server",
+            "--port",
+            str(port),
+            "--workers",
+            "3",
+            "--state-dir",
+            state_dir,
+            "--shards",
+            "3",
+            "--subprocess-shards",
+        ],
+        env=env,
+        stdout=log,
+        stderr=log,
+        start_new_session=True,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.recovery
+def test_kill9_shard_mid_100k_map_takeover_exactly_once(tmp_path, monkeypatch):
+    """ISSUE 16 acceptance soak: 3 OS-process shards behind the placement
+    director; the shard owning the app's partition is kill -9'd (real SIGKILL,
+    whole process group) mid-way through a 100k-input placement storm. The
+    director must fence it, a sibling must rehydrate its partition from the
+    dead shard's journal, and every input must land exactly once — the
+    client's idempotent re-sends dedupe against the REPLAYED journal state,
+    and no placement may be lost. The client is never restarted: its retry
+    loops ride UNAVAILABLE -> shard-map refresh -> redial."""
+    import json as _json
+    import threading
+    import zlib
+
+    import modal_tpu
+    from modal_tpu._utils.async_utils import synchronizer
+    from modal_tpu._utils.grpc_utils import find_free_port, retry_transient_errors
+    from modal_tpu.client import _Client
+    from modal_tpu.proto import api_pb2
+
+    TOTAL_INPUTS = 100_000
+    NUM_CALLS = 10
+    BATCH = 250
+
+    state_dir = str(tmp_path / "state")
+    port = find_free_port()
+    proc = _spawn_sharded_supervisor(port, state_dir, tmp_path)
+    try:
+        _wait_port(port, timeout_s=120.0)
+        monkeypatch.setenv("MODAL_TPU_SERVER_URL", f"grpc://127.0.0.1:{port}")
+        _Client.set_env_client(None)
+
+        # an app name whose crc32 lands on partition 1 — shard 1 is the victim
+        suffix = 0
+        while zlib.crc32(f"shard-soak-{suffix}".encode()) % 3 != 1:
+            suffix += 1
+        app = modal_tpu.App(f"shard-soak-{suffix}")
+
+        def noop(x):
+            return 0
+
+        f = app.function(serialized=True)(noop)
+        with app.run():
+            function_id = f.object_id
+            client = _Client._client_from_env
+            assert type(client._stub).__name__ == "ShardRouterStub", "router not engaged"
+
+            placed = {"n": 0}
+            payload = b"x" * 8
+            per_call = TOTAL_INPUTS // NUM_CALLS
+
+            async def _storm() -> list:
+                call_ids = []
+                for _ in range(NUM_CALLS):
+                    call = await retry_transient_errors(
+                        client.stub.FunctionMap,
+                        api_pb2.FunctionMapRequest(
+                            function_id=function_id,
+                            function_call_type=api_pb2.FUNCTION_CALL_TYPE_MAP,
+                        ),
+                        max_retries=None,
+                        total_timeout=180.0,
+                    )
+                    call_ids.append(call.function_call_id)
+                    idx = 0
+                    while idx < per_call:
+                        chunk = min(BATCH, per_call - idx)
+                        await retry_transient_errors(
+                            client.stub.FunctionPutInputs,
+                            api_pb2.FunctionPutInputsRequest(
+                                function_id=function_id,
+                                function_call_id=call.function_call_id,
+                                inputs=[
+                                    api_pb2.FunctionPutInputsItem(
+                                        idx=idx + k, input=api_pb2.FunctionInput(args=payload)
+                                    )
+                                    for k in range(chunk)
+                                ],
+                            ),
+                            # unlimited retries under a wall-clock budget: the
+                            # outage window is the whole fence+replay takeover,
+                            # far longer than a default backoff ladder
+                            max_retries=None,
+                            total_timeout=180.0,
+                        )
+                        idx += chunk
+                        placed["n"] += chunk
+                return call_ids
+
+            storm_result: dict = {}
+            storm_errors: list = []
+
+            def run_storm():
+                try:
+                    storm_result["call_ids"] = synchronizer.run(_storm())
+                except BaseException as exc:  # noqa: BLE001
+                    storm_errors.append(exc)
+
+            t = threading.Thread(target=run_storm)
+            t.start()
+            # kill the victim once the storm is genuinely mid-flight
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                if placed["n"] >= TOTAL_INPUTS // 3:
+                    break
+                if not t.is_alive():
+                    pytest.fail(f"storm died before the kill window (errors={storm_errors})")
+                time.sleep(0.1)
+            else:
+                pytest.fail("storm never reached the kill window")
+            with open(os.path.join(state_dir, "shards.json")) as fh:
+                victim = next(s for s in _json.load(fh)["shards"] if s["index"] == 1)
+            assert victim["pid"] > 0, "subprocess shard pid not persisted"
+            os.killpg(victim["pid"], signal.SIGKILL)
+            t.join(timeout=600)
+            assert not t.is_alive(), "placement storm never completed after the shard kill"
+            assert not storm_errors, f"storm failed across the kill -9: {storm_errors}"
+            assert placed["n"] == TOTAL_INPUTS
+
+            # exactly-once: the successor's REPLAYED state counts every input
+            # once — a lost placement or a dedupe miss both show up here
+            listed = synchronizer.run(
+                retry_transient_errors(
+                    client.stub.FunctionCallList,
+                    api_pb2.FunctionCallListRequest(function_id=function_id),
+                    max_retries=8,
+                )
+            )
+            by_id = {c.function_call_id: c.num_inputs for c in listed.calls}
+            ours = [by_id.get(cid, 0) for cid in storm_result["call_ids"]]
+            assert sum(ours) == TOTAL_INPUTS, f"placements lost/duplicated: {ours}"
+            assert all(n == per_call for n in ours), f"per-call counts off: {ours}"
+
+            # the takeover really happened, via the dead shard's journal
+            with open(os.path.join(state_dir, "director.json")) as fh:
+                topo = _json.load(fh)
+            assert topo["epoch"] >= 2, "no epoch bump — takeover never ran"
+            assert topo["assignments"][1] != 1, "partition 1 still on the dead shard"
+            assert topo["takeovers"] and topo["takeovers"][-1]["report"]["records_applied"] > 0
+    finally:
+        env_client = _Client._client_from_env
+        if env_client is not None and not env_client._closed:
+            env_client._close()
+        _Client.set_env_client(None)
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        # shard subprocesses are their own sessions: reap via shards.json
+        try:
+            with open(os.path.join(state_dir, "shards.json")) as fh:
+                for s in __import__("json").load(fh)["shards"]:
+                    if s.get("pid"):
+                        try:
+                            os.killpg(s["pid"], signal.SIGKILL)
+                        except OSError:
+                            pass
+        except OSError:
+            pass

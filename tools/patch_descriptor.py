@@ -129,6 +129,21 @@ NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
     "MetricsHistoryResponse": [
         ("payload_json", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
     ],
+    # Sharded control plane (ISSUE 16, server/shards.py): director-internal
+    # administration of supervisor shards — health probes, partition takeover
+    # orchestration, and epoch fencing of stale shards rejoining after a
+    # takeover. Never journaled (runtime topology, rebuilt by the director's
+    # health loop); action: status | adopt | fence.
+    "ShardControlRequest": [
+        ("action", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("partition", 2, F.TYPE_INT32, F.LABEL_OPTIONAL, ""),
+        ("journal_dir", 3, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("epoch", 4, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
+        ("shard_index", 5, F.TYPE_INT32, F.LABEL_OPTIONAL, ""),
+    ],
+    "ShardControlResponse": [
+        ("payload_json", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+    ],
 }
 
 # (message, field_name, field_number, field_type) — optionally a 5-tuple with
@@ -198,6 +213,15 @@ PATCHES: list[tuple[str, str, int, int]] = [
     # while per-replica tokens/s sits far under target_tokens_per_replica
     ("AutoscalerSettings", "target_ttft_ms", 5, F.TYPE_FLOAT),
     ("AutoscalerSettings", "target_tokens_per_replica", 6, F.TYPE_FLOAT),
+    # Sharded control plane (ISSUE 16, server/shards.py): the placement
+    # director answers ClientHello with the partition→shard-URL map as JSON
+    # ({"epoch": N, "urls": ["grpc://...", ...]} indexed by partition — the
+    # JSON idiom matches telemetry_json/payload_json: the map shape evolves
+    # faster than the wire). Empty on monolith supervisors, so existing
+    # clients see no behavior change. shard_epoch fences stale maps: a client
+    # holding an older epoch re-hellos before trusting a routing miss.
+    ("ClientHelloResponse", "shard_map_json", 8, F.TYPE_STRING),
+    ("ClientHelloResponse", "shard_epoch", 9, F.TYPE_INT64),
 ]
 
 HEADER = '''\
