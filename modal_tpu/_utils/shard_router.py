@@ -47,6 +47,7 @@ class _RoutedUnary:
         return await router.client.get_stub(router.shard_urls[part]), True
 
     async def __call__(self, request, timeout=None, metadata=None, **kwargs):
+        metadata = self._with_trace_context(metadata)
         target, direct = await self._target(request)
         fn = getattr(target, self._name)
         try:
@@ -55,12 +56,32 @@ class _RoutedUnary:
             if not direct or exc.code() != grpc.StatusCode.UNAVAILABLE:
                 raise
             # the owner may have just died: the director's health loop fences
-            # it and rewrites the map — fetch the new topology and re-dial
+            # it and rewrites the map — fetch the new topology and re-dial.
+            # the same trace context rides the retry: the re-routed attempt
+            # stitches under the SAME caller span as the failed one
             await self._router.refresh()
             target, _ = await self._target(request)
             return await getattr(target, self._name)(
                 request, timeout=timeout, metadata=metadata, **kwargs
             )
+
+    @staticmethod
+    def _with_trace_context(metadata):
+        """Attach the ambient trace context to routed calls (ISSUE 17): the
+        per-channel tracing interceptor covers real gRPC dials, but explicit
+        metadata here survives the refresh-and-retry leg landing on a
+        DIFFERENT channel and keeps the fast-path (in-process) rung stitched
+        identically."""
+        from ..observability import tracing
+
+        ctx = tracing.current_context()
+        if ctx is None:
+            return metadata
+        md = list(metadata or ())
+        have = {k for k, _v in md}
+        if tracing.TRACE_ID_METADATA_KEY in have:
+            return metadata
+        return md + tracing.context_metadata(ctx)
 
 
 class ShardRouterStub:

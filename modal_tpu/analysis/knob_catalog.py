@@ -159,6 +159,18 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "call error-rate SLO threshold"),
         _k("MODAL_TPU_SLO_SCALE_COOLDOWN", "float", "10", "docs/OBSERVABILITY.md",
            "SLO-autoscaler cooldown between scale decisions"),
+        _k("MODAL_TPU_FEDERATION", "bool", "1", "docs/OBSERVABILITY.md",
+           "director-resident metrics federation + fleet-scope SLO evaluation "
+           "(sharded plane only); off → per-shard history endpoints answer alone", gate=True),
+        _k("MODAL_TPU_FEDERATION_TIMEOUT", "float", "2.0", "docs/OBSERVABILITY.md",
+           "per-shard fan-out timeout for one federated history query; a shard "
+           "slower than this degrades the answer to a labeled partial"),
+        _k("MODAL_TPU_FLIGHT_RECORDER", "bool", "1", "docs/OBSERVABILITY.md",
+           "per-shard crash-forensics ring (raw samples, span/journal tails, chaos "
+           "events) frozen + dumped as postmortem-<event>.json on crash/takeover/alert",
+           gate=True),
+        _k("MODAL_TPU_FLIGHT_RECORDER_RING", "int", "60", "docs/OBSERVABILITY.md",
+           "flight-recorder ring capacity in ~1 Hz samples (≈ seconds of history)"),
         # -- serving tier (docs/SERVING.md) ---------------------------------
         _k("MODAL_TPU_SERVING_SAMPLING", "bool", "1", "docs/SERVING.md",
            "per-request sampling (temperature/top_k/top_p/seed); off → greedy-only", gate=True),

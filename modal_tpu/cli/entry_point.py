@@ -664,13 +664,37 @@ def _history_fetch(url: Optional[str], state_dir: Optional[str], query: str, **p
             raise click.ClickException(
                 f"history endpoint at {base} is not answering — the breadcrumb at "
                 f"{url_file} is stale (supervisor not running or restarting), or the "
-                f"supervisor was started with MODAL_TPU_TS_INTERVAL=0. ({exc})"
+                f"supervisor was started with MODAL_TPU_TS_INTERVAL=0."
+                f"{_shard_topology_hint(url_file)} ({exc})"
             )
         raise click.ClickException(f"history query against {base} failed: {exc}")
     try:
         return json.loads(raw)
     except ValueError as exc:
         raise click.ClickException(f"malformed history payload: {exc}")
+
+
+def _shard_topology_hint(url_file: str) -> str:
+    """When the stale breadcrumb belongs to a sharded fleet root, name the
+    topology in the error: the operator learns WHICH shard endpoints exist
+    (observability/shards/ breadcrumbs) instead of guessing from one path."""
+    root = os.path.dirname(os.path.dirname(url_file))
+    try:
+        with open(os.path.join(root, "shards.json")) as f:
+            shards = json.load(f).get("shards") or []
+    except (OSError, ValueError):
+        return ""
+    if not shards:
+        return ""
+    rows = ", ".join(
+        f"shard {s.get('index')} {s.get('url') or '?'}{' [dead]' if s.get('dead') else ''}"
+        for s in shards
+    )
+    return (
+        f" This is a sharded fleet root ({len(shards)} shards: {rows}); the director "
+        f"owns the root breadcrumb and per-shard endpoints are recorded under "
+        f"{os.path.join(root, 'observability', 'shards')}/."
+    )
 
 
 def _fmt_num(v, unit: str = "", scale: float = 1.0, digits: int = 1) -> str:
@@ -738,9 +762,20 @@ def _render_top_frame(payload: dict) -> str:
     alerts = (payload.get("alerts") or {}).get("alerts") or {}
     firing = sorted(n for n, a in alerts.items() if a.get("state") == "firing")
     stamp = datetime.datetime.fromtimestamp(payload.get("time", time.time())).strftime("%H:%M:%S")
+    fed = payload.get("federation") or {}
+    fed_tag = ""
+    if fed:
+        answered = fed.get("shards") or []
+        n_shards = len(answered) if isinstance(answered, list) else answered
+        fed_tag = f"   fleet-merged ({n_shards} shards)"
+        if fed.get("partial"):
+            # PARTIAL is load-bearing: merged counters/quantiles undercount
+            # whatever the missing/dead shards would have contributed
+            gone = sorted((fed.get("missing") or []) + (fed.get("dead") or []))
+            fed_tag += f" PARTIAL — no answer from shard(s) {gone}"
     lines.append(f"modal_tpu top — {stamp}   alerts firing: {len(firing)}" + (
         f" ({', '.join(firing)})" if firing else ""
-    ))
+    ) + fed_tag)
     lines.append(
         f"  TTFT p50 {_fmt_num(fleet.get('ttft_p50_s'), 's', digits=3)}  "
         f"p95 {_fmt_num(fleet.get('ttft_p95_s'), 's', digits=3)}   "
@@ -772,6 +807,26 @@ def _render_top_frame(payload: dict) -> str:
             lines.append(
                 f"  ALERT {name}: burn {_fmt_num(a.get('burn_rate'), 'x', digits=1)} "
                 f"value {_fmt_num(a.get('value'), digits=4)} (threshold {a.get('threshold')})"
+            )
+    shard_rows = payload.get("shards") or []
+    if shard_rows:
+        lines.append("")
+        lines.append(
+            f"  {'shard':<7} {'state':<9} {'calls/s':>8} {'req/s':>8} {'ttft p95':>9} "
+            f"{'tok/s':>8} {'queue':>6} {'replicas':>9}"
+        )
+        for s in shard_rows:
+            if s.get("state") != "live":
+                lines.append(f"  {s.get('shard', '?'):<7} {s.get('state', '?'):<9} (no data)")
+                continue
+            lines.append(
+                f"  {s.get('shard', '?'):<7} {s.get('state', ''):<9} "
+                f"{_fmt_num(s.get('calls_per_s'), digits=2):>8} "
+                f"{_fmt_num(s.get('requests_per_s'), digits=2):>8} "
+                f"{_fmt_num(s.get('ttft_p95_s'), 's', digits=3):>9} "
+                f"{_fmt_num(s.get('tokens_per_s')):>8} "
+                f"{_fmt_num(s.get('queue_depth'), digits=0):>6} "
+                f"{_fmt_num(s.get('replicas'), digits=0):>9}"
             )
     replicas = payload.get("replicas") or []
     lines.append("")
@@ -847,15 +902,175 @@ def trace_gc(state_dir: Optional[str], max_mb: int, max_age_hours: float) -> Non
     from ..observability import tracing
 
     _root, store = _trace_store(state_dir)
-    if not os.path.isdir(store):
+    dirs = [d for d in tracing.span_dirs(store) if os.path.isdir(d)]
+    if not dirs:
         raise click.ClickException(f"no span store at {store}")
-    report = tracing.gc_trace_dir(
-        store, max_total_bytes=max_mb * 1024 * 1024, max_age_s=max_age_hours * 3600.0
-    )
+    # a sharded fleet keeps one span sink per shard (<root>/shard-*/traces)
+    # next to the director's; the size cap applies per sink so one chatty
+    # shard can't starve the others' retention
+    total = {"removed": 0, "removed_bytes": 0, "kept": 0, "kept_bytes": 0}
+    for d in dirs:
+        report = tracing.gc_trace_dir(
+            d, max_total_bytes=max_mb * 1024 * 1024, max_age_s=max_age_hours * 3600.0
+        )
+        for k in total:
+            total[k] += report[k]
     click.echo(
-        f"removed {report['removed']} file(s) ({report['removed_bytes']} bytes); "
-        f"kept {report['kept']} ({report['kept_bytes']} bytes)"
+        f"removed {total['removed']} file(s) ({total['removed_bytes']} bytes); "
+        f"kept {total['kept']} ({total['kept_bytes']} bytes) across {len(dirs)} span dir(s)"
     )
+
+
+# ---------------------------------------------------------------------------
+# crash forensics (observability/flight_recorder.py, docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+
+
+@cli.group("debug")
+def debug_group() -> None:
+    """Crash forensics: flight-recorder postmortems and merged fleet timelines."""
+
+
+def _timeline_stamp(t: float) -> str:
+    return datetime.datetime.fromtimestamp(t).strftime("%H:%M:%S.%f")[:-3]
+
+
+@debug_group.command("bundle")
+@click.option("--state-dir", default=None, help="Fleet/supervisor state dir (default: configured).")
+@click.option("--out", default=None, help="Write the full merged bundle JSON to this path.")
+@click.option("--json", "as_json", is_flag=True, help="Dump the merged bundle JSON to stdout.")
+@click.option(
+    "--window",
+    default=0.0,
+    help="Only keep timeline events from the last N seconds (0 = everything found).",
+)
+def debug_bundle(
+    state_dir: Optional[str], out: Optional[str], as_json: bool, window: float
+) -> None:
+    """Merge every forensic artifact under a state dir into one timeline:
+    flight-recorder postmortem dumps (crash_restart / takeover / fence /
+    alert), the director's takeover log with its fence→adopt→remap→rehome
+    phase timestamps, and journaled fleet-scope SLO transitions. The point is
+    a single time-ordered view of WHAT the fleet did around a crash, without
+    hand-correlating per-shard files."""
+    from ..config import config as _config
+    from ..observability import flight_recorder, tracing
+
+    root = os.path.abspath(state_dir or _config["state_dir"])
+    with tracing.span("debug.bundle", attrs={"root": root}):
+        postmortems: list[dict] = []
+        for path in flight_recorder.find_postmortems(root):
+            try:
+                with open(path) as f:
+                    pm = json.load(f)
+            except (OSError, ValueError):
+                continue  # torn dump from a crash mid-write: skip, don't abort
+            pm["path"] = path
+            postmortems.append(pm)
+
+        takeovers: list[dict] = []
+        try:
+            with open(os.path.join(root, "director.json")) as f:
+                takeovers = json.load(f).get("takeovers") or []
+        except (OSError, ValueError):
+            pass
+
+        fleet_alerts: list[dict] = []
+        alerts_path = os.path.join(root, "observability", "fleet_alerts.jsonl")
+        try:
+            with open(alerts_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        fleet_alerts.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            pass
+
+        events: list[dict] = []
+        for pm in postmortems:
+            where = (
+                f"shard {pm.get('shard_index')}"
+                if pm.get("shard_index") is not None
+                else pm.get("scope", "?")
+            )
+            events.append(
+                {
+                    "t": float(pm.get("t") or 0.0),
+                    "source": where,
+                    "what": (
+                        f"postmortem {pm.get('event')} "
+                        f"({len(pm.get('samples') or [])} samples, "
+                        f"{len(pm.get('spans') or [])} spans, "
+                        f"{len(pm.get('journal_tail') or [])} journal records) "
+                        f"-> {pm.get('path')}"
+                    ),
+                }
+            )
+        for tk in takeovers:
+            phases = tk.get("phases") or {}
+            t0 = float(phases.get("start") or 0.0)
+            head = (
+                f"takeover shard {tk.get('dead_shard')} -> {tk.get('successor')} "
+                f"epoch {tk.get('epoch')}"
+            )
+            if not phases:
+                events.append({"t": t0, "source": "director", "what": head})
+            for phase in ("start", "fence", "adopt", "remap", "rehome"):
+                if phase not in phases:
+                    continue
+                pt = float(phases[phase])
+                events.append(
+                    {
+                        "t": pt,
+                        "source": "director",
+                        "what": f"{head}: {phase} (+{pt - t0:.3f}s)",
+                    }
+                )
+        for rec in fleet_alerts:
+            events.append(
+                {
+                    "t": float(rec.get("since") or rec.get("t") or 0.0),
+                    "source": "fleet-slo",
+                    "what": (
+                        f"fleet alert {rec.get('rule')} -> {rec.get('state')} "
+                        f"(value {rec.get('value')}, burn {rec.get('burn_rate')})"
+                    ),
+                }
+            )
+        if window and window > 0 and events:
+            horizon = max(e["t"] for e in events) - window
+            events = [e for e in events if e["t"] >= horizon]
+        events.sort(key=lambda e: e["t"])
+
+        bundle = {
+            "version": 1,
+            "root": root,
+            "generated_at": time.time(),
+            "postmortems": postmortems,
+            "takeovers": takeovers,
+            "fleet_alerts": fleet_alerts,
+            "timeline": events,
+        }
+        if out:
+            with open(out, "w") as f:
+                json.dump(bundle, f, indent=2, sort_keys=True)
+        if as_json:
+            click.echo(json.dumps(bundle, indent=2, sort_keys=True))
+            return
+        click.echo(
+            f"debug bundle for {root}: {len(postmortems)} postmortem(s), "
+            f"{len(takeovers)} takeover(s), {len(fleet_alerts)} fleet alert transition(s)"
+        )
+        if not events:
+            click.echo("  (no forensic events found — flight recorder off or nothing crashed)")
+        for e in events:
+            click.echo(f"  {_timeline_stamp(e['t'])}  {e['source']:<10} {e['what']}")
+        if out:
+            click.echo(f"wrote {out}")
 
 
 # ---------------------------------------------------------------------------

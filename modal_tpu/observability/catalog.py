@@ -455,6 +455,26 @@ DIRECTOR_REROUTES = REGISTRY.counter(
     ("reason",),
 )
 
+# -- federated observability + flight recorder (ISSUE 17) ---------------------
+
+FEDERATION_QUERY_SECONDS = REGISTRY.histogram(
+    "modal_tpu_federation_query_seconds",
+    "Director-observed latency of one federated history query (fan-out to every live "
+    "shard's /metrics/history + merge), by query kind.",
+    ("query",),
+)
+FEDERATION_PARTIAL_ANSWERS = REGISTRY.counter(
+    "modal_tpu_federation_partial_answers_total",
+    "Federated queries answered from a strict subset of shards (a dead or timed-out "
+    "shard degraded the answer; the payload is labeled, never silently truncated).",
+)
+FLIGHT_RECORDER_DUMPS = REGISTRY.counter(
+    "modal_tpu_flight_recorder_dumps_total",
+    "Postmortem bundles frozen + dumped by the flight recorder, by trigger event "
+    "(crash_restart|takeover|fence|alert).",
+    ("event",),
+)
+
 
 def observe_peak_rss() -> float:
     """Sample ru_maxrss into the PEAK_RSS_BYTES gauge; returns bytes."""
@@ -504,6 +524,8 @@ SPAN_CATALOG: dict[str, str] = {
     "recovery.crash_restart": "chaos supervisor crash + same-port rebuild",
     "control.takeover": "journal-fed partition takeover: dead shard's segments replayed into a survivor",
     "director.route": "placement director routing one app-scoped RPC to its owning shard",
+    "federation.query": "director-resident federated history query: fan-out to live shards + merge",
+    "debug.bundle": "crash-forensics collection: postmortem rings gathered + merged timeline rendered",
     "serving.admit": "serving-tier admission: queue wait → decode-slot + KV pages",
     "serving.prefill": "serving-tier prompt prefill (chunked; ends at the first token)",
     "serving.prefill_chunk": "one prefill chunk's device compute (per-request timeline detail)",

@@ -211,8 +211,31 @@ def _shutdown() -> None:
 atexit.register(_shutdown)
 
 
+# in-process span observers (ISSUE 17: the flight recorder's span tail) —
+# invoked before the sink check so a process with no configured sink still
+# feeds its black-box ring
+_span_taps: list = []
+
+
+def add_span_tap(tap) -> None:
+    if tap not in _span_taps:
+        _span_taps.append(tap)
+
+
+def remove_span_tap(tap) -> None:
+    try:
+        _span_taps.remove(tap)
+    except ValueError:
+        pass
+
+
 def _write(span: Span) -> None:
     global _sink_bytes
+    for tap in list(_span_taps):
+        try:
+            tap(span)
+        except Exception:
+            pass
     if _sink_file is None:
         return
     try:
@@ -406,9 +429,35 @@ def context_from_env() -> Optional[SpanContext]:
 # -- trace store reader (CLI waterfall / tests) -------------------------------
 
 
+def span_dirs(trace_dir_path: str) -> list[str]:
+    """The given trace dir plus any sibling per-shard span sinks: a sharded
+    fleet (server/shards.py) keeps the director's spans in ``<root>/traces``
+    and each subprocess shard's in ``<root>/shard-<i>/traces``. Readers merge
+    all of them so one routed call renders as one waterfall (ISSUE 17)."""
+    dirs = [trace_dir_path]
+    root = os.path.dirname(os.path.abspath(trace_dir_path))
+    try:
+        for name in sorted(os.listdir(root)):
+            if name.startswith("shard-"):
+                cand = os.path.join(root, name, "traces")
+                if cand != os.path.abspath(trace_dir_path) and os.path.isdir(cand):
+                    dirs.append(cand)
+    except OSError:
+        pass
+    return dirs
+
+
 def read_spans(trace_dir_path: str) -> list[dict]:
-    """Every span recorded under a trace dir, across all process files.
-    Malformed lines (torn writes at crash) are skipped."""
+    """Every span recorded under a trace dir (and any sibling per-shard span
+    sinks — see span_dirs), across all process files. Malformed lines (torn
+    writes at crash) are skipped."""
+    spans: list[dict] = []
+    for d in span_dirs(trace_dir_path):
+        spans.extend(_read_spans_one(d))
+    return spans
+
+
+def _read_spans_one(trace_dir_path: str) -> list[dict]:
     spans: list[dict] = []
     try:
         names = sorted(os.listdir(trace_dir_path))

@@ -127,7 +127,31 @@ class BlobServer:
                 f.write(f"{url}/metrics\n")
         except OSError:
             pass
+        # sharded fleet (ISSUE 17): a shard's state dir is <root>/shard-<i>,
+        # and N shards racing over one root breadcrumb was last-writer-wins.
+        # Each shard now ALSO writes a per-shard breadcrumb under the fleet
+        # root (the director owns the root metrics_url; federation resolves
+        # shard endpoints from these).
+        shard_crumb = self._fleet_shard_breadcrumb()
+        if shard_crumb is not None:
+            try:
+                os.makedirs(os.path.dirname(shard_crumb), exist_ok=True)
+                with open(shard_crumb, "w") as f:  # lint: disable=blocking-in-async
+                    f.write(f"{url}/metrics\n")
+            except OSError:
+                pass
         return url
+
+    def _fleet_shard_breadcrumb(self) -> Optional[str]:
+        """``<root>/observability/shards/shard-<i>`` when this supervisor is
+        one shard of a sharded fleet (its state dir is ``<root>/shard-<i>``,
+        server/shards.py's layout); None for a monolith."""
+        state_dir = os.path.abspath(self.state.state_dir)
+        idx = getattr(self.state, "shard_index", 0)
+        if os.path.basename(state_dir) != f"shard-{idx}":
+            return None
+        root = os.path.dirname(state_dir)
+        return os.path.join(root, "observability", "shards", f"shard-{idx}")
 
     async def _metrics(self, request: web.Request) -> web.Response:
         """Prometheus text by default; the OpenMetrics flavor — histogram
@@ -173,14 +197,18 @@ class BlobServer:
         # clean shutdown: drop the breadcrumb iff it still points at US — a
         # crash leaves it behind (the CLI then reports it as stale), and a
         # NEWER supervisor's breadcrumb must not be deleted by an old one
-        try:
-            crumb = os.path.join(self.state.state_dir, "observability", "metrics_url")
-            # tiny breadcrumb read at shutdown, the loop is idling:
-            with open(crumb) as f:  # lint: disable=blocking-in-async
-                if f.read().strip() == f"http://{self.host}:{self.port}/metrics":
-                    os.unlink(crumb)
-        except OSError:
-            pass
+        crumbs = [os.path.join(self.state.state_dir, "observability", "metrics_url")]
+        shard_crumb = self._fleet_shard_breadcrumb()
+        if shard_crumb is not None:
+            crumbs.append(shard_crumb)
+        for crumb in crumbs:
+            try:
+                # tiny breadcrumb read at shutdown, the loop is idling:
+                with open(crumb) as f:  # lint: disable=blocking-in-async
+                    if f.read().strip() == f"http://{self.host}:{self.port}/metrics":
+                        os.unlink(crumb)
+            except OSError:
+                pass
 
     async def _token_flow_approve(self, request: web.Request) -> web.Response:
         flow_id = request.match_info["flow_id"]

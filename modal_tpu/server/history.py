@@ -67,6 +67,8 @@ def history_payload(
         }
     if query == "top":
         return top_payload(state)
+    if query == "snapshot":
+        return snapshot_payload(state, window_s or TOP_SLOW_WINDOW_S)
     return {"error": f"unknown history query {query!r}"}
 
 
@@ -145,53 +147,89 @@ def _replica_rows(state: Any) -> list[dict]:
     return rows
 
 
+def fleet_summary(store: Any) -> tuple[dict, list]:
+    """The `top` dashboard's fleet roll-up + tokens/s sparkline against any
+    object exposing the TimeSeriesStore query surface — the supervisor's own
+    store here, or a federation MergedSnapshot at the director (ISSUE 17)."""
+    w = TOP_FAST_WINDOW_S
+    fleet = {
+        "ttft_p50_s": store.hist_quantile("modal_tpu_serving_ttft_seconds", 0.5, w),
+        "ttft_p95_s": store.hist_quantile("modal_tpu_serving_ttft_seconds", 0.95, w),
+        "dispatch_p50_s": store.hist_quantile("modal_tpu_dispatch_latency_seconds", 0.5, w),
+        "batch_occupancy_p50": store.hist_quantile("modal_tpu_serving_batch_occupancy", 0.5, w),
+        "requests_per_s": store.counter_rate("modal_tpu_serving_requests_total", w),
+        # call outcomes from the bounded task-results family (the
+        # rpc_total label space overflows the store's series cap)
+        "calls_per_s": store.counter_rate("modal_tpu_task_results_total", w),
+        "call_errors_per_s": store.counter_rate(
+            "modal_tpu_task_results_total", w, label_filter="FAILURE"
+        ),
+        "preemptions_per_s": store.counter_rate("modal_tpu_serving_preemptions_total", w),
+        # sharded control plane (server/shards.py): zero/absent = monolith
+        "placement_p95_s": store.hist_quantile(
+            "modal_tpu_shard_placement_latency_seconds", 0.95, w
+        ),
+        "director_reroutes_per_s": store.counter_rate("modal_tpu_director_reroutes_total", w),
+    }
+    for name, key in (
+        ("modal_tpu_serving_tokens_per_second", "tokens_per_s"),
+        ("modal_tpu_serving_queue_depth", "queue_depth"),
+        ("modal_tpu_kv_pages_free", "kv_pages_free"),
+        ("modal_tpu_kv_pages_allocated", "kv_pages_allocated"),
+        ("modal_tpu_scheduler_queue_depth", "scheduler_queue_depth"),
+        ("modal_tpu_device_memory_bytes", "device_memory_bytes"),
+        ("modal_tpu_control_shards_active", "control_shards_active"),
+        ("modal_tpu_shard_takeover_seconds", "shard_takeover_s"),
+    ):
+        stats = store.gauge_stats(name, w)
+        fleet[key] = stats["last"] if stats else None
+    # tokens/s sparkline over the slow window (merged across series)
+    pts = store.window_points("modal_tpu_serving_tokens_per_second", TOP_SLOW_WINDOW_S)
+    merged: dict[float, float] = {}
+    for series in pts.values():
+        for p in series:
+            merged[p[0]] = merged.get(p[0], 0.0) + p[1]
+    sparkline = [[round(t, 1), round(v, 2)] for t, v in sorted(merged.items())]
+    return fleet, sparkline
+
+
+def snapshot_payload(state: Any, window_s: float) -> dict:
+    """One shard's whole windowed store in a single payload — every tracked
+    family's series (wire-shaped, with kind + bounds), the per-replica rows,
+    and the alert view. The federation layer (observability/federation.py)
+    fetches exactly one of these per shard per federated query."""
+    store = state.timeseries
+    evaluator = state.slo
+    families: dict[str, dict] = {}
+    if store is not None:
+        for family in store.families:
+            payload = store.series_payload(family, window_s)
+            if payload.get("series") or payload.get("kind"):
+                families[family] = payload
+    alerts = (
+        evaluator.payload()
+        if evaluator is not None
+        else {"time": time.time(), "rules": [], "alerts": dict(state.alerts)}
+    )
+    return {
+        "time": time.time(),
+        "window_s": window_s,
+        "shard_index": getattr(state, "shard_index", 0),
+        "families": families,
+        "replicas": _replica_rows(state),
+        "alerts": alerts,
+    }
+
+
 def top_payload(state: Any) -> dict:
     """The `modal_tpu top` dashboard payload."""
     store = state.timeseries
     evaluator = state.slo
     now = time.time()
-    w = TOP_FAST_WINDOW_S
     fleet: dict = {}
     sparkline: list = []
     if store is not None:
-        fleet = {
-            "ttft_p50_s": store.hist_quantile("modal_tpu_serving_ttft_seconds", 0.5, w),
-            "ttft_p95_s": store.hist_quantile("modal_tpu_serving_ttft_seconds", 0.95, w),
-            "dispatch_p50_s": store.hist_quantile("modal_tpu_dispatch_latency_seconds", 0.5, w),
-            "batch_occupancy_p50": store.hist_quantile("modal_tpu_serving_batch_occupancy", 0.5, w),
-            "requests_per_s": store.counter_rate("modal_tpu_serving_requests_total", w),
-            # call outcomes from the bounded task-results family (the
-            # rpc_total label space overflows the store's series cap)
-            "calls_per_s": store.counter_rate("modal_tpu_task_results_total", w),
-            "call_errors_per_s": store.counter_rate(
-                "modal_tpu_task_results_total", w, label_filter="FAILURE"
-            ),
-            "preemptions_per_s": store.counter_rate("modal_tpu_serving_preemptions_total", w),
-            # sharded control plane (server/shards.py): zero/absent = monolith
-            "placement_p95_s": store.hist_quantile(
-                "modal_tpu_shard_placement_latency_seconds", 0.95, w
-            ),
-            "director_reroutes_per_s": store.counter_rate("modal_tpu_director_reroutes_total", w),
-        }
-        for name, key in (
-            ("modal_tpu_serving_tokens_per_second", "tokens_per_s"),
-            ("modal_tpu_serving_queue_depth", "queue_depth"),
-            ("modal_tpu_kv_pages_free", "kv_pages_free"),
-            ("modal_tpu_kv_pages_allocated", "kv_pages_allocated"),
-            ("modal_tpu_scheduler_queue_depth", "scheduler_queue_depth"),
-            ("modal_tpu_device_memory_bytes", "device_memory_bytes"),
-            ("modal_tpu_control_shards_active", "control_shards_active"),
-            ("modal_tpu_shard_takeover_seconds", "shard_takeover_s"),
-        ):
-            stats = store.gauge_stats(name, w)
-            fleet[key] = stats["last"] if stats else None
-        # tokens/s sparkline over the slow window (merged across series)
-        pts = store.window_points("modal_tpu_serving_tokens_per_second", TOP_SLOW_WINDOW_S)
-        merged: dict[float, float] = {}
-        for series in pts.values():
-            for p in series:
-                merged[p[0]] = merged.get(p[0], 0.0) + p[1]
-        sparkline = [[round(t, 1), round(v, 2)] for t, v in sorted(merged.items())]
+        fleet, sparkline = fleet_summary(store)
     alerts = evaluator.payload() if evaluator is not None else {"rules": [], "alerts": dict(state.alerts)}
     return {
         "time": now,

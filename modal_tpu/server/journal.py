@@ -237,6 +237,9 @@ class Journal:
         self._group_depth = 0
         self._group_dirty = False
         self._group_owner = None  # asyncio task (or None-sentinel) holding the group
+        # optional record observer (ISSUE 17: the flight recorder's journal
+        # tail) — called with the appended payload dict, never raises out
+        self.tap = None
         # segment name -> max seq it holds (maintained as segments roll so
         # compaction's prune decision never re-reads segment files on the
         # supervisor's event loop)
@@ -313,6 +316,12 @@ class Journal:
         payload["seq"] = self.seq
         payload["t"] = t
         line = json.dumps(payload, separators=(",", ":")) + "\n"
+        tap = self.tap
+        if tap is not None:
+            try:
+                tap(payload)
+            except Exception:
+                pass
         self._fh.write(line)
         if self._group_depth > 0 and self._current_task() is self._group_owner:
             self._group_dirty = True  # group exit commits the batch

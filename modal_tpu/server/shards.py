@@ -113,6 +113,24 @@ class PlacementDirector:
             )
         return api_pb2.ShardControlResponse(payload_json=json.dumps(parent.topology()))
 
+    async def MetricsHistory(self, request, context):
+        """Fleet-merged history (ISSUE 17): when federation is on, the
+        director answers the same query contract as a shard's handler but
+        over every live shard's merged series; otherwise it forwards to the
+        routed shard like any other RPC (one slice, as before)."""
+        parent = self.parent
+        await self._check_blackhole(context)
+        if parent.federation is not None:
+            payload = await parent.federation.payload(
+                request.query,
+                family=request.family,
+                window_s=request.window_s,
+                q=request.q,
+            )
+            return api_pb2.MetricsHistoryResponse(payload_json=json.dumps(payload))
+        home, owner = self._route(request)
+        return await self._forward_unary("MetricsHistory", request, context, owner)
+
     # -- synthesized forwarders ----------------------------------------------
 
     def __getattr__(self, name: str):
@@ -122,19 +140,36 @@ class PlacementDirector:
         if method.arity == Arity.UNARY_UNARY:
 
             async def forward(request, context, _name=name):
-                t0, wall0 = time.perf_counter(), time.time()
+                t0 = time.perf_counter()
                 await self._check_blackhole(context)
                 home, owner = self._route(request)
-                resp = await self._forward_unary(_name, request, context, owner)
+                # trace stitching (ISSUE 17): for traced callers, open the
+                # director.route span BEFORE forwarding and re-parent the
+                # forwarded leg under it, so the shard's rpc.server span
+                # hangs off the route hop — one waterfall, not two siblings.
+                span = None
+                if tracing.current_context() is not None:
+                    span = tracing.open_span(
+                        "director.route",
+                        attrs={"rpc": _name, "partition": home, "shard": owner},
+                    )
+                try:
+                    resp = await self._forward_unary(
+                        _name,
+                        request,
+                        context,
+                        owner,
+                        trace_ctx=span.context if span is not None else None,
+                    )
+                except BaseException:
+                    if span is not None:
+                        tracing.close_span(span, status="error")
+                    raise
+                if span is not None:
+                    tracing.close_span(span)
                 SHARD_PLACEMENT_LATENCY.observe(time.perf_counter() - t0)
                 if owner != home:
                     DIRECTOR_REROUTES.inc(reason="takeover")
-                tracing.record_span(
-                    "director.route",
-                    start=wall0,
-                    end=time.time(),
-                    attrs={"rpc": _name, "partition": home, "shard": owner},
-                )
                 return resp
 
         elif method.arity == Arity.UNARY_STREAM:
@@ -169,10 +204,18 @@ class PlacementDirector:
         home = 0 if part is None else part
         return home, parent.assignments[home]
 
-    async def _forward_unary(self, name: str, request, context, shard: int):
+    async def _forward_unary(self, name: str, request, context, shard: int, trace_ctx=None):
         parent = self.parent
         url = parent.shard_urls[shard]
         metadata = list(context.invocation_metadata() or ())
+        if trace_ctx is not None:
+            # re-parent the forwarded leg under the director.route span
+            # (strip the caller's span id first — duplicate keys would race)
+            metadata = [
+                (k, v)
+                for (k, v) in metadata
+                if k not in (tracing.TRACE_ID_METADATA_KEY, tracing.SPAN_ID_METADATA_KEY)
+            ] + tracing.context_metadata(trace_ctx)
         server = local_transport.resolve_local_server(url)
         if server is not None:
             entry = server.handlers.get(name)
@@ -276,6 +319,13 @@ class ShardedSupervisor:
         self._chaos_task: Optional[asyncio.Task] = None
         self._takeover_lock = asyncio.Lock()
 
+        # fleet observability (ISSUE 17): director-resident federation +
+        # fleet-scope SLO loop + crash-forensics flight recorder
+        self.federation = None
+        self.federation_server = None
+        self.flight_recorder = None
+        self._federation_task: Optional[asyncio.Task] = None
+
     # -- identity -------------------------------------------------------------
 
     @property
@@ -345,6 +395,40 @@ class ShardedSupervisor:
         await self._start_director()
         self._persist_topology()
         CONTROL_SHARDS_ACTIVE.set(float(self.num_shards))
+        if config["trace"]:
+            # the director's span sink lives at the FLEET root; in-process
+            # shards configured the process-wide sink at their own dirs
+            # during boot — re-point it here so director.route + everything
+            # after lands under <root>/traces (subprocess shards keep their
+            # own <root>/shard-<i>/traces sinks; readers merge via
+            # tracing.span_dirs)
+            trace_root = os.path.join(self.state_dir, "traces")
+            tracing.gc_trace_dir(trace_root)
+            tracing.configure(trace_root)
+        from ..observability import federation as obs_federation
+        from ..observability import flight_recorder as obs_flight_recorder
+
+        if obs_flight_recorder.enabled():
+            self.flight_recorder = obs_flight_recorder.FlightRecorder(
+                self.state_dir, chaos=self.chaos, scope="director"
+            )
+            self.flight_recorder.start()
+        if obs_federation.enabled():
+            self.federation = obs_federation.FederatedHistory(
+                self.state_dir,
+                # in-process shards share one process-wide registry: every
+                # shard's store holds the same series, so fan-out would
+                # N-count — merge SERIES from one live shard, the rest of
+                # the payload (replicas, alerts) from all
+                shared_registry=not self.subprocess_shards,
+            )
+            self.federation_server = obs_federation.FederationServer(
+                self.federation, self.state_dir
+            )
+            await self.federation_server.start()
+            self._federation_task = asyncio.create_task(
+                self._federation_loop(), name="fleet-slo"
+            )
         self._health_task = asyncio.create_task(self._health_loop(), name="shard-health")
         if self.chaos is not None and self.chaos.events:
             self._chaos_task = asyncio.create_task(
@@ -432,6 +516,28 @@ class ShardedSupervisor:
         # exactly like remote ones — one routing brain, two transports
         local_transport.register_local_server(self.server_url, self.director)
 
+    async def _federation_loop(self) -> None:
+        """Fleet-scope SLO evaluation (ISSUE 17): run the burn-rate rules at
+        the director over the MERGED series on the store's cadence, so a
+        fleet-wide violation fires even when no single shard crosses its own
+        threshold. Firing transitions freeze + dump the director's flight
+        recorder."""
+        from ..observability import timeseries as obs_timeseries
+
+        interval = max(2.0, obs_timeseries.base_interval_s())
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                transitions = await self.federation.evaluate_fleet()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("fleet SLO evaluation failed")
+                continue
+            for tr in transitions:
+                if tr.get("state") == "firing" and self.flight_recorder is not None:
+                    self.flight_recorder.dump("alert", extra={"alert": tr, "fleet": True})
+
     async def restart_director(self) -> None:
         """Kill + rebind the routing tier on the same port (chaos / tests):
         clients mid-map see UNAVAILABLE, retry, and land on the rebuilt
@@ -444,14 +550,23 @@ class ShardedSupervisor:
         logger.warning(f"placement director restarted at {self.server_url}")
 
     async def stop(self) -> None:
-        for task in (self._health_task, self._chaos_task):
+        for task in (self._health_task, self._chaos_task, self._federation_task):
             if task is not None:
                 task.cancel()
                 try:
                     await task
                 except asyncio.CancelledError:
                     pass
-        self._health_task = self._chaos_task = None
+        self._health_task = self._chaos_task = self._federation_task = None
+        if self.federation_server is not None:
+            await self.federation_server.stop()
+            self.federation_server = None
+        if self.federation is not None:
+            await self.federation.close()
+            self.federation = None
+        if self.flight_recorder is not None:
+            self.flight_recorder.stop()
+            self.flight_recorder = None
         local_transport.unregister_local_server(self.server_url)
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)
@@ -568,11 +683,15 @@ class ShardedSupervisor:
                 logger.error(f"shard {dead_index} dead and no live successor — cannot fail over")
                 return
             t0 = time.time()
+            # per-phase wall timestamps: the debug-bundle timeline annotates
+            # fence → adopt → remap → rehome against the metrics window
+            phases = {"start": round(t0, 3)}
             epoch = self.epoch + 1
             # fence FIRST: a false death (live shard behind a partition) must
             # stop serving before its journal is replayed elsewhere, or two
             # shards own one partition (split-brain)
             await self._fence_shard(dead_index, epoch)
+            phases["fence"] = round(time.time(), 3)
             dead_dir = shard_dir(self.state_dir, dead_index)
             try:
                 report = await self._adopt(successor, dead_dir, dead_index)
@@ -581,12 +700,15 @@ class ShardedSupervisor:
                     f"takeover of shard {dead_index} by {successor} failed; will retry"
                 )
                 return
+            phases["adopt"] = round(time.time(), 3)
             moved = [p for p in range(self.num_partitions) if self.assignments[p] == dead_index]
             for p in moved:
                 self.assignments[p] = successor
             self.epoch = epoch
             self._persist_topology()
+            phases["remap"] = round(time.time(), 3)
             await self._rehome_workers(dead_index, successor)
+            phases["rehome"] = round(time.time(), 3)
             took = time.time() - t0
             entry = {
                 "dead_shard": dead_index,
@@ -594,9 +716,12 @@ class ShardedSupervisor:
                 "partitions": moved,
                 "epoch": epoch,
                 "seconds": round(took, 4),
+                "phases": phases,
                 "report": report,
             }
             self.takeover_log.append(entry)
+            if self.flight_recorder is not None:
+                self.flight_recorder.dump("takeover", extra={"takeover": entry})
             # re-persist: the first write published the new assignments ASAP;
             # this one adds the takeover record external watchers read
             self._persist_topology()

@@ -77,6 +77,7 @@ class LocalSupervisor:
         self.uds_path = ""  # control-plane Unix socket (set at bind time)
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._sampler_task: Optional[asyncio.Task] = None  # ISSUE 11 time-series sampler
+        self.flight_recorder = None  # ISSUE 17 crash-forensics ring
         self._chaos_task: Optional[asyncio.Task] = None
         self._chaos_subtasks: set[asyncio.Task] = set()  # strong refs (GC guard)
         # serializes crash_restart: two supervisor_crash chaos events due in
@@ -266,6 +267,22 @@ class LocalSupervisor:
                 self.state.timeseries, alerts=self.state.alerts, journal=self.state.journal
             )
             self._sampler_task = asyncio.create_task(self._sampler_loop(), name="ts-sampler")
+        # crash-forensics flight recorder (ISSUE 17): bounded in-memory ring
+        # of raw samples + span/journal/chaos tails, frozen and dumped as
+        # postmortem-<event>.json on crash_restart / fence / takeover / alert
+        # firing. Rebuilt here (like the store) so it taps the NEW journal.
+        from ..observability import flight_recorder as obs_fr
+
+        if obs_fr.enabled():
+            self.flight_recorder = obs_fr.FlightRecorder(
+                self.state_dir,
+                journal=self.state.journal,
+                chaos=self.chaos,
+                shard_index=self.shard_index,
+            )
+            self.flight_recorder.start()
+        else:
+            self.flight_recorder = None
 
     async def _sampler_loop(self) -> None:
         """Sample the registry into the store + evaluate SLO rules, forever.
@@ -287,7 +304,12 @@ class LocalSupervisor:
                 TIMESERIES_SAMPLE_SECONDS.observe(_time.perf_counter() - t0)
                 for tier, n in store.point_counts().items():
                     TIMESERIES_POINTS.set(float(n), tier=tier)
-                evaluator.evaluate()
+                transitions = evaluator.evaluate()
+                recorder = self.flight_recorder
+                if recorder is not None:
+                    for tr in transitions:
+                        if tr.get("state") == "firing":
+                            recorder.dump("alert", extra={"alert": tr})
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -302,6 +324,9 @@ class LocalSupervisor:
             except asyncio.CancelledError:
                 pass
             self._sampler_task = None
+        if self.flight_recorder is not None:
+            self.flight_recorder.stop()
+            self.flight_recorder = None
 
     async def _chaos_event_loop(self) -> None:
         """Fire scheduled chaos events (worker kill / preempt / heartbeat
@@ -399,6 +424,10 @@ class LocalSupervisor:
         import time as _time
 
         t0 = _time.time()
+        if self.flight_recorder is not None:
+            # black-box dump BEFORE teardown: the ring still holds the 60 s
+            # leading up to the crash (the rebuilt plane gets a fresh ring)
+            self.flight_recorder.dump("crash_restart")
         grpc_port, blob_port, input_port = await self.crash_abandon()
         # rebuild the whole control plane from the journal
         self.state = ServerState(
@@ -459,6 +488,8 @@ class LocalSupervisor:
         )
         self.takeover_reports.append(report)
         SHARD_TAKEOVER_SECONDS.set(took, partition=str(partition))
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump("takeover", extra={"report": report})
         tracing.record_span("control.takeover", start=t0, end=_time.time(), attrs=report)
         logger.warning(f"shard {self.shard_index} adopted partition {partition}: {report}")
         return report
@@ -477,6 +508,8 @@ class LocalSupervisor:
             return
         self.fenced = True
         self.fenced_at_epoch = epoch
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump("fence", extra={"epoch": epoch})
         from .._utils import local_transport
 
         local_transport.unregister_local_server(self.server_url)

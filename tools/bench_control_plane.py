@@ -24,6 +24,11 @@ Reported (CONTROL_BENCH_RESULT JSON line):
 - ``control_calls_per_s`` — completed map-calls per second.
 - ``control_inputs_per_s`` — accepted inputs per second.
 - ``control_takeover_s`` — takeover-to-first-placement recovery time.
+- ``federation_query_p50_s`` / ``federation_direct_p50_s`` /
+  ``federation_overhead_x`` — fleet-merged /metrics/history query latency vs
+  one shard's direct endpoint (ISSUE 17: merged must stay <= 2x direct at 3
+  shards), plus ``flight_dump_s`` / ``flight_ring_bytes`` for the flight
+  recorder's postmortem dump.
 
 Usage (full scale ≈ 1M inputs / 10k calls; scale down for CI):
     JAX_PLATFORMS=cpu python tools/bench_control_plane.py \
@@ -151,6 +156,97 @@ async def _probe_recovery(client, function_id: str, t_kill: float, payload: byte
             await asyncio.sleep(0.02)
 
 
+async def _bench_federation(repeats: int = 20) -> dict:
+    """Federation phase (ISSUE 17): merged /metrics/history query latency vs
+    one shard's direct rendered `top` answer, plus the flight recorder's dump
+    latency and serialized ring size.
+
+    Runs against its OWN 3-shard subprocess fleet: the production deployment
+    shape is one process per shard, so the fan-out's server-side work is
+    genuinely concurrent. (An in-process fleet serializes all three handlers
+    on one event loop, which turns overhead_x into a measure of the bench
+    harness, not the federation.)"""
+    from modal_tpu.observability import flight_recorder
+    from modal_tpu.observability.federation import FederatedHistory
+    from modal_tpu.server.shards import ShardedSupervisor
+
+    fed_dir = tempfile.mkdtemp(prefix="bench-federation-")
+    sup = ShardedSupervisor(
+        num_shards=3,
+        num_workers=3,
+        state_dir=fed_dir,
+        worker_chips=8,
+        worker_tpu_type="local-sim",
+        subprocess_shards=True,
+        health_interval_s=5.0,
+    )
+    out: dict = {}
+    try:
+        await sup.start()
+        await asyncio.sleep(2.5)  # let each shard's sampler populate its store
+        fed = FederatedHistory(fed_dir, shared_registry=False)
+        live = [s for s in fed.topology() if not s.get("dead")]
+        if live:
+            # the single-shard arm is what an operator runs against a
+            # monolith: the shard's OWN rendered `top` answer over the same
+            # transport — so overhead_x isolates the fan-out + merge cost
+            await fed.payload("top")  # warm connections on both arms
+            await fed._fetch(live[0], "top", 600.0)
+            fed_lat: list[float] = []
+            direct_lat: list[float] = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                await fed.payload("top")
+                fed_lat.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                await fed._fetch(live[0], "top", 600.0)
+                direct_lat.append(time.perf_counter() - t0)
+            # the merge itself (namespacing + fleet_summary + per-shard rows)
+            # is the only work federation ADDS beyond the fetches — time it
+            # separately so the additive cost is guarded host-independently
+            snaps, missing, dead = await fed._gather(600.0)
+            merge_lat: list[float] = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                merged = fed.merged(snaps)
+                fed._top_payload(snaps, missing, dead, merged, fed._fed_meta(snaps, missing, dead))
+                merge_lat.append(time.perf_counter() - t0)
+            await fed.close()
+            fed_lat.sort()
+            direct_lat.sort()
+            merge_lat.sort()
+            fp50 = _quantile(fed_lat, 0.5)
+            dp50 = _quantile(direct_lat, 0.5)
+            out.update(
+                {
+                    "federation_query_p50_s": round(fp50, 6),
+                    "federation_query_p99_s": round(_quantile(fed_lat, 0.99), 6),
+                    "federation_direct_p50_s": round(dp50, 6),
+                    "federation_merge_p50_s": round(_quantile(merge_lat, 0.5), 6),
+                    "federation_overhead_x": round(fp50 / dp50, 3) if dp50 > 0 else None,
+                    "federation_shards": len(live),
+                    # on a host with fewer cores than shards every fetch's
+                    # client+server CPU serializes, so overhead_x floors at
+                    # ~N regardless of transport — the guard reads this to
+                    # pick the bar it can honestly hold
+                    "federation_cores": os.cpu_count() or 1,
+                }
+            )
+        fr = flight_recorder.FlightRecorder(
+            os.path.join(fed_dir, "bench-flight"), scope="bench", interval_s=0.0
+        )
+        for _ in range(fr.samples.maxlen or 60):
+            fr.record_sample()
+        t0 = time.perf_counter()
+        dump_path = fr.dump("bench")
+        out["flight_dump_s"] = round(time.perf_counter() - t0, 6)
+        out["flight_ring_bytes"] = os.path.getsize(dump_path) if dump_path else 0
+    finally:
+        await sup.stop()
+        shutil.rmtree(fed_dir, ignore_errors=True)
+    return out
+
+
 async def run_bench(args) -> dict:
     from modal_tpu.client import _Client
     from modal_tpu.server.shards import ShardedSupervisor
@@ -191,6 +287,9 @@ async def run_bench(args) -> dict:
         await asyncio.gather(
             *(_guarded(i % args.shards) for i in range(calls_first))
         )
+        # federation phase between the load halves (its own subprocess fleet;
+        # the main in-process fleet is idle while it runs)
+        federation_metrics = await _bench_federation()
         # kill one shard mid-run, keep pumping, and race the recovery probe
         t_kill = time.monotonic()
         await sup.kill_shard(kill_index)
@@ -228,6 +327,7 @@ async def run_bench(args) -> dict:
             "takeover_epoch": sup.epoch,
             "takeover_log": sup.takeover_log,
             "total_s": round(total_s, 2),
+            **federation_metrics,
         }
     finally:
         await client._close()
