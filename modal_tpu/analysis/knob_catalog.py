@@ -77,6 +77,8 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "abort the next N serving SSE streams mid-flight"),
         _k("MODAL_TPU_CHAOS_SERVING_STEP_DELAY_S", "float", "0", "docs/SERVING.md",
            "inject per-decode-step delay into the serving engine"),
+        _k("MODAL_TPU_CHAOS_KV_SHIP_DROP", "int", "0", "docs/SERVING.md",
+           "drop the next N KV-page shipments at admission (decode re-prefills locally)"),
         # -- dispatch fast path (docs/DISPATCH.md) --------------------------
         _k("MODAL_TPU_FASTPATH", "bool", "1", "docs/DISPATCH.md",
            "whole local-transport ladder (in-process/UDS) off → TCP only", gate=True),
@@ -184,6 +186,13 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "decode-span granularity (tokens per span mark)"),
         _k("MODAL_TPU_PAGED_KERNEL", "enum(auto|1|interpret|0)", "auto", "docs/SERVING.md",
            "Pallas paged-attention kernel selection; 0/off forces the gather path", gate=True),
+        _k("MODAL_TPU_SERVING_ROUTER", "bool", "1", "docs/SERVING.md",
+           "prefix-aware fleet routing; off → seeded-random replica choice", gate=True),
+        _k("MODAL_TPU_SERVING_ROLE", "enum(both|prefill|decode)", "both", "docs/SERVING.md",
+           "disaggregation role of this replica (prefill exports KV pages, decode imports)"),
+        _k("MODAL_TPU_SPEC_OVERLAP", "bool", "1", "docs/SERVING.md",
+           "overlap draft-propose with in-flight target verify across slot groups; "
+           "off → PR 11 sequential spec rounds", gate=True),
         # -- cold start (docs/COLDSTART.md) ---------------------------------
         _k("MODAL_TPU_WARM_POOL", "int", "0", "docs/COLDSTART.md",
            "baseline pre-forked parked interpreters per worker (config.py 'warm_pool')"),

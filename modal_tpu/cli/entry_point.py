@@ -831,7 +831,7 @@ def _render_top_frame(payload: dict) -> str:
     replicas = payload.get("replicas") or []
     lines.append("")
     lines.append(
-        f"  {'replica':<14} {'function':<16} {'occup':>6} {'kv free':>8} {'queue':>6} "
+        f"  {'replica':<14} {'function':<16} {'role':<7} {'occup':>6} {'kv free':>8} {'queue':>6} "
         f"{'ttft p95':>9} {'tok/s':>8} {'pfx hit':>8} {'accept':>7} {'mem MB':>8} {'age':>7}"
     )
     if not replicas:
@@ -839,6 +839,7 @@ def _render_top_frame(payload: dict) -> str:
     for r in replicas:
         lines.append(
             f"  {r.get('task_id', '')[:14]:<14} {str(r.get('function', ''))[:16]:<16} "
+            f"{str(r.get('role') or '-'):<7} "
             f"{_fmt_num(r.get('batch_occupancy_mean'), digits=1):>6} "
             f"{_fmt_num(r.get('kv_pages_free'), digits=0):>8} "
             f"{_fmt_num(r.get('queue_depth'), digits=0):>6} "

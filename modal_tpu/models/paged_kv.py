@@ -36,6 +36,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .llama import LlamaConfig, apply_rope, repeat_kv, rms_norm, rope_frequencies
@@ -220,6 +221,45 @@ def set_seq_lens(cache: PagedKVCache, new_lens: jax.Array, update: jax.Array) ->
     rejected positions' KV becomes unattended garbage beyond the length)."""
     return cache._replace(
         seq_lens=jnp.where(update, new_lens.astype(jnp.int32), cache.seq_lens)
+    )
+
+
+# -- KV-page shipment (prefill/decode disaggregation, ISSUE 18) ---------------
+
+
+def export_pages(cache: PagedKVCache, page_ids: list[int]) -> dict:
+    """Pull the named pages off the device as host arrays, ready to ride a
+    blob-plane frame to another replica. Shapes: k/v are
+    [n_layers, len(page_ids), page_size, n_kv, hd] in the pool dtype —
+    whole pages, so positions past the holder's seq_len travel as garbage
+    and stay unattended on the importer too. Read-only: exporting pages
+    that are refcount-shared with the prefix cache is safe."""
+    idx = jnp.asarray(page_ids, jnp.int32)
+    return {
+        "k": np.asarray(cache.k_pages[:, idx]),
+        "v": np.asarray(cache.v_pages[:, idx]),
+    }
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _import_pages(cache: PagedKVCache, idx: jax.Array, k: jax.Array, v: jax.Array) -> PagedKVCache:
+    return cache._replace(
+        k_pages=cache.k_pages.at[:, idx].set(k),
+        v_pages=cache.v_pages.at[:, idx].set(v),
+    )
+
+
+def import_pages(cache: PagedKVCache, page_ids: list[int], data: dict) -> PagedKVCache:
+    """Write a shipped page bundle (an `export_pages` dict) into freshly
+    allocated local pages. One executable per page count — shipment sizes
+    are prompt-page counts, so they bucket like prefill lengths in
+    practice. The caller owns the page allocation/table wiring; dtype is
+    cast to the pool's (a bf16 pool importing from a bf16 pool is a
+    no-op cast)."""
+    idx = jnp.asarray(page_ids, jnp.int32)
+    dtype = cache.k_pages.dtype
+    return _import_pages(
+        cache, idx, jnp.asarray(data["k"], dtype), jnp.asarray(data["v"], dtype)
     )
 
 
@@ -526,13 +566,21 @@ class PrefixCache:
         self._clock += 1.0
         return self._clock
 
-    def lookup(self, tokens: list) -> Optional[tuple[list[int], int, "PrefixCacheEntry"]]:
+    def lookup(
+        self, tokens: list, allow_partial: bool = True
+    ) -> Optional[tuple[list[int], int, "PrefixCacheEntry"]]:
         """Longest cached prefix of `tokens` covering at most len(tokens)-1
         positions (the suffix must still prefill to produce last-token
         logits). Returns (pages, covered_tokens, entry) with one holder ref
         taken on every returned page — the caller owns the release — or
         None. `covered` may end mid-page; that last page arrives
         refcount-shared and must be CoW'd before the caller writes into it.
+
+        `allow_partial=False` stops coverage at the full-page boundary: the
+        caller then never writes into a shared page at all, so no CoW
+        machinery is needed on its pool. This is the draft-pool mode (ISSUE
+        18): the draft mirror has no `_cow_range`, so it may only share
+        pages it will never touch.
 
         Deliberately side-effect-free beyond the refs: hit/miss counters and
         the entry's LRU clock move at `commit_use`/`note_miss` — a dry-pool
@@ -547,7 +595,7 @@ class PrefixCache:
             covered = j * page
             pages = list(entry.pages[:j])
             # token-granular extension into the entry's next (partial) page
-            if len(entry.tokens) > covered and len(entry.pages) > j:
+            if allow_partial and len(entry.tokens) > covered and len(entry.pages) > j:
                 limit = min(page, len(entry.tokens) - covered, max_cover - covered)
                 extra = 0
                 while extra < limit and entry.tokens[covered + extra] == tokens[covered + extra]:
@@ -569,15 +617,22 @@ class PrefixCache:
     def note_miss(self) -> None:
         self.misses += 1
 
-    def insert(self, tokens: list, pages: list[int]) -> bool:
+    def insert(self, tokens: list, pages: list[int], full_pages_only: bool = False) -> bool:
         """Cache `tokens`' prefix KV. `pages` is the holding slot's page list
         (only the prompt-covering prefix is taken); the entry refs them, so
         they outlive the slot. Needs at least one full page to be indexable.
-        Returns True if a new entry was created."""
+        Returns True if a new entry was created.
+
+        `full_pages_only=True` publishes only the full-page prompt prefix
+        (the partial last page stays private to the slot) — paired with
+        `lookup(allow_partial=False)` for pools without CoW support: a
+        shared page is then guaranteed write-free on both sides."""
         page = self.page_size
         full = len(tokens) // page
         if full < 1:
             return False
+        if full_pages_only:
+            tokens = list(tokens[: full * page])
         key = tuple(tokens)
         if key in self._entries:
             return False

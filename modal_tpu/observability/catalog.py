@@ -378,6 +378,34 @@ SERVING_SAMPLED_TOKENS = REGISTRY.counter(
     "as opposed to greedy argmax.",
 )
 
+# -- serving fleet (ISSUE 18; serving/router.py, prefill/decode
+# disaggregation — serving/engine.py, docs/SERVING.md) ------------------------
+
+SERVING_ROUTER_ROUTED = REGISTRY.counter(
+    "modal_tpu_serving_router_routed_total",
+    "Requests the fleet router dispatched, by reason (prefix = prefix-map "
+    "hit, affinity = pinned session, cold = consistent-hash fallback, "
+    "random = router disabled).",
+    ("reason",),
+)
+SERVING_ROLE = REGISTRY.gauge(
+    "modal_tpu_serving_role",
+    "This replica's serving role as a numeric code (0 = both, 1 = prefill, "
+    "2 = decode — engine.ROLE_GAUGE_VALUES); rides the heartbeat so "
+    "`modal_tpu top` and the autoscaler can tell fleet tiers apart.",
+)
+KV_PAGES_SHIPPED = REGISTRY.counter(
+    "modal_tpu_kv_pages_shipped_total",
+    "KV pages exported off-device for prefill→decode shipment (blob-plane "
+    "page bundles; counted on the exporting replica).",
+)
+KV_SHIP_SECONDS = REGISTRY.histogram(
+    "modal_tpu_kv_ship_seconds",
+    "Device→host export time of one KV-page shipment bundle (the prefill "
+    "side of a disaggregated handoff).",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5),
+)
+
 # -- fleet SLO observability (ISSUE 11; observability/timeseries.py,
 # observability/slo.py, docs/OBSERVABILITY.md) --------------------------------
 
@@ -534,6 +562,8 @@ SPAN_CATALOG: dict[str, str] = {
     "serving.spec_verify": "one speculative round: draft proposals → target verify → acceptance (ISSUE 12)",
     "serving.request": "root of one serving request's lifecycle: submit → done (ISSUE 11 timelines)",
     "serving.stream": "one SSE token stream: open → done/reset (serving/api.py)",
+    "serving.route": "fleet router dispatch: prefix-map/affinity/cold pick → replica call (ISSUE 18)",
+    "serving.kv_ship": "KV-page shipment leg: export off the prefill replica / import on the decode replica",
 }
 
 

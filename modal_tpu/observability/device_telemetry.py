@@ -233,6 +233,15 @@ PUSH_FAMILIES = (
     "modal_tpu_serving_spec_accept_ratio",
     "modal_tpu_serving_sampled_tokens_total",
     "modal_tpu_kv_pages_cow_copies_total",
+    # ISSUE 18 fleet: the role gauge lets `modal_tpu top` and the role-aware
+    # autoscaler tell prefill/decode/both replicas apart; shipment counters
+    # make disaggregation traffic first-class per replica
+    "modal_tpu_serving_role",
+    "modal_tpu_kv_pages_shipped_total",
+    "modal_tpu_kv_ship_seconds",
+    # the router's dispatch counter rides too: a router-tier container's
+    # heartbeat then carries its routed-by-reason split
+    "modal_tpu_serving_router_routed_total",
 )
 
 

@@ -106,6 +106,13 @@ def _replica_rows(state: Any) -> list[dict]:
             if lookups > 0:
                 prefix_hit_pct = 100.0 * (prefix_hits or 0.0) / lookups
         spec_accept = _push_gauge(report, "modal_tpu_serving_spec_accept_ratio")
+        # ISSUE 18: disaggregation role (gauge value per engine's
+        # ROLE_GAUGE_VALUES — mapping inlined so the supervisor never
+        # imports the serving tier)
+        role_code = _push_gauge(report, "modal_tpu_serving_role")
+        role = None
+        if role_code is not None:
+            role = {0: "both", 1: "prefill", 2: "decode"}.get(int(role_code))
         # batch occupancy rides as a cumulative histogram: report its mean
         occ = (report.get("modal_tpu_serving_batch_occupancy") or {}).get("series") or {}
         occ_mean = None
@@ -141,6 +148,7 @@ def _replica_rows(state: Any) -> list[dict]:
                 "kv_pages_allocated": pages_alloc,
                 "prefix_hit_pct": prefix_hit_pct,
                 "spec_accept_ratio": spec_accept,
+                "role": role,
                 "memory_bytes": hbm or None,
             }
         )

@@ -254,10 +254,18 @@ class Scheduler:
         queue_depth = pushed_gauge(report, "modal_tpu_serving_queue_depth")
         if ttft_p95 is None and tokens_per_s is None and queue_depth is None:
             return None
+        # ISSUE 18: disaggregation role rides the push as a numeric gauge
+        # (engine's ROLE_GAUGE_VALUES; mapping inlined — the supervisor
+        # never imports the serving tier)
+        role_code = pushed_gauge(report, "modal_tpu_serving_role")
+        role = None
+        if role_code is not None:
+            role = {0: "both", 1: "prefill", 2: "decode"}.get(int(role_code))
         return {
             "ttft_p95_s": ttft_p95 or 0.0,
             "tokens_per_s": tokens_per_s or 0.0,
             "queue_depth": queue_depth or 0.0,
+            "role": role,
         }
 
     _LIVE_TASK_STATES = (
@@ -374,11 +382,16 @@ class Scheduler:
             active = queued > 0 or total_tps > 0
             violated = queued > 0 or (ttft_slo_s > 0 and worst_ttft > ttft_slo_s and active)
             ttft_ok_for_down = ttft_slo_s <= 0 or worst_ttft < 0.5 * ttft_slo_s or not active
+        # prefill-role replicas (ISSUE 18) never stream decode tokens, so
+        # their ~0 tokens/s must not read as fleet idleness: the utilization
+        # denominator counts only decode-capable replicas
+        n_prefill = sum(1 for r in reports if r.get("role") == "prefill")
+        decode_n = max(1, current - n_prefill)
         idle = (
             ttft_ok_for_down
             and queued == 0
             and tps_target > 0
-            and total_tps / max(1, current) < self.SLO_SCALEDOWN_UTIL * tps_target
+            and total_tps / decode_n < self.SLO_SCALEDOWN_UTIL * tps_target
         )
         floor = max(settings.min_containers, 1)
         ceiling = settings.max_containers or 8

@@ -18,6 +18,17 @@ Routes (docs/SERVING.md has the full contract):
   stream dies mid-generation re-fetches here and gets every token exactly
   once — the engine's per-request buffer, not the transport, is the source
   of truth.
+- ``POST /v1/prefill`` — prefill-only leg of the disaggregated flow
+  (ISSUE 18): runs the prompt through prefill, exports the KV pages as a
+  blob-plane file reference, and answers ``{"kv_ref", "first_token",
+  "n_tokens", "request_id"}``. The shipment file lands under
+  ``MODAL_TPU_BLOB_LOCAL_DIR`` (tempdir fallback) — the same local-dir
+  handoff the dispatch plane's blob threshold uses.
+- ``POST /v1/prefilled`` — decode-only leg: ``kv_ref`` plus the normal
+  generate fields. The engine admits the request with its prefill already
+  covered (remote pages imported at offset 0) and goes straight to decode;
+  a missing/mismatched/chaos-dropped shipment degrades to a full local
+  prefill — same tokens, slower TTFT.
 - ``GET /v1/stats`` — engine stats; ``GET /healthz`` — liveness.
 
 Chaos: ``MODAL_TPU_CHAOS_SERVING_STREAM_RESETS=N`` aborts the next N SSE
@@ -316,6 +327,122 @@ def serving_asgi_app(
                 logger.warning(f"serving: chaos stream reset for {req.id} (buffer intact)")
                 raise ConnectionResetError(f"chaos serving stream reset ({req.id})")
 
+    def _ship_dir() -> str:
+        import tempfile
+
+        d = os.environ.get("MODAL_TPU_BLOB_LOCAL_DIR", "") or tempfile.gettempdir()
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    async def handle_prefill(scope, receive, send) -> None:
+        """Prefill leg: generate exactly the first token, export the prompt's
+        KV pages, park them as a serialized file reference. The heavy bytes
+        never transit the HTTP response — only the path does."""
+        from .. import serialization
+
+        try:
+            raw = await read_body(receive)
+            body = json.loads(raw) if raw else {}
+            if not isinstance(body, dict):
+                raise ValueError("JSON body must be an object")
+            prompt = _decode_prompt(body, vocab_size)
+            request_id = str(body.get("request_id", ""))
+            sampling = _parse_sampling(body, defaults)
+        except (ValueError, json.JSONDecodeError) as exc:
+            await send_json(send, 400, {"error": str(exc)})
+            return
+        try:
+            req = engine.prefill_export(prompt, request_id=request_id, **sampling)
+        except EngineStopped as exc:
+            await send_json(send, 429 if "queue full" in str(exc) else 503, {"error": str(exc)})
+            return
+        except ValueError as exc:
+            await send_json(send, 400, {"error": str(exc)})
+            return
+        await wait_done(req)
+        if req.error or req.shipment is None:
+            await send_json(send, 500, {"error": req.error or "prefill produced no shipment"})
+            return
+        path = os.path.join(_ship_dir(), f"kvship-{req.id}.bin")
+
+        def _write(ship: dict) -> None:
+            with open(path, "wb") as f:
+                f.write(serialization.serialize(ship))
+
+        await asyncio.to_thread(_write, req.shipment)
+        req.shipment = None  # the file is the handoff; drop the host copy
+        await send_json(
+            send,
+            200,
+            {
+                "kv_ref": path,
+                "first_token": req.tokens[0] if req.tokens else None,
+                "n_tokens": len(prompt),
+                "request_id": req.id,
+            },
+        )
+
+    async def handle_prefilled(scope, receive, send) -> None:
+        """Decode leg: land a shipped prefill and stream like /v1/generate.
+        Every shipment defect is a degrade (engine re-prefills locally), not
+        an error — the router's fallback path depends on that."""
+        from .. import serialization
+
+        try:
+            raw = await read_body(receive)
+            body = json.loads(raw) if raw else {}
+            if not isinstance(body, dict):
+                raise ValueError("JSON body must be an object")
+            prompt = _decode_prompt(body, vocab_size)
+            kv_ref = str(body.get("kv_ref", ""))
+            if not kv_ref:
+                raise ValueError("'kv_ref' is required (path from /v1/prefill)")
+            max_new = int(body.get("max_new_tokens", 64))
+            if not 1 <= max_new <= max_new_tokens_limit:
+                raise ValueError(f"max_new_tokens must be in [1, {max_new_tokens_limit}]")
+            stream = bool(body.get("stream", False))
+            eos = body.get("eos_token_id")
+            request_id = str(body.get("request_id", ""))
+            sampling = _parse_sampling(body, defaults)
+        except (ValueError, json.JSONDecodeError) as exc:
+            await send_json(send, 400, {"error": str(exc)})
+            return
+        def _read() -> dict:
+            with open(kv_ref, "rb") as f:
+                return serialization.deserialize(f.read())
+
+        shipment = None
+        try:
+            shipment = await asyncio.to_thread(_read)
+        except Exception as exc:  # noqa: BLE001 — degrade to local prefill
+            logger.warning(f"serving: kv_ref {kv_ref!r} unreadable ({exc}); local prefill")
+        kwargs = dict(
+            request_id=request_id,
+            eos_token_id=int(eos) if eos is not None else None,
+            **sampling,
+        )
+        try:
+            try:
+                req = engine.submit_prefilled(prompt, shipment, max_new, **kwargs)
+            except ValueError as exc:
+                if shipment is None or "shipment" not in str(exc):
+                    raise
+                # mismatched geometry/prompt: the shipment is garbage but the
+                # request isn't — re-submit for a full local prefill
+                logger.warning(f"serving: shipment rejected ({exc}); local prefill")
+                req = engine.submit_prefilled(prompt, None, max_new, **kwargs)
+        except EngineStopped as exc:
+            await send_json(send, 429 if "queue full" in str(exc) else 503, {"error": str(exc)})
+            return
+        except ValueError as exc:
+            await send_json(send, 400, {"error": str(exc)})
+            return
+        if not stream:
+            await wait_done(req)
+            await send_json(send, 500 if req.error else 200, _result_payload(req, vocab_size))
+            return
+        await stream_sse(send, req)
+
     async def handle_result(scope, receive, send, request_id: str) -> None:
         await read_body(receive)
         req = engine.get(request_id)
@@ -344,6 +471,10 @@ def serving_asgi_app(
                 await send_json(send, 200, engine.stats())
             elif path == "/v1/generate" and method == "POST":
                 await handle_generate(scope, receive, send)
+            elif path == "/v1/prefill" and method == "POST":
+                await handle_prefill(scope, receive, send)
+            elif path == "/v1/prefilled" and method == "POST":
+                await handle_prefilled(scope, receive, send)
             elif path.startswith("/v1/result/") and method == "GET":
                 await handle_result(scope, receive, send, path[len("/v1/result/"):])
             else:

@@ -42,12 +42,15 @@ from ..observability.catalog import (
     KV_PAGES_ALLOCATED,
     KV_PAGES_COW,
     KV_PAGES_FREE,
+    KV_PAGES_SHIPPED,
+    KV_SHIP_SECONDS,
     SERVING_BATCH_OCCUPANCY,
     SERVING_PREEMPTIONS,
     SERVING_PREFIX_HITS,
     SERVING_PREFIX_MISSES,
     SERVING_QUEUE_DEPTH,
     SERVING_REQUESTS,
+    SERVING_ROLE,
     SERVING_SAMPLED_TOKENS,
     SERVING_SPEC_ACCEPT_RATIO,
     SERVING_TOKENS,
@@ -94,6 +97,61 @@ SAMPLING_ENV = "MODAL_TPU_SERVING_SAMPLING"  # 0 → greedy-only engine
 PREFIX_CACHE_ENV = "MODAL_TPU_SERVING_PREFIX_CACHE"  # 0 → no shared-prefix reuse
 SPEC_ENV = "MODAL_TPU_SERVING_SPEC"  # 0 → ignore any configured draft model
 # (the Pallas kernel knob MODAL_TPU_PAGED_KERNEL lives in models/paged_kv.py)
+
+# ISSUE 18 fleet knobs (docs/SERVING.md degradation matrix):
+# - role: what this replica does in a disaggregated fleet. "prefill" replicas
+#   serve /v1/prefill (KV-page shipments out), "decode" replicas accept
+#   /v1/prefilled admissions; unset/"both" is the PR 11 all-in-one replica —
+#   the role never *disables* an engine path, it only advertises intent to
+#   the router/autoscaler, so a mis-set role degrades to slower routing, not
+#   to refused requests.
+ROLE_ENV = "MODAL_TPU_SERVING_ROLE"  # prefill | decode | both (unset → both)
+# - overlap: run draft-propose for one half of the decode batch while the
+#   other half's target verify is still in flight. 0 → the PR 11 sequential
+#   round (byte-identical token streams either way; this is dispatch
+#   pipelining, not an algorithm change).
+SPEC_OVERLAP_ENV = "MODAL_TPU_SPEC_OVERLAP"
+# chaos (ISSUE 18): drop the next N inbound KV-page shipments at the decode
+# boundary — exactly what a prefill replica dying mid-ship looks like. The
+# decode side must fall back to a full local prefill with zero token loss.
+CHAOS_KV_SHIP_DROP_ENV = "MODAL_TPU_CHAOS_KV_SHIP_DROP"
+
+_kv_ship_chaos: dict = {}
+
+
+def _consume_kv_ship_drop() -> bool:
+    """One chaos-drop budget unit, lazily seeded from the env (same
+    budget-consume pattern as api._consume_stream_reset: tests set the env
+    then `_reset_kv_ship_chaos_for_tests()`)."""
+    budget = _kv_ship_chaos.get("budget")
+    if budget is None:
+        try:
+            budget = int(os.environ.get(CHAOS_KV_SHIP_DROP_ENV, "0") or 0)
+        except ValueError:
+            budget = 0
+        _kv_ship_chaos["budget"] = budget
+    if budget > 0:
+        _kv_ship_chaos["budget"] = budget - 1
+        return True
+    return False
+
+
+def _reset_kv_ship_chaos_for_tests() -> None:
+    _kv_ship_chaos.clear()
+
+
+def resolve_role() -> str:
+    """MODAL_TPU_SERVING_ROLE → "prefill" | "decode" | "both". Anything
+    unrecognized (including unset) is "both": a typo'd role must degrade to
+    the do-everything replica, never to a replica that refuses work."""
+    val = os.environ.get(ROLE_ENV, "").strip().lower()
+    return val if val in ("prefill", "decode") else "both"
+
+
+# the serving_role gauge encodes the role as a number (gauges carry floats
+# over the heartbeat); history._replica_rows maps it back for `modal_tpu top`
+ROLE_GAUGE_VALUES = {"both": 0, "prefill": 1, "decode": 2}
+ROLE_GAUGE_NAMES = {v: k for k, v in ROLE_GAUGE_VALUES.items()}
 
 
 def _env_on(name: str, default: str = "1") -> bool:
@@ -156,6 +214,13 @@ class GenRequest:
         self.tokens: list[int] = []
         self.done = False
         self.error: Optional[str] = None
+        # prefill/decode disaggregation (ISSUE 18): `shipment` is the
+        # export-side result (a host KV-page bundle, set before _finish);
+        # `_shipment` is an inbound remotely-prefilled bundle consumed at
+        # first admission (a later preemption re-prefills locally)
+        self.shipment: Optional[dict] = None
+        self._shipment: Optional[dict] = None
+        self._export = False
         # per-request timeline (ISSUE 11): the root span every lifecycle
         # span (admit → prefill chunks → decode marks → preempt → stream)
         # parents under; queue_from anchors the NEXT admit span (request
@@ -263,7 +328,9 @@ class _Slot:
     draft_pages: list[int] = field(default_factory=list)  # speculative: draft pool mirror
     pos: int = 0  # tokens written to the slot's pages (mirrors seq_lens)
     prefill_tokens: list[int] = field(default_factory=list)  # prompt (+ regenerated prefix)
-    prefill_done: int = 0  # tokens of prefill_tokens already written
+    prefill_done: int = 0  # tokens of prefill_tokens already written (target pool)
+    draft_prefill_done: int = 0  # draft-pool prefill progress (may lead via its own prefix hits)
+    first_emitted: bool = False  # this slot's prefill-completion token went out
     cur_token: int = 0  # token to feed the next decode step
     state: str = "prefill"  # "prefill" | "decode"
     admitted_step: int = 0
@@ -291,6 +358,7 @@ class ServingEngine:
         draft: Optional[tuple] = None,  # (draft_params, draft_cfg) → speculative decoding
         spec_k: int = 3,  # draft tokens proposed per speculative round
         prefix_cache: Optional[bool] = None,  # None = env default (on)
+        role: Optional[str] = None,  # prefill | decode | both; None = env default
     ):
         import math
 
@@ -345,14 +413,25 @@ class ServingEngine:
             self.draft_cache = PagedKVCache.create(
                 draft_cfg, max_slots, num_pages, page_size, pages_per_slot
             )
-        # shared-prefix KV reuse: content-keyed lookup + CoW pages. Off in
-        # speculative mode: the draft pool holds no shared prefixes, so the
-        # draft would desync from a prefix-skipping target prefill
-        # (documented limit, docs/SERVING.md).
+        # shared-prefix KV reuse: content-keyed lookup + CoW pages. ISSUE 18
+        # lifts the old spec-mode exclusion: the draft pool now runs its OWN
+        # prefix cache in full-page-only mode (no partial-page sharing ⇒ no
+        # CoW machinery needed on a pool that has none), so a prefix-skipping
+        # target prefill can no longer desync from the draft.
         want_prefix = _env_on(PREFIX_CACHE_ENV) if prefix_cache is None else bool(prefix_cache)
         self.prefix_cache: Optional[PrefixCache] = (
-            PrefixCache(self.allocator) if (want_prefix and self.spec_k == 0) else None
+            PrefixCache(self.allocator) if want_prefix else None
         )
+        self.draft_prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.draft_allocator) if (want_prefix and self.spec_k) else None
+        )
+        # ISSUE 18 fleet mode: advertised role + overlapped spec rounds
+        self.role = role if role in ("prefill", "decode", "both") else resolve_role()
+        SERVING_ROLE.set(float(ROLE_GAUGE_VALUES[self.role]))
+        self.spec_overlap = _env_on(SPEC_OVERLAP_ENV)
+        self.kv_pages_shipped = 0
+        self.kv_ship_drops = 0
+        self.remote_prefills = 0
         self.slots: list[Optional[_Slot]] = [None] * max_slots
         self.waiting: deque[GenRequest] = deque()
         self.requests: dict[str, GenRequest] = {}  # id -> request (bounded retention)
@@ -404,11 +483,13 @@ class ServingEngine:
         for req in leftovers:
             req._finish(error="engine stopped")
             SERVING_REQUESTS.inc(outcome="stopped")
-        # release the prefix cache's page holds (its entries are the one
+        # release the prefix caches' page holds (their entries are the one
         # thing that outlives completed requests by design)
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
             self._sync_page_gauges()
+        if self.draft_prefix_cache is not None:
+            self.draft_prefix_cache.clear()
 
     # -- submission ---------------------------------------------------------
 
@@ -423,6 +504,8 @@ class ServingEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int = 0,
+        shipment: Optional[dict] = None,  # remotely-prefilled KV bundle (submit_prefilled)
+        export: bool = False,  # prefill-only: ship KV pages out (prefill_export)
     ) -> GenRequest:
         """Thread-safe admission into the running loop. Returns immediately;
         consume via the returned request's wait_new/result.
@@ -470,6 +553,8 @@ class ServingEngine:
             trace_context=tracing.current_context(),
             temperature=temperature, top_k=top_k, top_p=top_p, seed=int(seed),
         )
+        req._export = bool(export)
+        req._shipment = shipment
         if _spans_enabled():
             # per-request timeline root (ISSUE 11): parents under the
             # ambient context when one exists (a .remote() chain), else
@@ -491,6 +576,82 @@ class ServingEngine:
             SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
             self._work.notify_all()
         return req
+
+    def prefill_export(
+        self,
+        prompt: list[int],
+        *,
+        request_id: str = "",
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> GenRequest:
+        """Prefill-role entry point (ISSUE 18 disaggregation): run ONLY the
+        prompt's prefill, emit the single continuation token, and attach the
+        finished KV pages to `req.shipment` as a host-side bundle —
+        {prompt, first_token, n_tokens, k, v} — ready to ride a blob-plane
+        frame to a decode replica. The request completes with exactly one
+        token; its slot (and pages, once the prefix-cache entry is the only
+        holder) free immediately, so a prefill replica's pool turns over at
+        admission rate, not at generation length."""
+        return self.submit(
+            prompt, max_new_tokens=1, request_id=request_id,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            export=True,
+        )
+
+    def submit_prefilled(
+        self,
+        prompt: list[int],
+        shipment: Optional[dict],
+        max_new_tokens: int = 64,
+        *,
+        request_id: str = "",
+        eos_token_id: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> GenRequest:
+        """Decode-role admission of a remotely-prefilled prompt: the
+        shipment's pages are imported at covered offset (no local prefill),
+        its first token is this replica's first emission, and the imported
+        prompt is published into the local prefix cache for followers.
+
+        A shipment that doesn't match this engine's geometry — or one the
+        chaos knob MODAL_TPU_CHAOS_KV_SHIP_DROP eats — degrades to a plain
+        `submit` (full local prefill): token streams are identical either
+        way, only TTFT pays (docs/SERVING.md degradation matrix)."""
+        if shipment is None:
+            # no bundle at all (unreadable kv_ref upstream): plain admission
+            return self.submit(
+                prompt, max_new_tokens, request_id=request_id, eos_token_id=eos_token_id,
+                temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            )
+        page = self.page_size
+        n_ship = -(-len(prompt) // page) if prompt else 0
+        ok = bool(prompt) and list(shipment.get("prompt", ())) == list(prompt)
+        k_arr, v_arr = shipment.get("k"), shipment.get("v")
+        if ok:
+            ok = (
+                k_arr is not None
+                and v_arr is not None
+                and getattr(k_arr, "shape", None) == getattr(v_arr, "shape", None)
+                and k_arr.shape[:3] == (self.cfg.n_layers, n_ship, page)
+            )
+        if not ok:
+            raise ValueError("shipment does not match this prompt/engine geometry")
+        if _consume_kv_ship_drop():
+            # chaos: the prefill replica "died mid-ship" — import nothing,
+            # prefill locally, lose no tokens
+            self.kv_ship_drops += 1
+            shipment = None
+        return self.submit(
+            prompt, max_new_tokens, request_id=request_id, eos_token_id=eos_token_id,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            shipment=shipment,
+        )
 
     def get(self, request_id: str) -> Optional[GenRequest]:
         with self._lock:
@@ -556,6 +717,18 @@ class ServingEngine:
             self._sync_page_gauges()
         return released
 
+    def _evict_draft_prefix_for(self, shortage: int) -> int:
+        """Draft-pool twin of `_evict_prefix_for` (the KV page gauges track
+        the target pool only, so no gauge sync here)."""
+        released = 0
+        while (
+            released < shortage
+            and self.draft_prefix_cache is not None
+            and len(self.draft_prefix_cache)
+        ):
+            released += self.draft_prefix_cache.evict_lru()
+        return released
+
     def _admit(self) -> None:
         """Move waiting requests into free slots while pages allow. FIFO —
         skipping the head for a smaller request would starve long prompts.
@@ -579,28 +752,46 @@ class ServingEngine:
                 req = self.waiting[0]
                 prefill_tokens = req.prompt + req.tokens  # preempted: regen prefix too
                 need = self.allocator.pages_for(len(prefill_tokens) + 1)
+                shipment = req._shipment
                 shared_pages: list[int] = []
                 covered = 0
                 hit_entry = None
-                if self.prefix_cache is not None:
+                if shipment is None and self.prefix_cache is not None:
                     hit = self.prefix_cache.lookup(prefill_tokens)
                     if hit is not None:
                         shared_pages, covered, hit_entry = hit
                 fresh_need = max(0, need - len(shared_pages))
-                draft_need = need if self.spec_k else 0
+                # draft mirror: full-page-only prefix reuse from the draft
+                # pool's own cache (no partial pages ⇒ no CoW needed there)
+                draft_shared: list[int] = []
+                draft_covered = 0
+                draft_entry = None
+                if self.draft_prefix_cache is not None:
+                    dhit = self.draft_prefix_cache.lookup(prefill_tokens, allow_partial=False)
+                    if dhit is not None:
+                        draft_shared, draft_covered, draft_entry = dhit
+                draft_need = max(0, need - len(draft_shared)) if self.spec_k else 0
                 if not self.allocator.can_alloc(fresh_need):
                     self._evict_prefix_for(fresh_need - self.allocator.free_pages)
+                if self.spec_k and not self.draft_allocator.can_alloc(draft_need):
+                    self._evict_draft_prefix_for(draft_need - self.draft_allocator.free_pages)
                 if not self.allocator.can_alloc(fresh_need) or (
-                    draft_need and not self.draft_allocator.can_alloc(draft_need)
+                    self.spec_k and not self.draft_allocator.can_alloc(draft_need)
                 ):
                     if shared_pages:
                         self.allocator.free(shared_pages)  # drop the lookup's refs
+                    if draft_shared:
+                        self.draft_allocator.free(draft_shared)
                     return  # pool dry; decode-side preemption or completions will free
                 self.waiting.popleft()
                 SERVING_QUEUE_DEPTH.set(float(len(self.waiting)))
                 try:
                     pages = shared_pages + self.allocator.alloc(fresh_need)
-                    draft_pages = self.draft_allocator.alloc(draft_need) if draft_need else []
+                    draft_pages = (
+                        draft_shared + self.draft_allocator.alloc(draft_need)
+                        if self.spec_k
+                        else []
+                    )
                 except PagePoolExhausted:  # pragma: no cover — guarded above
                     self.waiting.appendleft(req)
                     return
@@ -610,19 +801,24 @@ class ServingEngine:
                     draft_pages=draft_pages,
                     prefill_tokens=prefill_tokens,
                     prefill_done=covered,
+                    draft_prefill_done=draft_covered,
                     pos=covered,
                     admitted_step=self.step_count,
                 )
                 self.slots[free_idx] = slot
-                if self.prefix_cache is not None:
+                if self.prefix_cache is not None and shipment is None:
                     # counted at admission commit, not per dry-pool retry —
                     # cache stats, LRU clock, and Prometheus stay consistent
+                    # (a remote-prefill import is neither hit nor miss: the
+                    # prefix work happened on another replica)
                     if hit_entry is not None and covered:
                         self.prefix_cache.commit_use(hit_entry)
                         SERVING_PREFIX_HITS.inc()
                     else:
                         self.prefix_cache.note_miss()
                         SERVING_PREFIX_MISSES.inc()
+                if draft_entry is not None and draft_covered:
+                    self.draft_prefix_cache.commit_use(draft_entry)
             # pad the row to pages_per_slot: assign_pages keys an executable
             # on the page-array SHAPE, so padded admissions all share one
             # compile (growth adds single pages — one more shape, total two)
@@ -647,9 +843,71 @@ class ServingEngine:
                         "slot": free_idx,
                         "pages": len(pages),
                         "prefix_tokens": covered,
+                        "draft_prefix_tokens": draft_covered,
+                        "remote_prefill": shipment is not None,
                         "requeue": req.preemptions > 0,
                     },
                 )
+            if shipment is not None:
+                self._import_shipment(free_idx, slot, shipment)
+
+    def _import_shipment(self, idx: int, slot: _Slot, shipment: dict) -> None:
+        """Land a remotely-prefilled KV bundle in the slot's fresh pages:
+        import the page payload, set the slot's length to the covered
+        prompt, publish the prompt into the local prefix cache (the imported
+        pages serve followers exactly like locally-prefilled ones), and emit
+        the shipped continuation token as this replica's first emission. In
+        spec mode the target side is done but the draft still prefills
+        locally — the slot stays in "prefill" until the mirror catches up."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.paged_kv import import_pages, set_seq_lens
+
+        req = slot.request
+        req._shipment = None  # consumed: a later preemption re-prefills locally
+        n_ship = -(-len(req.prompt) // self.page_size)
+        t0 = time.time()
+        self.cache = import_pages(self.cache, slot.pages[:n_ship], shipment)
+        lens = np.zeros((self.max_slots,), np.int32)
+        upd = np.zeros((self.max_slots,), bool)
+        lens[idx] = len(req.prompt)
+        upd[idx] = True
+        self.cache = set_seq_lens(self.cache, jnp.asarray(lens), jnp.asarray(upd))
+        slot.prefill_done = len(slot.prefill_tokens)
+        slot.pos = len(req.prompt)
+        self.remote_prefills += 1
+        if req.trace_context is not None and _spans_enabled():
+            tracing.record_span(
+                "serving.kv_ship",
+                start=t0,
+                end=time.time(),
+                parent=req.trace_context,
+                attrs={"request_id": req.id, "side": "import", "pages": n_ship},
+            )
+        if self.prefix_cache is not None and len(req.prompt) >= self.page_size:
+            self.prefix_cache.insert(req.prompt, slot.pages)
+            self._sync_page_gauges()
+        self._emit_first(idx, slot, int(shipment["first_token"]))
+
+    def _emit_first(self, idx: int, slot: _Slot, tok: int) -> None:
+        """The slot's prefill-completion emission (shared by local prefill
+        completion and shipment import): first decode feed, TTFT mark, and —
+        when the draft mirror (if any) is also resident — the prefill →
+        decode state flip."""
+        req = slot.request
+        slot.cur_token = tok
+        slot.first_emitted = True
+        if not self.spec_k or slot.draft_prefill_done >= len(slot.prefill_tokens):
+            slot.state = "decode"
+        slot.last_mark_t = time.time()
+        slot.tokens_at_mark = len(req.tokens) + 1  # the token appended below
+        req._append(tok)
+        if len(req.tokens) == 1:
+            self._note_ttft(req)
+        self.tokens_generated += 1
+        self._note_rate(1)
+        self._maybe_finish(idx, slot)
 
     def _cow_range(self, idx: int, slot: _Slot, start_pos: int, end_pos: int) -> bool:
         """Copy-on-write barrier: before any write to positions
@@ -685,7 +943,15 @@ class ServingEngine:
     def _prefill_one(self) -> None:
         """Advance the oldest prefilling slot by one chunk. One chunk per
         loop iteration: decode steps interleave, so in-flight token cadence
-        survives long-prompt arrivals."""
+        survives long-prompt arrivals.
+
+        Target and draft pools progress INDEPENDENTLY (ISSUE 18): each has
+        its own prefix cache, so their covered offsets differ — the target
+        may start mid-page (partial-page extension + CoW) while the draft
+        starts at its last full-page boundary, and a remote-prefill import
+        leaves the target fully covered while the draft still prefills
+        locally. The first token goes out the moment the TARGET completes;
+        decode waits for both."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -699,61 +965,83 @@ class ServingEngine:
             return
         idx, slot = min(candidates, key=lambda t: t[1].admitted_step)
         req = slot.request
-        chunk = slot.prefill_tokens[slot.prefill_done : slot.prefill_done + self.prefill_chunk]
-        if not self._cow_range(idx, slot, slot.prefill_done, slot.prefill_done + len(chunk)):
-            # CoW starved for a page: free capacity the hard way and retry
-            # next iteration. The needy slot itself is a valid victim — if
-            # it alone holds the pool, preempting it (requeue, pages freed)
-            # is the only move that ever unsticks the loop
-            self._preempt_youngest(exclude=())
-            return
-        bucket = prefill_bucket(len(chunk), self.max_context)
-        padded = np.zeros((bucket,), np.int32)
-        padded[: len(chunk)] = chunk
+        total = len(slot.prefill_tokens)
+        target_done_now = False
+        logits = None
+        next_tok = None
         t0 = time.time()
-        logits, next_tok, self.cache = paged_prefill(
-            self.params,
-            self.cfg,
-            jnp.asarray(padded),
-            jnp.int32(len(chunk)),
-            self.cache,
-            jnp.int32(idx),
-            jnp.int32(slot.prefill_done),
-        )
-        if self.spec_k:
-            # the draft mirrors every prefill chunk (it shares no prefixes,
-            # so its cache must hold the full prompt before proposing)
-            _dl, _dn, self.draft_cache = paged_prefill(
-                self.draft_params,
-                self.draft_cfg,
+        if slot.prefill_done < total:
+            chunk = slot.prefill_tokens[slot.prefill_done : slot.prefill_done + self.prefill_chunk]
+            if not self._cow_range(idx, slot, slot.prefill_done, slot.prefill_done + len(chunk)):
+                # CoW starved for a page: free capacity the hard way and retry
+                # next iteration. The needy slot itself is a valid victim — if
+                # it alone holds the pool, preempting it (requeue, pages freed)
+                # is the only move that ever unsticks the loop
+                self._preempt_youngest(exclude=())
+                return
+            bucket = prefill_bucket(len(chunk), self.max_context)
+            padded = np.zeros((bucket,), np.int32)
+            padded[: len(chunk)] = chunk
+            logits, next_tok, self.cache = paged_prefill(
+                self.params,
+                self.cfg,
                 jnp.asarray(padded),
                 jnp.int32(len(chunk)),
-                self.draft_cache,
+                self.cache,
                 jnp.int32(idx),
                 jnp.int32(slot.prefill_done),
             )
-        if req.trace_context is not None and _spans_enabled():
-            tracing.record_span(
-                "serving.prefill_chunk",
-                start=t0,
-                end=time.time(),
-                parent=req.trace_context,
-                attrs={
-                    "request_id": req.id,
-                    "chunk_tokens": len(chunk),
-                    "offset": slot.prefill_done,
-                    "bucket": bucket,
-                },
+            if req.trace_context is not None and _spans_enabled():
+                tracing.record_span(
+                    "serving.prefill_chunk",
+                    start=t0,
+                    end=time.time(),
+                    parent=req.trace_context,
+                    attrs={
+                        "request_id": req.id,
+                        "chunk_tokens": len(chunk),
+                        "offset": slot.prefill_done,
+                        "bucket": bucket,
+                    },
+                )
+            slot.prefill_done += len(chunk)
+            slot.pos = slot.prefill_done
+            target_done_now = slot.prefill_done >= total
+        if self.spec_k and slot.draft_prefill_done < total:
+            # the draft mirror advances its own chunk from its own covered
+            # offset; draft KV content is chunk-split-independent, so the
+            # two pools never desync on values, only on progress
+            dchunk = slot.prefill_tokens[
+                slot.draft_prefill_done : slot.draft_prefill_done + self.prefill_chunk
+            ]
+            dbucket = prefill_bucket(len(dchunk), self.max_context)
+            dpadded = np.zeros((dbucket,), np.int32)
+            dpadded[: len(dchunk)] = dchunk
+            _dl, _dn, self.draft_cache = paged_prefill(
+                self.draft_params,
+                self.draft_cfg,
+                jnp.asarray(dpadded),
+                jnp.int32(len(dchunk)),
+                self.draft_cache,
+                jnp.int32(idx),
+                jnp.int32(slot.draft_prefill_done),
             )
-        slot.prefill_done += len(chunk)
-        slot.pos = slot.prefill_done
-        if slot.prefill_done >= len(slot.prefill_tokens):
+            slot.draft_prefill_done += len(dchunk)
+            if slot.draft_prefill_done >= total:
+                if self.draft_prefix_cache is not None and len(req.prompt) >= self.page_size:
+                    # publish the draft's full-page prompt prefix (partial
+                    # last page stays private: the draft pool has no CoW)
+                    self.draft_prefix_cache.insert(
+                        req.prompt, slot.draft_pages, full_pages_only=True
+                    )
+                if slot.first_emitted and slot.state == "prefill":
+                    slot.state = "decode"  # target finished earlier (import)
+        if target_done_now:
             # prefill complete: the model's continuation after the whole
             # prefix is a NEW token — for a fresh request the first one
             # (TTFT); for a preempted-and-readmitted one the next one
             # (already-emitted tokens re-entered via prefill_tokens and are
             # never re-appended — the continuation after them is new)
-            slot.state = "decode"
             if self.prefix_cache is not None and len(req.prompt) >= self.page_size:
                 # the prompt's KV is now resident — publish it for followers
                 # (entry refs the pages, so they outlive this request; dedup
@@ -777,7 +1065,8 @@ class ServingEngine:
                 next_tok = int(tok_arr[0])
                 self.sampled_tokens += 1
                 SERVING_SAMPLED_TOKENS.inc()
-            slot.cur_token = int(next_tok)
+            if req._export:
+                self._export_shipment(slot, int(next_tok))
             if req.trace_context is not None:
                 tracing.record_span(
                     "serving.prefill",
@@ -786,14 +1075,38 @@ class ServingEngine:
                     parent=req.trace_context,
                     attrs={"request_id": req.id, "prompt_tokens": len(slot.prefill_tokens)},
                 )
-            slot.last_mark_t = time.time()
-            slot.tokens_at_mark = len(req.tokens) + 1  # the token appended below
-            req._append(int(next_tok))
-            if len(req.tokens) == 1:
-                self._note_ttft(req)
-            self.tokens_generated += 1
-            self._note_rate(1)
-            self._maybe_finish(idx, slot)
+            self._emit_first(idx, slot, int(next_tok))
+
+    def _export_shipment(self, slot: _Slot, first_token: int) -> None:
+        """Pull the slot's prompt-covering pages off the device and attach
+        them to the request as a shipment bundle (prefill_export path). Runs
+        BEFORE the emission below can finish/free the slot — the pages must
+        still be live to read."""
+        from ..models.paged_kv import export_pages
+
+        req = slot.request
+        n_ship = -(-len(req.prompt) // self.page_size)
+        t0 = time.time()
+        data = export_pages(self.cache, slot.pages[:n_ship])
+        dt = time.time() - t0
+        req.shipment = {
+            "prompt": list(req.prompt),
+            "first_token": int(first_token),
+            "n_tokens": len(req.prompt),
+            "k": data["k"],
+            "v": data["v"],
+        }
+        self.kv_pages_shipped += n_ship
+        KV_PAGES_SHIPPED.inc(n_ship)
+        KV_SHIP_SECONDS.observe(dt)
+        if req.trace_context is not None and _spans_enabled():
+            tracing.record_span(
+                "serving.kv_ship",
+                start=t0,
+                end=t0 + dt,
+                parent=req.trace_context,
+                attrs={"request_id": req.id, "side": "export", "pages": n_ship},
+            )
 
     def _note_ttft(self, req: GenRequest) -> None:
         ttft = req.first_token_at - req.created_at
@@ -844,6 +1157,10 @@ class ServingEngine:
             if short > 0:
                 self._evict_prefix_for(short)
                 short = sum(n for _i, _s, n in needy) - self.allocator.free_pages
+            if self.spec_k:
+                d_short = sum(n for _i, _s, n in needy) - self.draft_allocator.free_pages
+                if d_short > 0:
+                    self._evict_draft_prefix_for(d_short)
             if short > 0 or (
                 self.spec_k
                 and sum(n for _i, _s, n in needy) > self.draft_allocator.free_pages
@@ -1048,13 +1365,12 @@ class ServingEngine:
         only decides how many land per round. At temperature 0 that chain is
         the target argmax chain; at temperature>0 it is the same
         fold_in(seed, index)-keyed chain the non-speculative path samples.
-        Acceptance rate is a throughput knob, never a correctness one."""
-        import jax.numpy as jnp
-        import numpy as np
+        Acceptance rate is a throughput knob, never a correctness one.
 
-        from ..models.paged_kv import paged_decode_step, paged_verify_step, set_seq_lens
-        from ..models.sampling import sample_step
-
+        With MODAL_TPU_SPEC_OVERLAP on (default) and ≥2 decoding slots, the
+        round is pipelined: `_spec_dispatch` enqueues a slot-group's whole
+        device program without syncing, so group B's draft chain overlaps
+        group A's verify — continuous batching for the verify stage."""
         if not self._grow_pages():
             return
         with self._lock:
@@ -1063,22 +1379,82 @@ class ServingEngine:
             ]
         if not decoding:
             return
+        k = self.spec_k
+        t0 = time.time()
+        # ISSUE 18 overlap: split the batch in two and enqueue BOTH groups'
+        # device work (draft chain + verify + target sampling — all async
+        # dispatch, no host sync) before forcing either group's results.
+        # Group B's draft steps run while group A's verify is in flight.
+        # Per-row ops are batch-composition-independent, and seq_lens rolls
+        # are masked per group, so token streams are byte-identical to the
+        # sequential round (test-pinned).
+        self.step_count += 1
+        SERVING_BATCH_OCCUPANCY.observe(float(len(decoding)))
+        groups = [decoding]
+        if self.spec_overlap and len(decoding) >= 2:
+            mid = (len(decoding) + 1) // 2
+            groups = [decoding[:mid], decoding[mid:]]
+        pendings = [self._spec_dispatch(g) for g in groups]
+        totals = [
+            self._spec_accept(g, p, batch=len(decoding)) for g, p in zip(groups, pendings)
+        ]
+        total_emitted = sum(t[0] for t in totals)
+        total_accepted = sum(t[1] for t in totals)
+        n_sampled = sum(t[2] for t in totals)
+
+        self.spec_rounds += 1
+        self._spec_window.append((total_accepted, k * len(decoding)))
+        acc = sum(a for a, _p in self._spec_window)
+        prop_total = max(1, sum(p for _a, p in self._spec_window))
+        SERVING_SPEC_ACCEPT_RATIO.set(acc / prop_total)
+        if n_sampled:
+            self.sampled_tokens += n_sampled
+            SERVING_SAMPLED_TOKENS.inc(n_sampled)
+        if _spans_enabled():
+            rep = min(decoding, key=lambda t: t[1].admitted_step)[1].request
+            if rep.trace_context is not None:
+                tracing.record_span(
+                    "serving.spec_verify",
+                    start=t0,
+                    end=time.time(),
+                    parent=rep.trace_context,
+                    attrs={
+                        "proposed": k * len(decoding),
+                        "accepted": total_accepted,
+                        "batch": len(decoding),
+                        "groups": len(groups),
+                    },
+                )
+        self.tokens_generated += total_emitted
+        self._note_rate(total_emitted)
+
+    def _spec_dispatch(self, group: list) -> tuple:
+        """Enqueue one group's speculative round — k draft decode steps (the
+        proposals stay ON DEVICE between steps), the extra draft feed, the
+        target verify, and the target-chain sampling — without a single host
+        sync. Returns (proposals_dev [slots,k], targets_dev) still in
+        flight; `_spec_accept` forces them."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.paged_kv import paged_decode_step, paged_verify_step
+        from ..models.sampling import sample_step
+
         k, k1 = self.spec_k, self.spec_k + 1
         cur = np.zeros((self.max_slots,), np.int32)
         active = np.zeros((self.max_slots,), bool)
-        for i, s in decoding:
+        for i, s in group:
             cur[i] = s.cur_token
             active[i] = True
         active_j = jnp.asarray(active)
-        seeds, indices, temps, top_ks, top_ps = self._sampling_arrays(decoding, np)
+        seeds, indices, temps, top_ks, top_ps = self._sampling_arrays(group, np)
         seeds_j, temps_j = jnp.asarray(seeds), jnp.asarray(temps)
         top_ks_j, top_ps_j = jnp.asarray(top_ks), jnp.asarray(top_ps)
 
-        t0 = time.time()
         # 1) draft chain: propose k tokens with the SAME (seed, index) keys
         # the target will sample with — a good draft then agrees often even
         # at temperature > 0 (identical gumbel noise, similar logits)
-        proposals = np.zeros((self.max_slots, k), np.int32)
+        props = []
         feed = jnp.asarray(cur)
         for j in range(k):
             dlogits, _g, self.draft_cache = paged_decode_step(
@@ -1088,35 +1464,47 @@ class ServingEngine:
             prop = sample_step(
                 dlogits, seeds_j, jnp.asarray(indices + j), temps_j, top_ks_j, top_ps_j
             )
-            proposals[:, j] = np.asarray(prop)
+            props.append(prop)
             feed = prop
+        # extra feed: write the last proposal's KV so a fully-accepted round
+        # leaves the draft cache complete
         _dl, _dg, self.draft_cache = paged_decode_step(
             self.draft_params, self.draft_cfg, feed, self.draft_cache, active_j, self.attn_impl
         )
 
         # 2) target verifies [cur, d_1..d_k] in one fixed-shape step
-        fed = np.concatenate([cur[:, None], proposals], axis=1)  # [slots, k1]
-        vlogits, self.cache = paged_verify_step(
-            self.params, self.cfg, jnp.asarray(fed), self.cache, active_j
-        )
+        proposals_dev = jnp.stack(props, axis=1)  # [slots, k]
+        fed = jnp.concatenate([jnp.asarray(cur)[:, None], proposals_dev], axis=1)
+        vlogits, self.cache = paged_verify_step(self.params, self.cfg, fed, self.cache, active_j)
 
         # 3) the target's own chain at every verified position
         flat = vlogits.reshape(self.max_slots * k1, vlogits.shape[-1])
         idx_f = (indices[:, None] + np.arange(k1, dtype=np.int32)[None, :]).reshape(-1)
-        targets = np.asarray(
-            sample_step(
-                flat,
-                jnp.asarray(np.repeat(seeds, k1)),
-                jnp.asarray(idx_f.astype(np.int32)),
-                jnp.asarray(np.repeat(temps, k1)),
-                jnp.asarray(np.repeat(top_ks, k1)),
-                jnp.asarray(np.repeat(top_ps, k1)),
-            )
-        ).reshape(self.max_slots, k1)
+        targets_dev = sample_step(
+            flat,
+            jnp.asarray(np.repeat(seeds, k1)),
+            jnp.asarray(idx_f.astype(np.int32)),
+            jnp.asarray(np.repeat(temps, k1)),
+            jnp.asarray(np.repeat(top_ks, k1)),
+            jnp.asarray(np.repeat(top_ps, k1)),
+        )
+        return proposals_dev, targets_dev
 
-        # 4) host acceptance + emission
-        self.step_count += 1
-        SERVING_BATCH_OCCUPANCY.observe(float(len(decoding)))
+    def _spec_accept(self, group: list, pending: tuple, batch: int) -> tuple[int, int, int]:
+        """Host side of a group's round: force the sync, walk acceptance,
+        emit tokens, roll BOTH pools' seq_lens for this group's rows only
+        (masked update — the other group's in-flight verify reads its own
+        rows untouched), then release finished slots. Returns
+        (emitted, accepted, sampled)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.paged_kv import set_seq_lens
+
+        k, k1 = self.spec_k, self.spec_k + 1
+        proposals_dev, targets_dev = pending
+        proposals = np.asarray(proposals_dev)  # [slots, k] — THE host sync
+        targets = np.asarray(targets_dev).reshape(self.max_slots, k1)
         spans_on = _spans_enabled()
         mark_every = _span_mark_tokens()
         new_lens = np.zeros((self.max_slots,), np.int32)
@@ -1124,7 +1512,7 @@ class ServingEngine:
         total_emitted = 0
         total_accepted = 0
         n_sampled = 0
-        for i, s in decoding:
+        for i, s in group:
             req = s.request
             emitted = 0
             for j in range(k1):
@@ -1154,7 +1542,7 @@ class ServingEngine:
                         attrs={
                             "request_id": req.id,
                             "tokens": len(req.tokens),
-                            "batch_occupancy": len(decoding),
+                            "batch_occupancy": batch,
                             "speculative": True,
                             "kv_pages_free": self.allocator.free_pages,
                             "kv_pages_allocated": self.allocator.allocated_pages,
@@ -1163,40 +1551,16 @@ class ServingEngine:
                     s.last_mark_t = now
                     s.tokens_at_mark = len(req.tokens)
 
-        # 5) roll both pools' lengths to the accepted frontier — the verify
+        # roll both pools' lengths to the accepted frontier — the verify
         # wrote k+1 positions, only pos+emitted of them are real; the draft
         # over-advanced by its k+1 feeds and rolls back to match. BEFORE any
         # slot release: release_slot zeroes the slot's length, and this roll
         # must not scribble a stale value back onto a freed slot
         self.cache = set_seq_lens(self.cache, jnp.asarray(new_lens), jnp.asarray(update))
         self.draft_cache = set_seq_lens(self.draft_cache, jnp.asarray(new_lens), jnp.asarray(update))
-        for i, s in decoding:
+        for i, s in group:
             self._maybe_finish(i, s)
-
-        self.spec_rounds += 1
-        self._spec_window.append((total_accepted, k * len(decoding)))
-        acc = sum(a for a, _p in self._spec_window)
-        prop_total = max(1, sum(p for _a, p in self._spec_window))
-        SERVING_SPEC_ACCEPT_RATIO.set(acc / prop_total)
-        if n_sampled:
-            self.sampled_tokens += n_sampled
-            SERVING_SAMPLED_TOKENS.inc(n_sampled)
-        if spans_on:
-            rep = min(decoding, key=lambda t: t[1].admitted_step)[1].request
-            if rep.trace_context is not None:
-                tracing.record_span(
-                    "serving.spec_verify",
-                    start=t0,
-                    end=time.time(),
-                    parent=rep.trace_context,
-                    attrs={
-                        "proposed": k * len(decoding),
-                        "accepted": total_accepted,
-                        "batch": len(decoding),
-                    },
-                )
-        self.tokens_generated += total_emitted
-        self._note_rate(total_emitted)
+        return total_emitted, total_accepted, n_sampled
 
     def _maybe_finish(self, idx: int, slot: _Slot) -> None:
         from ..models.paged_kv import release_slot
@@ -1218,6 +1582,18 @@ class ServingEngine:
         req._finish()
 
     # -- introspection ------------------------------------------------------
+
+    def prefix_digests(self, limit: int = 512) -> list[str]:
+        """Digests of every full-page prefix key the target prefix cache
+        currently serves, capped (content-blind: a digest identifies a
+        prefix without shipping its tokens). The fleet router folds these
+        into its prefix→replica map via /v1/stats (serving/router.py)."""
+        if self.prefix_cache is None:
+            return []
+        from .router import prefix_digest
+
+        keys = list(self.prefix_cache._index.keys())  # atomic snapshot (GIL)
+        return [prefix_digest(key) for key in keys[:limit]]
 
     def stats(self) -> dict:
         with self._lock:
@@ -1249,6 +1625,18 @@ class ServingEngine:
             "spec_k": self.spec_k,
             "spec_rounds": self.spec_rounds,
             "spec_accept_ratio": round(acc / prop, 4) if prop else None,
+            "spec_overlap": self.spec_overlap,
+            "role": self.role,
+            "remote_prefills": self.remote_prefills,
+            "kv_pages_shipped": self.kv_pages_shipped,
+            "kv_ship_drops": self.kv_ship_drops,
+            "draft_prefix_cache_entries": (
+                len(self.draft_prefix_cache) if self.draft_prefix_cache else 0
+            ),
+            "draft_prefix_cache_hits": (
+                self.draft_prefix_cache.hits if self.draft_prefix_cache else 0
+            ),
+            "prefix_digests": self.prefix_digests(),
             "tokens_per_s": SERVING_TOKENS_PER_S.value(),
             "ttft_p95_s": SERVING_TTFT_P95.value(),
         }

@@ -43,9 +43,15 @@ def llm_service(
     top_k: int = 0,
     top_p: float = 1.0,
     sampling_seed: int = 0,
-    draft_model: Optional[str] = None,  # small config → speculative decoding
+    draft_model: Optional[str] = None,  # legacy alias for draft_config
+    # ISSUE 18: a REAL smaller draft — `draft_config` names the draft's model
+    # config, `draft_weights` points at its own trained checkpoint (omitted ⇒
+    # random init from `seed`, fine for benches, useless for acceptance rate)
+    draft_config: Optional[str] = None,
+    draft_weights: Optional[str] = None,
     spec_k: int = 3,
     prefix_cache: Optional[bool] = None,  # None = env default (on)
+    role: Optional[str] = None,  # prefill|decode|both (None = env/both)
     **cls_kwargs: Any,
 ) -> Any:
     """Register a serving class on `app` and return it (an `@app.cls`
@@ -82,11 +88,16 @@ def llm_service(
 
                 params = quantize_params(params)
             draft = None
-            if draft_model:
-                # draft weights: a separate checkpoint is a future knob; the
-                # small-config draft initializes from the same seed today
-                draft_cfg = get_config(draft_model)
-                draft = (init_params(draft_cfg, jax.random.PRNGKey(seed)), draft_cfg)
+            draft_name = draft_config or draft_model
+            if draft_name:
+                draft_cfg = get_config(draft_name)
+                if draft_weights:
+                    from modal_tpu.models.weights import load_params
+
+                    draft_params = load_params(draft_weights, draft_cfg)
+                else:
+                    draft_params = init_params(draft_cfg, jax.random.PRNGKey(seed))
+                draft = (draft_params, draft_cfg)
             from modal_tpu.serving.engine import ServingEngine
 
             self.engine = ServingEngine(
@@ -100,6 +111,7 @@ def llm_service(
                 draft=draft,
                 spec_k=spec_k,
                 prefix_cache=prefix_cache,
+                role=role,
             ).start()
 
         @modal_tpu.exit()

@@ -386,25 +386,48 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    # --- phase 5: speculative decoding A/B (ISSUE 12) ---------------------
-    # Engine-level (the HTTP plane is benched above). SELF-draft (draft =
-    # target): with random-init weights no smaller config agrees with the
-    # target, so accept ratio would measure model noise, not the machinery.
-    # Self-draft pins the MECHANISM — acceptance must sit near 1.0 (every
-    # proposal is the target's own chain), and any round/rollback/KV
-    # bookkeeping bug craters it. Tokens/s is reported for both arms
-    # honestly: a same-cost draft cannot win on wall clock (spec_speedup
-    # ~0.8x here); the win arrives with a genuinely smaller draft
-    # checkpoint, which is a deployment knob (llm_service(draft_model=...)).
+    # --- phase 5: speculative decoding with a genuinely smaller draft -----
+    # Engine-level (the HTTP plane is benched above). PR 11's self-draft arm
+    # pinned the MECHANISM (acceptance ~1.0, spec_speedup ~0.8x honest: a
+    # same-cost draft cannot win on wall clock). This phase benches the
+    # DEPLOYMENT shape — llm_service(draft_config=, draft_weights=) — with a
+    # surrogate aligned pair built in-process: the draft is the 2-layer tiny
+    # model; the target is a 12x-deeper tiny whose first layers ARE the
+    # draft's and whose extra layers are residual-identity (attention `wo`
+    # and MLP `w_down` zeroed, so under pre-norm residuals both sublayers
+    # add exact zeros). Embed/final_norm/lm_head are shared, so the pair is
+    # logits-aligned (acceptance near 1.0 — the residue is the fp32
+    # verify-vs-decode executable near-tie caveat) while the target pays
+    # ~12x the draft's per-step cost. Depth matters on CPU: a shallow
+    # target's step is dispatch-overhead-bound, and the multi-token verify
+    # only amortizes that overhead (the real-hardware memory-bandwidth win)
+    # once per-layer work dominates the step. Acceptance: spec must BEAT
+    # the non-spec target (spec_speedup > 1x, bench.py SPEC_SPEEDUP_FLOOR;
+    # measured 1.25x at spec_k=4).
+    import jax.numpy as jnp
+
     from modal_tpu.serving.engine import ServingEngine
 
-    draft_params, draft_cfg = params, cfg
+    tgt_cfg = get_config("tiny", n_layers=12 * cfg.n_layers)
+    tgt_seed = init_params(tgt_cfg, jax.random.PRNGKey(3))
+    tgt_layers = {}
+    for k, leaf in tgt_seed["layers"].items():
+        tail = leaf[cfg.n_layers :]
+        if k in ("wo", "w_down"):
+            tail = jnp.zeros_like(tail)  # residual-identity: sublayer adds 0
+        tgt_layers[k] = jnp.concatenate([params["layers"][k], tail], axis=0)
+    tgt_params = {
+        "embed": params["embed"],
+        "layers": tgt_layers,
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
     spec_prompts = prompts[:16]
 
     def _engine_tokens_per_s(draft) -> tuple:
         eng = ServingEngine(
-            params, cfg, max_slots=8, num_pages=16 * 9 + 8, page_size=16,
-            prefill_chunk=64, draft=draft, spec_k=3, prefix_cache=False,
+            tgt_params, tgt_cfg, max_slots=8, num_pages=16 * 9 + 8, page_size=16,
+            prefill_chunk=64, draft=draft, spec_k=4, prefix_cache=False,
         ).start()
         try:
             warm = eng.submit(spec_prompts[0], max_new_tokens=GEN_LEN)
@@ -418,15 +441,148 @@ def main() -> None:
             eng.stop()
 
     base_eng_tps, _st = _engine_tokens_per_s(None)
-    spec_tps, spec_st = _engine_tokens_per_s((draft_params, draft_cfg))
+    spec_tps, spec_st = _engine_tokens_per_s((params, cfg))
     result["spec_tokens_per_s"] = round(spec_tps, 1)
     result["spec_baseline_tokens_per_s"] = round(base_eng_tps, 1)
     result["spec_speedup"] = round(spec_tps / max(1e-9, base_eng_tps), 2)
     result["spec_accept_ratio"] = spec_st.get("spec_accept_ratio")
     result["spec_rounds"] = spec_st.get("spec_rounds")
+    result["spec_draft_layers"] = cfg.n_layers
+    result["spec_target_layers"] = tgt_cfg.n_layers
     print(
         f"bench[serving]: speculative {spec_tps:.0f} vs {base_eng_tps:.0f} tokens/s "
-        f"({result['spec_speedup']}x), accept ratio {result['spec_accept_ratio']}",
+        f"({result['spec_speedup']}x, {cfg.n_layers}L draft / {tgt_cfg.n_layers}L target), "
+        f"accept ratio {result['spec_accept_ratio']}",
+        file=sys.stderr,
+    )
+
+    # --- phase 6: cache-aware fleet routing + disaggregation (ISSUE 18) ---
+    # Three engine replicas behind ServingRouter, hit with shared-prefix
+    # traffic (6 families x 4 requests, 224-token family prefix + 4-token
+    # suffixes). A/B on the ROUTER only (every engine keeps its prefix
+    # cache): routed followers land on the family's cache holder; the
+    # random arm (MODAL_TPU_SERVING_ROUTER=0 degradation) scatters them, so
+    # most requests pay a cold full prefill. Acceptance: routed p50 TTFT
+    # >= 2x better than random (bench.py FLEET_ROUTED_TTFT_FLOOR). A third
+    # arm runs the disaggregated path (rep0 as the prefill tier, KV pages
+    # shipped to the decode replicas via route(split_prefill=True)) and
+    # reports shipment counts — its win is decode-replica HBM/cache
+    # residency, not TTFT, so it carries no speed guard.
+    from modal_tpu.serving.router import ServingRouter
+
+    FLEET_GEN = 8
+    fam_rng = np.random.default_rng(18)
+
+    class _EngineTransport:
+        """Direct-call replica transport (the router's contract is
+        `callable(path, body) -> dict`; HTTP framing is benched in phases
+        2-4). Shipments ride an in-memory store instead of the blob plane."""
+
+        def __init__(self, name: str, engine, store: dict):
+            self.name, self.engine, self.store = name, engine, store
+
+        def __call__(self, path: str, body: dict) -> dict:
+            rid = body.get("request_id", "")
+            if path == "/v1/prefill":
+                req = self.engine.prefill_export(body["prompt"], request_id=rid)
+                req.result(timeout=300)
+                ref = f"mem://{self.name}/{rid}"
+                self.store[ref] = req.shipment
+                req.shipment = None
+                return {"kv_ref": ref, "request_id": rid}
+            if path == "/v1/prefilled":
+                ship = self.store.pop(body.get("kv_ref"), None)
+                req = self.engine.submit_prefilled(
+                    body["prompt"], ship, body.get("max_new_tokens", FLEET_GEN),
+                    request_id=rid,
+                )
+            else:
+                req = self.engine.submit(
+                    body["prompt"], body.get("max_new_tokens", FLEET_GEN),
+                    request_id=rid,
+                )
+            tokens = req.result(timeout=300)
+            return {"tokens": tokens, "ttft_s": req.ttft_s}
+
+    def _fleet_arm(enabled: bool, split: bool = False) -> tuple:
+        os.environ["MODAL_TPU_SERVING_ROUTER"] = "1" if enabled else "0"
+        engines = {
+            f"rep{i}": ServingEngine(
+                params, cfg, max_slots=4, num_pages=160, page_size=16,
+                pages_per_slot=16, prefill_chunk=64,
+                role="prefill" if (split and i == 0) else "both",
+            ).start()
+            for i in range(3)
+        }
+        store: dict = {}
+        replicas = {n: _EngineTransport(n, e, store) for n, e in engines.items()}
+        router = ServingRouter(
+            replicas, page_size=16,
+            prefill_replicas=("rep0",) if split else (),
+        )
+        families = []
+        for _ in range(6):
+            head = fam_rng.integers(0, cfg.vocab_size, size=224).tolist()
+            families.append([
+                head + fam_rng.integers(0, cfg.vocab_size, size=4).tolist()
+                for _ in range(4)
+            ])
+        try:
+            # warmup (untimed, excluded): every replica compiles the cold
+            # full-prefill buckets, the suffix hit-path, and the decode
+            # executable before the measured window
+            warm_head = fam_rng.integers(0, cfg.vocab_size, size=224).tolist()
+            for tr in replicas.values():
+                tr("/v1/generate", {"prompt": warm_head + [1, 2, 3, 4]})
+                tr("/v1/generate", {"prompt": warm_head + [5, 6, 7, 8]})
+            ttfts = []
+            for fam in families:
+                for p in fam:
+                    out = router.route(
+                        {"prompt": p, "max_new_tokens": FLEET_GEN},
+                        split_prefill=split,
+                    )
+                    if out.get("ttft_s") is not None:
+                        ttfts.append(out["ttft_s"])
+            eng_stats = {n: e.stats() for n, e in engines.items()}
+        finally:
+            os.environ.pop("MODAL_TPU_SERVING_ROUTER", None)
+            for e in engines.values():
+                e.stop()
+        return ttfts, eng_stats, router.stats()
+
+    routed_ttfts, routed_stats, routed_router = _fleet_arm(True)
+    random_ttfts, random_stats, _rr = _fleet_arm(False)
+    split_ttfts, split_stats, split_router = _fleet_arm(True, split=True)
+
+    routed_p50 = _quantile(routed_ttfts, 0.5)
+    random_p50 = _quantile(random_ttfts, 0.5)
+    result["fleet_replicas"] = 3
+    result["fleet_routed_p50_ttft_s"] = round(routed_p50, 4)
+    result["fleet_random_p50_ttft_s"] = round(random_p50, 4)
+    result["fleet_routed_vs_random_ttft"] = round(random_p50 / max(1e-9, routed_p50), 2)
+    result["fleet_routed_prefix_hits"] = sum(
+        s.get("prefix_cache_hits", 0) for s in routed_stats.values()
+    )
+    result["fleet_random_prefix_hits"] = sum(
+        s.get("prefix_cache_hits", 0) for s in random_stats.values()
+    )
+    result["fleet_routed_reasons"] = routed_router["routed"]
+    result["fleet_split_p50_ttft_s"] = round(_quantile(split_ttfts, 0.5), 4)
+    result["fleet_remote_prefills"] = sum(
+        s.get("remote_prefills", 0) for s in split_stats.values()
+    )
+    result["fleet_kv_pages_shipped"] = sum(
+        s.get("kv_pages_shipped", 0) for s in split_stats.values()
+    )
+    result["fleet_prefill_fallbacks"] = split_router["prefill_fallbacks"]
+    print(
+        f"bench[serving]: fleet routed p50 TTFT {routed_p50:.4f}s vs random "
+        f"{random_p50:.4f}s ({result['fleet_routed_vs_random_ttft']}x, reasons "
+        f"{routed_router['routed']}); split arm shipped "
+        f"{result['fleet_kv_pages_shipped']} KV pages over "
+        f"{result['fleet_remote_prefills']} remote prefills "
+        f"({result['fleet_prefill_fallbacks']} fallbacks)",
         file=sys.stderr,
     )
 
