@@ -968,6 +968,16 @@ def _control_regression_guard(ctl: dict) -> None:
             f"{fed_limit:.1f}x single-shard budget "
             f"({fed_shards} shards on {fed_cores} core(s))\n"
         )
+    # ISSUE 19 absolute bar: quorum-committed placement p50 must stay within
+    # 1.5x of the local-only plane on the same host (same-process A/B)
+    quorum_overhead = ctl.get("journal_quorum_overhead_x")
+    if quorum_overhead is not None and quorum_overhead > QUORUM_OVERHEAD_LIMIT_X:
+        regression = True
+        sys.stderr.write(
+            f"bench[control]: QUORUM OVERHEAD {quorum_overhead:.2f}x > "
+            f"{QUORUM_OVERHEAD_LIMIT_X:.1f}x local-only placement p50\n"
+        )
+    replica_takeover = ctl.get("replica_takeover_s")
     if baseline is not None:
         base_p99 = baseline.get("control_placement_p99_s")
         base_takeover = baseline.get("control_takeover_s")
@@ -994,6 +1004,17 @@ def _control_regression_guard(ctl: dict) -> None:
                 f"bench[control]: REGRESSION federation p50 {fed_p50:.4f}s "
                 f"vs baseline {base_fed:.4f}s\n"
             )
+        base_replica = baseline.get("replica_takeover_s")
+        if (
+            base_replica
+            and replica_takeover
+            and replica_takeover > base_replica * DISPATCH_REGRESSION_FACTOR
+        ):
+            regression = True
+            sys.stderr.write(
+                f"bench[control]: REGRESSION dead-disk replica takeover "
+                f"{replica_takeover:.2f}s vs baseline {base_replica:.2f}s\n"
+            )
         base_dump = baseline.get("flight_dump_s")
         if base_dump and flight_dump and flight_dump > base_dump * DISPATCH_REGRESSION_FACTOR:
             regression = True
@@ -1019,6 +1040,11 @@ def _control_regression_guard(ctl: dict) -> None:
                         "federation_overhead_x": fed_overhead,
                         "federation_shards": fed_shards,
                         "federation_cores": fed_cores,
+                        "journal_quorum_p50_s": ctl.get("journal_quorum_p50_s"),
+                        "journal_local_p50_s": ctl.get("journal_local_p50_s"),
+                        "journal_quorum_overhead_x": quorum_overhead,
+                        "replica_takeover_s": replica_takeover,
+                        "replica_takeover_mode": ctl.get("replica_takeover_mode"),
                         "flight_dump_s": flight_dump,
                         "flight_ring_bytes": ctl.get("flight_ring_bytes"),
                         "shards": ctl.get("shards"),
@@ -1244,6 +1270,9 @@ PREFIX_TTFT_SPEEDUP_FLOOR = 1.5
 # ISSUE 17: a fleet-merged /metrics/history query (concurrent 3-shard
 # fan-out + merge) must stay within this factor of one shard's direct answer
 FEDERATION_OVERHEAD_LIMIT_X = 2.0
+# ISSUE 19: quorum journal replication (MODAL_TPU_JOURNAL_REPLICAS=2) must
+# keep placement p50 within this factor of the local-only (=0) plane
+QUORUM_OVERHEAD_LIMIT_X = 1.5
 # ISSUE 18: prefix-aware routing must beat seeded-random replica placement
 # by at least this p50-TTFT factor on the shared-prefix fleet workload
 FLEET_ROUTED_TTFT_FLOOR = 2.0

@@ -79,6 +79,14 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "inject per-decode-step delay into the serving engine"),
         _k("MODAL_TPU_CHAOS_KV_SHIP_DROP", "int", "0", "docs/SERVING.md",
            "drop the next N KV-page shipments at admission (decode re-prefills locally)"),
+        _k("MODAL_TPU_CHAOS_REPL_TORN_TAIL", "int", "0", "docs/CHAOS.md",
+           "tear the record tail of the next N replicated journal batches (follower crash mid-write)"),
+        _k("MODAL_TPU_CHAOS_REPL_DISK_FULL", "int", "0", "docs/CHAOS.md",
+           "refuse the next N replicated journal appends (follower disk full)"),
+        _k("MODAL_TPU_CHAOS_REPL_ACK_DROP", "int", "0", "docs/CHAOS.md",
+           "durably append but drop the ack for the next N replicated batches (partition-during-commit)"),
+        _k("MODAL_TPU_CHAOS_REPL_LAG_MS", "float", "0", "docs/CHAOS.md",
+           "extra delay before every replicated journal append batch"),
         # -- dispatch fast path (docs/DISPATCH.md) --------------------------
         _k("MODAL_TPU_FASTPATH", "bool", "1", "docs/DISPATCH.md",
            "whole local-transport ladder (in-process/UDS) off → TCP only", gate=True),
@@ -121,6 +129,10 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "records since snapshot that trigger periodic compaction"),
         _k("MODAL_TPU_IDEMPOTENCY_MAX", "int", "8192", "docs/RECOVERY.md",
            "journal-backed RPC-dedupe seen-set capacity"),
+        _k("MODAL_TPU_JOURNAL_REPLICAS", "int", "2", "docs/RECOVERY.md",
+           "follower shards per journal writer (quorum replication); 0 → byte-identical single-writer path", gate=True),
+        _k("MODAL_TPU_JOURNAL_QUORUM_TIMEOUT", "float", "5.0", "docs/RECOVERY.md",
+           "seconds a mutating RPC waits at the quorum-commit barrier before UNAVAILABLE"),
         # -- sharded control plane (docs/CONTROL_PLANE.md) ------------------
         _k("MODAL_TPU_SHARDS", "int", "1", "docs/CONTROL_PLANE.md",
            "control-plane shard count; 1 = the monolith (no director, no routing)"),

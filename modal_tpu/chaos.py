@@ -62,7 +62,15 @@ HEARTBEAT_RPCS = frozenset({"ContainerHeartbeat", "WorkerHeartbeat"})
 # stream_reset: FunctionStreamOutputs aborts UNAVAILABLE mid-stream — the
 # client must degrade to the unary poll rung with the call completing
 # exactly-once (docs/DISPATCH.md).
-LIFECYCLE_KNOBS = frozenset({"warm_kill_handoff", "stream_reset"})
+# The repl_* knobs target journal replication followers (ISSUE 19,
+# server/replication.py): repl_torn_tail writes half of a batch's last record
+# with no newline (follower crash mid-write; the next append must repair),
+# repl_disk_full rejects the append outright (the writer retries / degrades),
+# repl_ack_drop appends durably but swallows the ack (partition-during-commit;
+# the writer resends and the follower dedupes by seq).
+LIFECYCLE_KNOBS = frozenset(
+    {"warm_kill_handoff", "stream_reset", "repl_torn_tail", "repl_disk_full", "repl_ack_drop"}
+)
 
 # HTTP blob routes are injected under pseudo-RPC names so one policy and one
 # rate table cover the gRPC and HTTP planes alike. BlockGet is the volume
@@ -124,6 +132,10 @@ class ChaosPolicy:
         self.latency_rate = latency_rate
         self.events = list(events or [])
         self.max_faults = max_faults
+        # journal-replication lag injection (ISSUE 19): extra delay before
+        # every replicated append batch — the quorum-commit path must absorb
+        # follower slowness without violating the commit rules
+        self.repl_lag_ms = 0.0
         # budgeted one-shot faults (the conftest knob surface)
         self.fail_counts: dict[str, int] = {}
         # observability
@@ -163,6 +175,12 @@ class ChaosPolicy:
         - MODAL_TPU_CHAOS_SHARD_PARTITION ("shard:outputs[:duration_s]":
           network-partition the shard from the director's health probes —
           the shard stays alive, probes fail)
+        - MODAL_TPU_CHAOS_REPL_TORN_TAIL / _REPL_DISK_FULL / _REPL_ACK_DROP
+          (int N: budgeted follower-side journal-replication faults — torn
+          record tail, refused append, durable-but-unacked append — ISSUE 19,
+          server/replication.py)
+        - MODAL_TPU_CHAOS_REPL_LAG_MS (float: extra delay before every
+          replicated append batch; stresses the quorum-commit timeout)
         """
         if os.environ.get("MODAL_TPU_CHAOS", "") not in ("1", "true", "yes"):
             return None
@@ -239,6 +257,26 @@ class ChaosPolicy:
             logger.warning("ignoring malformed MODAL_TPU_CHAOS_STREAM_RESETS")
         if stream_resets > 0:
             policy.fail_counts["stream_reset"] = stream_resets
+        # journal-replication faults (ISSUE 19, server/replication.py):
+        # budgeted follower-side faults + a flat per-batch lag injection
+        for env_name, knob in (
+            ("MODAL_TPU_CHAOS_REPL_TORN_TAIL", "repl_torn_tail"),
+            ("MODAL_TPU_CHAOS_REPL_DISK_FULL", "repl_disk_full"),
+            ("MODAL_TPU_CHAOS_REPL_ACK_DROP", "repl_ack_drop"),
+        ):
+            try:
+                budget = int(os.environ.get(env_name, "0") or 0)
+            except ValueError:
+                budget = 0
+                logger.warning(f"ignoring malformed {env_name}")
+            if budget > 0:
+                policy.fail_counts[knob] = budget
+        try:
+            policy.repl_lag_ms = max(
+                0.0, float(os.environ.get("MODAL_TPU_CHAOS_REPL_LAG_MS", "0") or 0)
+            )
+        except ValueError:
+            logger.warning("ignoring malformed MODAL_TPU_CHAOS_REPL_LAG_MS")
         return policy
 
     # -- deterministic decision engine --------------------------------------

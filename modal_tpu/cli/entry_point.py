@@ -1120,26 +1120,70 @@ def journal_status(state_dir: Optional[str], as_json: bool) -> None:
     from ..config import config as _config
     from ..server.journal import Journal
 
+    from ..server.replication import offline_stream_status, quorum_acks_needed, replicas_configured
+
     root = state_dir or _config["state_dir"]
     shards = _shard_dirs(root)
+    replicas = replicas_configured()
     if shards:
         statuses = []
         for sdir in shards:
             j = Journal(sdir)
-            statuses.append(j.status())
+            st = j.status()
             j.close()
+            # quorum replication (ISSUE 19): the replica streams this shard
+            # holds for its peer writers, read straight off disk
+            st["replica_streams"] = offline_stream_status(sdir) if replicas > 0 else []
+            statuses.append(st)
         if as_json:
-            click.echo(json.dumps({"shards": statuses}, indent=2, sort_keys=True))
+            click.echo(
+                json.dumps(
+                    {
+                        "shards": statuses,
+                        "replication": {
+                            "replicas": replicas,
+                            "quorum_acks_needed": quorum_acks_needed(replicas),
+                        },
+                    },
+                    indent=2,
+                    sort_keys=True,
+                )
+            )
             return
-        click.echo(f"sharded control plane root {root} ({len(shards)} shard journal(s))")
+        click.echo(
+            f"sharded control plane root {root} ({len(shards)} shard journal(s), "
+            f"replication {replicas} follower(s)/writer"
+            + (f", quorum {quorum_acks_needed(replicas)} ack(s))" if replicas else " — off)")
+        )
+        writer_seqs = {}
+        for sdir, st in zip(shards, statuses):
+            name = os.path.basename(sdir)
+            try:
+                writer_seqs[int(name.rsplit("-", 1)[1])] = st["seq"]
+            except (IndexError, ValueError):
+                pass
         for sdir, st in zip(shards, statuses):
             click.echo(f"  {os.path.basename(sdir):<10} seq {st['seq']:<8} "
                        f"snapshot<={st['snapshot_seq']:<8} {st['segments']} segment(s) "
                        f"{st['tail_records']} tail  {st['bytes']} bytes")
+            for stream in st["replica_streams"]:
+                seal = (
+                    f" SEALED@{stream['sealed_epoch']} seq<={stream['sealed_seq']}"
+                    if stream.get("sealed_epoch")
+                    else ""
+                )
+                lag = writer_seqs.get(stream["writer"], stream["last_seq"]) - stream["last_seq"]
+                click.echo(
+                    f"             replica of shard-{stream['writer']}: "
+                    f"seq {stream['last_seq']} epoch {stream['epoch']}"
+                    f" (lag {max(0, lag)} vs writer journal){seal}"
+                )
         return
     j = _open_journal(state_dir)
     st = j.status()
     j.close()
+    if replicas > 0:
+        st["replica_streams"] = offline_stream_status(root)
     if as_json:
         click.echo(json.dumps(st, indent=2, sort_keys=True))
         return
@@ -1149,6 +1193,16 @@ def journal_status(state_dir: Optional[str], as_json: bool) -> None:
     click.echo(f"  fsync per append: {'on' if st['fsync'] else 'off (page-cache durable)'}")
     for t, n in st["records_by_type"].items():
         click.echo(f"    {t:<20} {n}")
+    for stream in st.get("replica_streams") or []:
+        seal = (
+            f" SEALED@{stream['sealed_epoch']} seq<={stream['sealed_seq']}"
+            if stream.get("sealed_epoch")
+            else ""
+        )
+        click.echo(
+            f"  replica of shard-{stream['writer']}: seq {stream['last_seq']} "
+            f"epoch {stream['epoch']}{seal}"
+        )
 
 
 @journal_group.command("compact")
@@ -1175,9 +1229,27 @@ def journal_compact(state_dir: Optional[str], force: bool) -> None:
                     f"{what} answers at {url} — live planes compact their own journals; "
                     "use --force to compact anyway (risks racing an open segment or a takeover)"
                 )
+    from ..server.replication import offline_replicate_snapshot, replicas_configured
+
     for target in targets:
         prefix = f"{os.path.basename(target)}: " if shards else ""
-        click.echo(prefix + _compact_one(target))
+        message, snapshot_seq = _compact_one(target)
+        click.echo(prefix + message)
+        if shards and replicas_configured() > 0 and snapshot_seq > 0:
+            # quorum replication (ISSUE 19): a follower must never need the
+            # segments this compaction just pruned — install the fresh
+            # snapshot into every sibling's replica stream of this writer
+            try:
+                writer = int(os.path.basename(target).rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            snap_path = os.path.join(target, "journal", f"snapshot-{snapshot_seq}.jsonl")
+            updated = offline_replicate_snapshot(root, writer, snap_path, snapshot_seq)
+            if updated:
+                click.echo(
+                    f"{prefix}snapshot seq<={snapshot_seq} replicated to sibling shard(s) "
+                    + ", ".join(str(u) for u in updated)
+                )
 
 
 def _live_supervisor_url(root: str) -> Optional[str]:
@@ -1196,7 +1268,7 @@ def _live_supervisor_url(root: str) -> Optional[str]:
         return None
 
 
-def _compact_one(root: str) -> str:
+def _compact_one(root: str) -> tuple[str, int]:
     from ..server.journal import IdempotencyCache, Journal, recover_state, synthesize_records
     from ..server.state import ServerState
 
@@ -1211,11 +1283,12 @@ def _compact_one(root: str) -> str:
     j.write_snapshot(synthesize_records(state))
     after = j.status()
     j.close()
-    return (
+    message = (
         f"compacted: {before['tail_records']} tail record(s) -> snapshot at seq {after['snapshot_seq']} "
         f"({before['bytes']} -> {after['bytes']} bytes); "
         f"replayed {report['records_applied']} record(s), {report['open_calls']} open call(s)"
     )
+    return message, int(after["snapshot_seq"])
 
 
 def _parse_prometheus(text: str) -> dict:

@@ -181,6 +181,30 @@ JOURNAL_COMPACTIONS = REGISTRY.counter(
     "modal_tpu_journal_compactions_total",
     "Journal compactions (snapshot written, covered segments pruned).",
 )
+JOURNAL_REPLICA_APPENDS = REGISTRY.counter(
+    "modal_tpu_journal_replica_appends_total",
+    "Replicated journal records this follower accepted (result=ok/snapshot) "
+    "or refused (stale_epoch/gap/disk_full/corrupt), per writer shard.",
+    ("writer", "result"),
+)
+JOURNAL_FENCE_REJECTIONS = REGISTRY.counter(
+    "modal_tpu_journal_fence_rejections_total",
+    "Stale-epoch journal replication messages rejected by this follower "
+    "(fencing tokens): a sustained storm means an undead writer.",
+    ("writer",),
+)
+JOURNAL_REPLICATION_LAG = REGISTRY.gauge(
+    "modal_tpu_journal_replication_lag_seconds",
+    "Age of the oldest journal record not yet acked by this follower "
+    "(0 = fully caught up).",
+    ("follower",),
+)
+JOURNAL_QUORUM_COMMIT_SECONDS = REGISTRY.histogram(
+    "modal_tpu_journal_quorum_commit_seconds",
+    "Wall time a mutating RPC waited at the quorum-commit barrier for "
+    "follower acks (server/replication.py).",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 2.5),
+)
 RECOVERIES = REGISTRY.counter(
     "modal_tpu_recoveries_total",
     "Control-plane recoveries from the journal, by outcome.",
@@ -551,6 +575,8 @@ SPAN_CATALOG: dict[str, str] = {
     "recovery.replay": "journal replay into a fresh ServerState",
     "recovery.crash_restart": "chaos supervisor crash + same-port rebuild",
     "control.takeover": "journal-fed partition takeover: dead shard's segments replayed into a survivor",
+    "journal.replicate": "one replicated journal append/catch-up batch shipped to a follower shard",
+    "control.seal": "quorum takeover seal: survivor's replica stream fenced at the takeover epoch and materialized",
     "director.route": "placement director routing one app-scoped RPC to its owning shard",
     "federation.query": "director-resident federated history query: fan-out to live shards + merge",
     "debug.bundle": "crash-forensics collection: postmortem rings gathered + merged timeline rendered",

@@ -225,6 +225,10 @@ _RPC_NAMES = [
     # administration — shard status probes, journal-fed partition takeover,
     # and epoch fencing of stale shards
     "ShardControl",
+    # Quorum journal replication (ISSUE 19, server/replication.py): a writer
+    # shard streams journal appends / snapshots / seals to follower shards,
+    # every message fenced by the writer's fleet epoch
+    "JournalReplicate",
     # Workspace (identity/membership/settings; billing is NG)
     "WorkspaceNameLookup",
     "WorkspaceMemberList",
@@ -407,7 +411,13 @@ def _maybe_dedupe(servicer: Any, method: "RPCMethod", impl: Any) -> Any:
     map plane that residue is harmless — duplicate inputs share an idx and
     the client's finalized-idx set drops the duplicate output — and the
     window is one buffered flush (~µs); closing it fully needs multi-record
-    atomic appends, deliberately out of scope."""
+    atomic appends, deliberately out of scope.
+
+    Layering: ``_maybe_quorum`` wraps OUTSIDE this — the quorum barrier must
+    cover the dedupe record ``cache.put`` just journaled, or a replica
+    takeover can seal past the effects but before the dedupe key, and the
+    retry re-executes on the successor (a double-apply the ISSUE 19 soak
+    caught)."""
     from ..server.journal import IDEMPOTENT_RPCS  # lazy: proto must not pull server at import
 
     cache = getattr(servicer, "idempotency", None)
@@ -435,6 +445,47 @@ def _maybe_dedupe(servicer: Any, method: "RPCMethod", impl: Any) -> Any:
     return deduped
 
 
+def _maybe_quorum(servicer: Any, method: "RPCMethod", impl: Any) -> Any:
+    """Quorum-commit layer for journaled RPCs (ISSUE 19,
+    server/replication.py): after the handler runs (and its effect records
+    hit the local journal via the ``journal.group()`` flush), hold the
+    response until a quorum of follower shards has durably appended every
+    record up to the journal's current seq. A fenced writer (a follower saw
+    a newer epoch) or a quorum timeout aborts UNAVAILABLE — the client's
+    ``retry_transient_errors`` re-sends and the idempotency layer (wrapped
+    INSIDE this barrier, so its dedupe record is quorum-durable before the
+    ack) replays the cached response instead of double-applying.
+
+    Build-time gated: with ``MODAL_TPU_JOURNAL_REPLICAS=0`` (or no
+    replicator on the servicer) this returns ``impl`` unchanged — the
+    degraded path is byte-identical to the single-writer plane, not a
+    wrapper that happens to no-op."""
+    from ..server.journal import JOURNALED_RPCS  # lazy: proto must not pull server at import
+    from ..server.replication import replicas_configured
+
+    replicator = getattr(servicer, "replicator", None)
+    if replicator is None or method.name not in JOURNALED_RPCS or replicas_configured() == 0:
+        return impl
+
+    async def quorum_committed(request, context, _impl=impl, _name=method.name, _repl=replicator):
+        response = await _impl(request, context)
+        if _repl.active and not await _repl.commit_barrier():
+            reason = "writer fenced by a newer epoch" if _repl.fenced else "replication quorum timeout"
+            await context.abort(
+                _grpc_status().UNAVAILABLE,
+                f"{_name}: journal quorum commit failed ({reason}); safe to retry",
+            )
+        return response
+
+    return quorum_committed
+
+
+def _grpc_status():
+    import grpc
+
+    return grpc.StatusCode
+
+
 def _build_handler(
     servicer: Any, registry: dict[str, RPCMethod], service_name: str
 ) -> "grpc.GenericRpcHandler":
@@ -451,7 +502,11 @@ def _build_handler(
         )
         if method.arity == Arity.UNARY_UNARY:
             handlers[method.name] = grpc.unary_unary_rpc_method_handler(
-                _instrument_unary(method.name, _maybe_dedupe(servicer, method, impl)), **kwargs
+                _instrument_unary(
+                    method.name,
+                    _maybe_quorum(servicer, method, _maybe_dedupe(servicer, method, impl)),
+                ),
+                **kwargs,
             )
         elif method.arity == Arity.UNARY_STREAM:
             handlers[method.name] = grpc.unary_stream_rpc_method_handler(
@@ -486,7 +541,10 @@ def build_local_handlers(servicer: Any) -> dict[str, tuple["RPCMethod", Any]]:
         if method.arity == Arity.UNARY_UNARY:
             handlers[method.name] = (
                 method,
-                _instrument_unary(method.name, _maybe_dedupe(servicer, method, impl)),
+                _instrument_unary(
+                    method.name,
+                    _maybe_quorum(servicer, method, _maybe_dedupe(servicer, method, impl)),
+                ),
             )
         elif method.arity == Arity.UNARY_STREAM:
             handlers[method.name] = (method, _instrument_stream(method.name, impl))

@@ -42,6 +42,13 @@ def main() -> None:
         default=None,
         help="shared blob store directory (set by the director)",
     )
+    parser.add_argument(
+        "--fleet-root",
+        type=str,
+        default=None,
+        help="sharded fleet root dir for journal-replication peer discovery "
+        "(set by the director; reads <fleet-root>/shards.json)",
+    )
     args = parser.parse_args()
     try:
         asyncio.run(
@@ -53,6 +60,7 @@ def main() -> None:
                 subprocess_shards=args.subprocess_shards,
                 shard_index=args.shard_index,
                 blob_dir=args.blob_dir,
+                fleet_root=args.fleet_root,
             )
         )
     except KeyboardInterrupt:

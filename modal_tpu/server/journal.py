@@ -146,6 +146,8 @@ EXEMPT_RPCS: dict[str, str] = {
     "ShardControl": "director↔shard topology administration; shard maps and epochs are runtime "
     "state rebuilt by the director's health loop (the takeover IT TRIGGERS replays+compacts "
     "journals, which is the durable part)",
+    "JournalReplicate": "replication plumbing (server/replication.py): the shipped records ARE "
+    "journal records — journaling the RPC that carries them would double-write every append",
     # on-disk content-addressed stores are already durable
     "MountPutFile": "content-addressed block store on disk is already durable",
     "MountGetOrCreate": "manifest is stored as an on-disk block",
@@ -240,6 +242,14 @@ class Journal:
         # optional record observer (ISSUE 17: the flight recorder's journal
         # tail) — called with the appended payload dict, never raises out
         self.tap = None
+        # quorum replication hooks (ISSUE 19, server/replication.py):
+        # `observer` sees every appended record (the replicator's feed);
+        # `on_snapshot` is awaited by compact_async BEFORE pruning, so
+        # followers receive the snapshot while its covered segments still
+        # exist. Both are None on an unreplicated journal — the append and
+        # compaction byte streams are identical either way.
+        self.observer = None
+        self.on_snapshot = None
         # segment name -> max seq it holds (maintained as segments roll so
         # compaction's prune decision never re-reads segment files on the
         # supervisor's event loop)
@@ -320,6 +330,14 @@ class Journal:
         if tap is not None:
             try:
                 tap(payload)
+            except Exception:
+                pass
+        observer = self.observer
+        if observer is not None:
+            try:
+                # the serialized line rides along so the replicator's buffer
+                # never has to re-encode the record it is about to ship
+                observer(payload, line)
             except Exception:
                 pass
         self._fh.write(line)
@@ -409,6 +427,33 @@ class Journal:
         tail.sort(key=lambda r: int(r.get("seq", 0)))
         return snap_records, tail
 
+    def latest_snapshot(self) -> Optional[tuple[int, str]]:
+        """(covered_seq, path) of the newest snapshot, or None. The
+        replicator's catch-up path installs it on followers whose gap
+        predates the retained segments (server/replication.py)."""
+        snapshots = self._list("snapshot-")
+        if not snapshots:
+            return None
+        name = snapshots[-1]
+        return int(name[len("snapshot-") : -len(".jsonl")]), os.path.join(self.dir, name)
+
+    def tail_lines(self, since_seq: int) -> list[tuple[int, str]]:
+        """Record lines with seq > since_seq from the on-disk segments, in
+        seq order — the replicator's follower catch-up feed. Records still
+        buffered in the writer's file handle are not visible here, but those
+        are by construction still in the replicator's in-memory buffer."""
+        out: list[tuple[int, str]] = []
+        for name in self._list("segment-"):
+            seg_max = self._segment_max_seq.get(name)
+            if seg_max is not None and seg_max <= since_seq:
+                continue
+            for rec in _read_records(os.path.join(self.dir, name)):
+                seq = int(rec.get("seq", 0))
+                if seq > since_seq:
+                    out.append((seq, json.dumps(rec, separators=(",", ":"))))
+        out.sort(key=lambda pair: pair[0])
+        return out
+
     # -- snapshot / compaction ----------------------------------------------
 
     @staticmethod
@@ -474,6 +519,15 @@ class Journal:
         covered_seq = self.seq
         path = self._snapshot_path(covered_seq)
         await asyncio.to_thread(self._write_snapshot_file, records, path)
+        on_snapshot = self.on_snapshot
+        if on_snapshot is not None:
+            # replicate the snapshot BEFORE pruning the segments it covers
+            # (server/replication.py): a follower must never need pruned
+            # history to seal. Best-effort — the hook logs its own failures.
+            try:
+                await on_snapshot(covered_seq, path)
+            except Exception:
+                logger.exception("snapshot replication hook failed")
         self._finish_snapshot(path, covered_seq)
         return path
 

@@ -80,6 +80,13 @@ class ModalTPUServicer:
         wrapper in proto/rpc.py. None when journaling is off."""
         return self.s.idempotency
 
+    @property
+    def replicator(self):
+        """Quorum journal replicator (ISSUE 19, server/replication.py),
+        consumed by the quorum-commit wrapper in proto/rpc.py. None when
+        journaling or replication is off."""
+        return self.s.replicator
+
     def _j(self, t: str, **payload) -> None:
         """Append one typed record to the write-ahead journal (no-op when
         journaling is off). Every mutating handler below calls this with the
@@ -1273,10 +1280,23 @@ class ModalTPUServicer:
                 grpc.StatusCode.FAILED_PRECONDITION,
                 "shard administration requires a supervisor-attached servicer",
             )
+        if request.epoch and hasattr(sup, "note_fleet_epoch"):
+            # director probes piggyback the fleet epoch (ISSUE 19): the
+            # local replicator stamps subsequent appends with it, so
+            # followers can fence a writer that missed a takeover
+            sup.note_fleet_epoch(request.epoch)
         if request.action == "status":
             return api_pb2.ShardControlResponse(payload_json=json.dumps(sup.shard_status()))
         if request.action == "adopt":
             report = await sup.adopt_partition(request.journal_dir, request.partition)
+            return api_pb2.ShardControlResponse(payload_json=json.dumps(report))
+        if request.action == "adopt_replica":
+            # quorum takeover (ISSUE 19): adopt a partition from OUR replica
+            # stream of the dead writer — used when the writer's own journal
+            # directory is gone (lost disk), not just its process
+            report = await sup.adopt_from_replica(
+                request.shard_index, request.partition, request.epoch
+            )
             return api_pb2.ShardControlResponse(payload_json=json.dumps(report))
         if request.action == "fence":
             # fencing stops the very gRPC server carrying this call: run it as
@@ -1290,6 +1310,41 @@ class ModalTPUServicer:
         await context.abort(
             grpc.StatusCode.INVALID_ARGUMENT, f"unknown shard action {request.action!r}"
         )
+
+    async def JournalReplicate(self, request, context) -> api_pb2.JournalReplicateResponse:
+        """Follower side of quorum journal replication (ISSUE 19,
+        server/replication.py): a peer writer streams its journal appends /
+        compacted snapshots / seal requests here; we persist them into our
+        per-writer ReplicaStore stream. Every message carries the writer's
+        fleet epoch — a stale epoch is rejected (fencing token), which is
+        what makes a partitioned old writer structurally unable to commit
+        past a takeover. Journal-EXEMPT: the payload IS journal records."""
+        sup = self.supervisor
+        store = getattr(sup, "replica_store", None) if sup is not None else None
+        if store is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "journal replication requires a replica store (journaling + replicas > 0)",
+            )
+        kind = request.kind
+        # payload is newline-joined record lines, not a JSON array: the hot
+        # append path must not re-encode/re-parse what is already JSONL
+        lines = request.payload_json.split("\n") if request.payload_json else []
+        if kind == "append":
+            result = store.append(request.writer_shard, request.epoch, lines)
+        elif kind == "snapshot":
+            result = store.install_snapshot(
+                request.writer_shard, request.epoch, request.base_seq, lines
+            )
+        elif kind == "seal":
+            result = store.seal(request.writer_shard, request.epoch)
+        elif kind == "status":
+            result = store.status(request.writer_shard)
+        else:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"unknown replicate kind {request.kind!r}"
+            )
+        return api_pb2.JournalReplicateResponse(payload_json=json.dumps(result))
 
     def _scaledown_blocked(self, fn, task) -> bool:
         """Is this container one of the `min_containers` oldest live ones for

@@ -379,6 +379,7 @@ class ShardedSupervisor:
             max_faults=self.chaos.max_faults,
         )
         clone.fail_counts = dict(self.chaos.fail_counts)
+        clone.repl_lag_ms = self.chaos.repl_lag_ms
         return clone
 
     def _workers_for_shard(self, index: int) -> int:
@@ -464,6 +465,10 @@ class ShardedSupervisor:
                     str(index),
                     "--blob-dir",
                     self.blob_dir,
+                    # journal-replication peer discovery (ISSUE 19): the
+                    # shard reads <fleet_root>/shards.json for live siblings
+                    "--fleet-root",
+                    self.state_dir,
                 ],
                 env=env,
                 start_new_session=True,  # a shard's SIGKILL must not orphan-kill us
@@ -481,6 +486,14 @@ class ShardedSupervisor:
                 chaos=self._shard_policy(),
                 shard_index=index,
                 blob_dir=self.blob_dir,
+                # journal-replication peers (ISSUE 19): live siblings by
+                # CURRENT topology — dead shards drop out so the writer's
+                # follower set heals itself after a takeover
+                replication_peers=lambda _i=index: [
+                    (j, self.shard_urls[j])
+                    for j in range(self.num_shards)
+                    if j != _i and not self.dead[j] and self.shard_urls[j]
+                ],
             )
             await sup.start()
             self.shards[index] = sup
@@ -633,8 +646,11 @@ class ShardedSupervisor:
             if proc is None or proc.poll() is not None:
                 return False
             try:
+                # the probe carries the fleet epoch (ISSUE 19): shards stamp
+                # their replicated journal appends with it, so followers can
+                # fence a writer that missed a takeover
                 resp = await self.shard_stub(index).ShardControl(
-                    api_pb2.ShardControlRequest(action="status"), timeout=1.0
+                    api_pb2.ShardControlRequest(action="status", epoch=self.epoch), timeout=1.0
                 )
                 status = json.loads(resp.payload_json)
                 self._probe_outputs[index] = int(status.get("chaos_outputs_seen", 0))
@@ -642,7 +658,10 @@ class ShardedSupervisor:
             except (grpc.aio.AioRpcError, ValueError, asyncio.TimeoutError):
                 return False
         sup = self.shards[index]
-        return sup is not None and sup._grpc_server is not None and not sup.fenced
+        if sup is None or sup._grpc_server is None or sup.fenced:
+            return False
+        sup.note_fleet_epoch(self.epoch)
+        return True
 
     async def _health_loop(self) -> None:
         while True:
@@ -684,7 +703,7 @@ class ShardedSupervisor:
                 return
             t0 = time.time()
             # per-phase wall timestamps: the debug-bundle timeline annotates
-            # fence → adopt → remap → rehome against the metrics window
+            # fence → seal → adopt → remap → rehome against the metrics window
             phases = {"start": round(t0, 3)}
             epoch = self.epoch + 1
             # fence FIRST: a false death (live shard behind a partition) must
@@ -693,8 +712,32 @@ class ShardedSupervisor:
             await self._fence_shard(dead_index, epoch)
             phases["fence"] = round(time.time(), 3)
             dead_dir = shard_dir(self.state_dir, dead_index)
+            # quorum takeover (ISSUE 19): prefer the survivors' replica
+            # streams over the corpse's own journal directory — the replica
+            # path survives a lost DISK, and sealing every surviving copy at
+            # the bumped epoch structurally kills the old writer's quorum.
+            # No replicated copy (replication off / nothing ever appended)
+            # falls back to the PR 13 replay-from-the-corpse's-disk path.
+            mode = "journal"
             try:
-                report = await self._adopt(successor, dead_dir, dead_index)
+                replica_successor = await self._pick_replica_successor(dead_index)
+                if replica_successor is not None:
+                    successor, holders = replica_successor
+                    for holder in holders:
+                        await self._replica_call(holder, "seal", dead_index, epoch)
+                    phases["seal"] = round(time.time(), 3)
+                    report = await self._adopt_replica(successor, dead_index, epoch)
+                    mode = "replica"
+                    # the corpse's journal (when its disk survived) must not
+                    # be replayable by a stale respawn: archive best-effort
+                    try:
+                        from .journal import archive_existing
+
+                        archive_existing(dead_dir)
+                    except OSError:
+                        pass
+                else:
+                    report = await self._adopt(successor, dead_dir, dead_index)
             except Exception:
                 logger.exception(
                     f"takeover of shard {dead_index} by {successor} failed; will retry"
@@ -715,6 +758,7 @@ class ShardedSupervisor:
                 "successor": successor,
                 "partitions": moved,
                 "epoch": epoch,
+                "mode": mode,
                 "seconds": round(took, 4),
                 "phases": phases,
                 "report": report,
@@ -757,6 +801,74 @@ class ShardedSupervisor:
             )
             return json.loads(resp.payload_json)
         return await self.shards[successor].adopt_partition(dead_dir, partition=partition)
+
+    # -- quorum takeover (ISSUE 19, server/replication.py) ---------------------
+
+    async def _replica_call(self, shard: int, kind: str, writer: int, epoch: int = 0) -> dict:
+        """One JournalReplicate exchange with a surviving shard about its
+        replica stream of `writer`: direct store access for in-process
+        shards, the RPC for subprocess ones. Unreachable shards report as an
+        error dict, never an exception — the takeover must keep moving."""
+        if not self.subprocess_shards:
+            sup = self.shards[shard]
+            store = sup.replica_store if sup is not None else None
+            if store is None:
+                return {"ok": False, "error": "no_store"}
+            if kind == "status":
+                return store.status(writer)
+            if kind == "seal":
+                return store.seal(writer, epoch)
+            raise ValueError(f"unknown replica call kind {kind!r}")
+        stub = self.shard_stub(shard)
+        if stub is None:
+            return {"ok": False, "error": "unreachable"}
+        try:
+            resp = await stub.JournalReplicate(
+                api_pb2.JournalReplicateRequest(kind=kind, writer_shard=writer, epoch=epoch),
+                timeout=5.0,
+            )
+            return json.loads(resp.payload_json)
+        except (grpc.aio.AioRpcError, ValueError, asyncio.TimeoutError):
+            return {"ok": False, "error": "unreachable"}
+
+    async def _pick_replica_successor(self, dead_index: int) -> Optional[tuple[int, list[int]]]:
+        """(successor, every surviving stream holder) for a quorum takeover:
+        the survivor with the HIGHEST replicated seq of the dead writer wins
+        (it holds everything any quorum ever acked), ring order breaks ties
+        so the choice matches _pick_successor when replicas are in lockstep.
+        None when no survivor holds a stream — the caller falls back to the
+        corpse's own journal directory."""
+        candidates: list[tuple[int, int, int]] = []  # (last_seq, -ring_off, shard)
+        holders: list[int] = []
+        for off in range(1, self.num_shards):
+            cand = (dead_index + off) % self.num_shards
+            if self.dead[cand] or not self.shard_urls[cand]:
+                continue
+            status = await self._replica_call(cand, "status", dead_index)
+            if not status.get("ok"):
+                continue
+            holders.append(cand)
+            candidates.append((int(status.get("last_seq", 0)), -off, cand))
+        if not candidates:
+            return None
+        candidates.sort(reverse=True)
+        return candidates[0][2], holders
+
+    async def _adopt_replica(self, successor: int, dead_index: int, epoch: int) -> dict:
+        if self.subprocess_shards:
+            resp = await self.shard_stub(successor).ShardControl(
+                api_pb2.ShardControlRequest(
+                    action="adopt_replica",
+                    partition=dead_index,
+                    shard_index=dead_index,
+                    epoch=epoch,
+                ),
+                timeout=120.0,
+            )
+            return json.loads(resp.payload_json)
+        return await self.shards[successor].adopt_from_replica(
+            dead_index, dead_index, epoch
+        )
 
     async def _rehome_workers(self, dead_index: int, successor: int) -> None:
         """In-process mode: the dead shard's worker AGENTS survive the

@@ -444,6 +444,10 @@ class ServerState:
         # idempotency = journal-backed seen-set for mutating RPC dedupe.
         self.journal = None  # Optional[journal.Journal]
         self.idempotency = None  # Optional[journal.IdempotencyCache]
+        # quorum journal replication (ISSUE 19, server/replication.py):
+        # wired by the supervisor when MODAL_TPU_JOURNAL_REPLICAS > 0; the
+        # RPC layer's _maybe_quorum reads it at handler-build time
+        self.replicator = None  # Optional[replication.JournalReplicator]
 
         # fleet SLO observability (ISSUE 11): the supervisor-resident
         # time-series store + burn-rate evaluator (wired by the supervisor's

@@ -47,6 +47,13 @@ class LocalSupervisor:
         recover: Optional[bool] = None,  # None = auto: recover iff a journal exists
         shard_index: int = 0,  # home partition for minted ids (server/shards.py)
         blob_dir: Optional[str] = None,  # shared blob store across shards
+        # quorum journal replication (ISSUE 19, server/replication.py):
+        # peers = () -> [(shard_index, url)] of live siblings (in-process
+        # sharding injects this); fleet_root = the sharded fleet's root dir
+        # (subprocess shards discover peers from <fleet_root>/shards.json).
+        # Neither set => a standalone monolith: no peers, no replication.
+        replication_peers: Optional[Any] = None,
+        fleet_root: Optional[str] = None,
     ):
         self.num_workers = num_workers
         self.port = port
@@ -63,6 +70,11 @@ class LocalSupervisor:
         self.fenced_at_epoch = 0
         self.recovery_report: Optional[dict] = None  # set when start() replayed a journal
         self.takeover_reports: list[dict] = []  # one per adopted partition
+        self.replication_peers = replication_peers
+        self.fleet_root = fleet_root
+        self.replica_store = None  # follower side (ISSUE 19), set by _attach_journal
+        self._fence_rejection_times: list[float] = []  # storm detector window
+        self._fence_storm_dumped_at = 0.0
         self.state = ServerState(self.state_dir, shard_index=shard_index, blob_dir=blob_dir)
         # chaos: explicit policy, else env-driven (MODAL_TPU_CHAOS=1)
         self.chaos = chaos if chaos is not None else ChaosPolicy.from_env()
@@ -122,6 +134,7 @@ class LocalSupervisor:
             self.state.idempotency = IdempotencyCache(journal=journal)
         else:
             self.state.idempotency.journal = journal
+        self._attach_replication(journal)
         # data-plane port continuity: clients that survive a control-plane
         # restart hold the OLD input-plane/blob URLs (handed out at
         # ClientHello / BlobCreate) — rebinding the same ports makes their
@@ -139,6 +152,72 @@ class LocalSupervisor:
                 self.input_plane.port = int(saved.get("input_plane", 0))
         except (OSError, ValueError):
             pass
+
+    def _attach_replication(self, journal: Journal) -> None:
+        """Quorum journal replication (ISSUE 19, server/replication.py): wire
+        the follower-side ReplicaStore and the writer-side JournalReplicator
+        onto the freshly opened journal. Fleet-only: a standalone monolith
+        (no peers callable, no fleet root) gets neither — and with
+        MODAL_TPU_JOURNAL_REPLICAS=0 this is a structural no-op, so the
+        single-writer path stays byte-identical."""
+        from .replication import JournalReplicator, ReplicaStore, replicas_configured
+
+        if (self.replication_peers is None and not self.fleet_root) or replicas_configured() == 0:
+            return
+        self.replica_store = ReplicaStore(
+            self.state_dir, chaos=self.chaos, on_fence_rejection=self._note_fence_rejection
+        )
+        peers = self.replication_peers or self._peers_from_fleet_root
+        replicator = JournalReplicator(
+            journal, self.shard_index, self.state_dir, peers=peers, chaos=self.chaos
+        )
+        self.state.replicator = replicator
+        # the hooks are what keeps replicas=0 byte-identical: without them the
+        # journal doesn't know replication exists
+        journal.observer = replicator.observe
+        journal.on_snapshot = replicator.ship_snapshot
+
+    def _peers_from_fleet_root(self) -> list[tuple[int, str]]:
+        """Subprocess-shard peer discovery: the director persists
+        <fleet_root>/shards.json (pids/ports) on every topology change; dead
+        or unstarted siblings are excluded. Re-read per call so takeovers and
+        respawns are picked up without a control channel."""
+        import json as _json
+
+        try:
+            with open(os.path.join(self.fleet_root, "shards.json")) as f:
+                doc = _json.load(f)
+        except (OSError, ValueError):
+            return []
+        peers = []
+        for entry in doc.get("shards", ()):
+            try:
+                idx = int(entry.get("index", -1))
+            except (TypeError, ValueError):
+                continue
+            url = entry.get("url") or ""
+            if idx < 0 or idx == self.shard_index or not url or entry.get("dead"):
+                continue
+            peers.append((idx, url))
+        return peers
+
+    def _note_fence_rejection(self, writer: int) -> None:
+        """Fence-rejection storm detector (ISSUE 19 satellite): one stale
+        append is routine during takeover; a sustained storm means an undead
+        writer is actively hammering a sealed stream — freeze the flight
+        recorder's last minute for the postmortem."""
+        import time as _time
+
+        now = _time.monotonic()
+        window = [t for t in self._fence_rejection_times if now - t < 10.0]
+        window.append(now)
+        self._fence_rejection_times = window
+        if len(window) >= 5 and now - self._fence_storm_dumped_at > 60.0:
+            self._fence_storm_dumped_at = now
+            if self.flight_recorder is not None:
+                self.flight_recorder.dump(
+                    "fence_rejections", extra={"writer": writer, "rejections_10s": len(window)}
+                )
 
     def _save_ports(self) -> None:
         """Record the bound data-plane ports for the next (post-crash) boot."""
@@ -283,6 +362,11 @@ class LocalSupervisor:
             self.flight_recorder.start()
         else:
             self.flight_recorder = None
+        # quorum replication sender tasks (ISSUE 19): started here — not in
+        # _attach_journal — because they need the running loop, and the
+        # crash_restart rebuild must respawn them against the NEW journal
+        if self.state.replicator is not None:
+            self.state.replicator.start()
 
     async def _sampler_loop(self) -> None:
         """Sample the registry into the store + evaluate SLO rules, forever.
@@ -416,6 +500,7 @@ class LocalSupervisor:
         await self._stop_sampler()  # references the abandoned state
         await self.input_plane.stop()
         await self.blob_server.stop()
+        await self._stop_replication()
         if old_journal is not None:
             old_journal.close()
         return ports
@@ -523,10 +608,70 @@ class LocalSupervisor:
         await self._stop_sampler()
         await self.input_plane.stop()
         await self.blob_server.stop()
+        await self._stop_replication()
         if self.state.journal is not None:
             self.state.journal.close()
             self.state.journal = None
         logger.warning(f"shard {self.shard_index} fenced at epoch {epoch}")
+
+    async def _stop_replication(self) -> None:
+        """Tear down the quorum-replication surfaces (ISSUE 19): cancel the
+        writer's sender tasks and close the follower store's file handles.
+        Replica streams STAY on disk — they are what a takeover seals and
+        materializes after this shard (or its whole disk) is gone."""
+        replicator = self.state.replicator
+        if replicator is not None:
+            await replicator.stop()
+            self.state.replicator = None
+        if self.replica_store is not None:
+            self.replica_store.close()
+            self.replica_store = None
+
+    def note_fleet_epoch(self, epoch: int) -> None:
+        """Adopt the director's fleet epoch (piggybacked on health probes and
+        takeover adopts): the replicator stamps subsequent appends with it so
+        followers can fence any incarnation of us that missed a takeover."""
+        replicator = self.state.replicator
+        if replicator is not None:
+            replicator.note_epoch(epoch)
+
+    async def adopt_from_replica(self, writer: int, partition: int, epoch: int) -> dict:
+        """Quorum takeover (ISSUE 19, server/shards.py): adopt a dead
+        writer's partition from OUR replica stream of its journal — the path
+        the director takes when the writer's own journal directory is gone
+        (lost disk). Seal first (idempotent; the director also seals every
+        other surviving holder at the same epoch, so the old writer's quorum
+        is structurally dead), then materialize the sealed stream into a
+        journal-shaped directory and ride the existing adopt_partition
+        replay."""
+        import time as _time
+
+        if self.replica_store is None:
+            raise RuntimeError(
+                f"shard {self.shard_index} holds no replica streams (replication off?)"
+            )
+        t0 = _time.time()
+        sealed = self.replica_store.seal(writer, epoch)
+        if not sealed.get("ok"):
+            raise RuntimeError(f"seal of writer {writer} at epoch {epoch} refused: {sealed}")
+        source = self.replica_store.materialize(writer)
+        tracing.record_span(
+            "control.seal",
+            start=t0,
+            end=_time.time(),
+            attrs={
+                "writer": writer,
+                "partition": partition,
+                "epoch": epoch,
+                "sealed_seq": sealed.get("sealed_seq", 0),
+            },
+        )
+        self.note_fleet_epoch(epoch)
+        report = await self.adopt_partition(source, partition=partition)
+        report["mode"] = "replica"
+        report["writer"] = writer
+        report["sealed_seq"] = sealed.get("sealed_seq", 0)
+        return report
 
     def shard_status(self) -> dict:
         """Health/topology snapshot for the director's probe loop and the
@@ -544,6 +689,14 @@ class LocalSupervisor:
             ),
             "journal_seq": j.seq if j is not None else 0,
             "takeovers": len(self.takeover_reports),
+            # quorum replication (ISSUE 19): writer-side follower lag/epoch
+            # and the replica streams this shard holds for peer writers
+            "replication": (
+                self.state.replicator.status() if self.state.replicator is not None else None
+            ),
+            "replica_streams": (
+                self.replica_store.status_all() if self.replica_store is not None else []
+            ),
             # the director's shared chaos clock (subprocess shards report
             # their output count through the health probe)
             "chaos_outputs_seen": self.chaos.outputs_seen if self.chaos is not None else 0,
@@ -588,6 +741,7 @@ class LocalSupervisor:
         await self._stop_sampler()
         await self.input_plane.stop()
         await self.blob_server.stop()
+        await self._stop_replication()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)
         if self.state.journal is not None:
@@ -602,6 +756,7 @@ async def serve_forever(
     subprocess_shards: bool = False,
     shard_index: int = 0,
     blob_dir: Optional[str] = None,
+    fleet_root: Optional[str] = None,
 ) -> None:
     if shards > 1:
         # sharded control plane (server/shards.py): shards==1 stays on this
@@ -623,6 +778,7 @@ async def serve_forever(
             state_dir=state_dir,
             shard_index=shard_index,
             blob_dir=blob_dir,
+            fleet_root=fleet_root,
         )
     await sup.start()
     print(f"modal_tpu control plane listening on {sup.server_url}", flush=True)

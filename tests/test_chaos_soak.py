@@ -307,19 +307,20 @@ def _spawn_sharded_supervisor(port: int, state_dir: str, tmp_path) -> "subproces
     )
 
 
-@pytest.mark.slow
-@pytest.mark.chaos
-@pytest.mark.recovery
-def test_kill9_shard_mid_100k_map_takeover_exactly_once(tmp_path, monkeypatch):
-    """ISSUE 16 acceptance soak: 3 OS-process shards behind the placement
-    director; the shard owning the app's partition is kill -9'd (real SIGKILL,
-    whole process group) mid-way through a 100k-input placement storm. The
-    director must fence it, a sibling must rehydrate its partition from the
-    dead shard's journal, and every input must land exactly once — the
-    client's idempotent re-sends dedupe against the REPLAYED journal state,
-    and no placement may be lost. The client is never restarted: its retry
-    loops ride UNAVAILABLE -> shard-map refresh -> redial."""
+def _kill9_shard_soak(tmp_path, monkeypatch, delete_journal_dir: bool = False):
+    """Shared soak body (ISSUE 16 / ISSUE 19): 3 OS-process shards behind the
+    placement director; the shard owning the app's partition is kill -9'd
+    (real SIGKILL, whole process group) mid-way through a 100k-input
+    placement storm. With ``delete_journal_dir`` the victim's journal
+    directory is deleted right after the kill — the disk is gone, not just
+    the process — so recovery MUST come from the survivors' replica streams.
+    Either way the director must fence the victim, a sibling must rehydrate
+    its partition, and every input must land exactly once — the client's
+    idempotent re-sends dedupe against the recovered state, and no placement
+    may be lost. The client is never restarted: its retry loops ride
+    UNAVAILABLE -> shard-map refresh -> redial."""
     import json as _json
+    import shutil
     import threading
     import zlib
 
@@ -423,6 +424,18 @@ def test_kill9_shard_mid_100k_map_takeover_exactly_once(tmp_path, monkeypatch):
                 victim = next(s for s in _json.load(fh)["shards"] if s["index"] == 1)
             assert victim["pid"] > 0, "subprocess shard pid not persisted"
             os.killpg(victim["pid"], signal.SIGKILL)
+            if delete_journal_dir:
+                # the disk dies with the process: nothing left to replay from
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        os.kill(victim["pid"], 0)
+                    except OSError:
+                        break  # corpse reaped — its file handles are gone
+                    time.sleep(0.1)
+                shutil.rmtree(
+                    os.path.join(state_dir, "shard-1", "journal"), ignore_errors=True
+                )
             t.join(timeout=600)
             assert not t.is_alive(), "placement storm never completed after the shard kill"
             assert not storm_errors, f"storm failed across the kill -9: {storm_errors}"
@@ -448,6 +461,12 @@ def test_kill9_shard_mid_100k_map_takeover_exactly_once(tmp_path, monkeypatch):
             assert topo["epoch"] >= 2, "no epoch bump — takeover never ran"
             assert topo["assignments"][1] != 1, "partition 1 still on the dead shard"
             assert topo["takeovers"] and topo["takeovers"][-1]["report"]["records_applied"] > 0
+            if delete_journal_dir:
+                # the journal dir was deleted: only the quorum replica path
+                # can explain a successful rehydration
+                assert topo["takeovers"][-1]["mode"] == "replica", (
+                    "takeover claims a journal replay from a deleted directory"
+                )
     finally:
         env_client = _Client._client_from_env
         if env_client is not None and not env_client._closed:
@@ -472,3 +491,24 @@ def test_kill9_shard_mid_100k_map_takeover_exactly_once(tmp_path, monkeypatch):
                             pass
         except OSError:
             pass
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.recovery
+def test_kill9_shard_mid_100k_map_takeover_exactly_once(tmp_path, monkeypatch):
+    """ISSUE 16 acceptance soak: process loss only — the corpse's disk
+    survives, and either recovery path (replica stream or corpse journal)
+    may serve the rehydration."""
+    _kill9_shard_soak(tmp_path, monkeypatch, delete_journal_dir=False)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.recovery
+def test_kill9_and_delete_journal_dir_quorum_recovery(tmp_path, monkeypatch):
+    """ISSUE 19 acceptance soak: kill -9 the home shard AND delete its
+    journal directory mid-storm. Zero acked-record loss and exactly-once
+    placement counts must come entirely from the surviving shards' quorum
+    replica streams (takeover mode == "replica")."""
+    _kill9_shard_soak(tmp_path, monkeypatch, delete_journal_dir=True)

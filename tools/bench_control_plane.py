@@ -29,6 +29,12 @@ Reported (CONTROL_BENCH_RESULT JSON line):
   one shard's direct endpoint (ISSUE 17: merged must stay <= 2x direct at 3
   shards), plus ``flight_dump_s`` / ``flight_ring_bytes`` for the flight
   recorder's postmortem dump.
+- ``journal_quorum_p50_s`` / ``journal_local_p50_s`` /
+  ``journal_quorum_overhead_x`` — placement p50 with quorum journal
+  replication on (MODAL_TPU_JOURNAL_REPLICAS=2) vs off (=0); the ISSUE 19
+  bar is overhead <= 1.5x.  ``replica_takeover_s`` / ``replica_takeover_mode``
+  time the dead-DISK takeover (shard killed + journal directory deleted;
+  recovery must come from the survivors' replica streams).
 
 Usage (full scale ≈ 1M inputs / 10k calls; scale down for CI):
     JAX_PLATFORMS=cpu python tools/bench_control_plane.py \
@@ -247,10 +253,102 @@ async def _bench_federation(repeats: int = 20) -> dict:
     return out
 
 
+async def _bench_replication(args) -> dict:
+    """ISSUE 19 A/B: identical placement loads against two fresh in-process
+    fleets — quorum journal replication ON (MODAL_TPU_JOURNAL_REPLICAS=2)
+    vs OFF (=0, the byte-identical single-writer degrade). The acceptance
+    bar is quorum p50 <= 1.5x local-only p50 on the same host. The ON fleet
+    then loses a shard AND its journal directory (disk death, not process
+    death) and the replica-stream takeover is timed."""
+    from modal_tpu.client import _Client
+    from modal_tpu.server.shards import ShardedSupervisor
+
+    REPL_CALLS = 120
+    REPL_INPUTS_PER_CALL = 20
+    prior = os.environ.get("MODAL_TPU_JOURNAL_REPLICAS")
+    metrics: dict = {}
+    try:
+        for env_value, key in (("0", "journal_local_p50_s"), ("2", "journal_quorum_p50_s")):
+            os.environ["MODAL_TPU_JOURNAL_REPLICAS"] = env_value
+            state_dir = tempfile.mkdtemp(prefix=f"bench-repl-{env_value}-")
+            sup = ShardedSupervisor(
+                num_shards=args.shards,
+                num_workers=args.shards,
+                state_dir=state_dir,
+                worker_chips=8,
+                worker_tpu_type="local-sim",
+                health_interval_s=0.2,
+            )
+            await sup.start()
+            for shard in sup.shards:
+                if shard is not None:
+                    await shard.scheduler.stop()
+            client = _Client(sup.server_url, 1)
+            await client._open()
+            try:
+                await client.hello()
+                functions = await _create_partition_apps(client, args.shards)
+                payload = b"x" * args.payload_bytes
+                latencies: list[float] = []
+                sem = asyncio.Semaphore(min(args.concurrency, 32))
+
+                async def _guarded(part: int) -> None:
+                    async with sem:
+                        await _one_call(
+                            client,
+                            functions[part],
+                            REPL_INPUTS_PER_CALL,
+                            min(args.batch, REPL_INPUTS_PER_CALL),
+                            payload,
+                            latencies,
+                        )
+
+                await asyncio.gather(*(_guarded(i % args.shards) for i in range(REPL_CALLS)))
+                latencies.sort()
+                metrics[key] = round(_quantile(latencies, 0.50), 6)
+                if env_value == "2":
+                    # dead-disk takeover: kill the shard AND delete its journal
+                    # — only the survivors' replica streams can rehydrate it
+                    kill_index = 1 % args.shards
+                    await sup.kill_shard(kill_index)
+                    shutil.rmtree(
+                        os.path.join(state_dir, f"shard-{kill_index}", "journal"),
+                        ignore_errors=True,
+                    )
+                    deadline = time.monotonic() + 60.0
+                    while time.monotonic() < deadline:
+                        if sup.assignments[kill_index] != kill_index:
+                            break
+                        await asyncio.sleep(0.05)
+                    entries = [
+                        e for e in sup.takeover_log if e["dead_shard"] == kill_index
+                    ]
+                    if entries:
+                        metrics["replica_takeover_s"] = entries[-1]["seconds"]
+                        metrics["replica_takeover_mode"] = entries[-1]["mode"]
+            finally:
+                await client._close()
+                await sup.stop()
+                shutil.rmtree(state_dir, ignore_errors=True)
+        local = metrics.get("journal_local_p50_s") or 0.0
+        quorum = metrics.get("journal_quorum_p50_s") or 0.0
+        if local > 0 and quorum > 0:
+            metrics["journal_quorum_overhead_x"] = round(quorum / local, 3)
+    finally:
+        if prior is None:
+            os.environ.pop("MODAL_TPU_JOURNAL_REPLICAS", None)
+        else:
+            os.environ["MODAL_TPU_JOURNAL_REPLICAS"] = prior
+    return metrics
+
+
 async def run_bench(args) -> dict:
     from modal_tpu.client import _Client
     from modal_tpu.server.shards import ShardedSupervisor
 
+    # replication A/B first: its two small fleets must not share CPU with the
+    # main load (quorum overhead is a latency ratio — contamination skews it)
+    replication_metrics = await _bench_replication(args)
     state_dir = tempfile.mkdtemp(prefix="bench-control-")
     os.environ["MODAL_TPU_STATE_DIR"] = state_dir
     sup = ShardedSupervisor(
@@ -328,6 +426,7 @@ async def run_bench(args) -> dict:
             "takeover_log": sup.takeover_log,
             "total_s": round(total_s, 2),
             **federation_metrics,
+            **replication_metrics,
         }
     finally:
         await client._close()

@@ -144,6 +144,24 @@ NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
     "ShardControlResponse": [
         ("payload_json", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
     ],
+    # Quorum journal replication (ISSUE 19, server/replication.py): a
+    # writer shard streams its journal appends to follower shards; every
+    # message carries the writer's fleet epoch as a fencing token. kind:
+    # append (payload_json = list of record lines) | snapshot (payload_json
+    # = compacted snapshot lines, base_seq = covered seq) | seal (fence the
+    # stream at its replicated max-seq under a takeover epoch) | status.
+    # The response payload is JSON ({ok, last_seq, epoch, error}) — the
+    # shape evolves with the protocol, like ShardControl's.
+    "JournalReplicateRequest": [
+        ("kind", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("writer_shard", 2, F.TYPE_INT32, F.LABEL_OPTIONAL, ""),
+        ("epoch", 3, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
+        ("base_seq", 4, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
+        ("payload_json", 5, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+    ],
+    "JournalReplicateResponse": [
+        ("payload_json", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+    ],
 }
 
 # (message, field_name, field_number, field_type) — optionally a 5-tuple with
