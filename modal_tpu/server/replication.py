@@ -46,6 +46,7 @@ import json
 import os
 import shutil
 import time
+from collections import deque
 from typing import Any, Callable, Optional
 
 from ..config import logger
@@ -59,10 +60,22 @@ from ..observability.catalog import (
 from .journal import JOURNAL_DIRNAME, Journal, _read_records
 
 REPLICA_DIRNAME = "replica"
+# durable writer identity (incarnation counter + last adopted fleet epoch),
+# next to — not inside — the journal dir: it must survive the chaos soak's
+# journal-dir deletion so a respawned writer keeps a monotonic incarnation
+WRITER_META_FILENAME = "journal-writer.json"
 
 # one replication append batch is bounded so a catch-up after a long
 # partition cannot ship an unbounded payload in one RPC
 APPEND_BATCH_MAX_RECORDS = 512
+
+# writer-side in-memory replication buffer cap: one unreachable-but-not-yet-
+# dead follower must not pin the buffer floor and grow it without bound —
+# a follower evicted past the cap catches up from the journal's on-disk
+# snapshot + segments instead (the sender's _catch_up path). Far larger than
+# any group-commit batch, so evicted entries are always flushed to disk and
+# therefore visible to tail_lines().
+BUFFER_MAX_RECORDS = 4096
 
 
 def replicas_configured() -> int:
@@ -144,6 +157,7 @@ class _Stream:
         self.sealed_epoch = 0
         self.sealed_seq = 0
         self.snapshot_seq = 0
+        self.writer_inc = 0  # highest writer incarnation seen on this stream
         self.last_seq = 0
         self.valid_offset = 0  # byte offset of the last COMPLETE record line
         self._fh = None
@@ -162,6 +176,7 @@ class _Stream:
             self.sealed_epoch = int(meta.get("sealed_epoch", 0))
             self.sealed_seq = int(meta.get("sealed_seq", 0))
             self.snapshot_seq = int(meta.get("snapshot_seq", 0))
+            self.writer_inc = int(meta.get("writer_inc", 0))
         except (OSError, ValueError):
             pass
         self.last_seq = self.snapshot_seq
@@ -196,6 +211,7 @@ class _Stream:
                     "sealed_epoch": self.sealed_epoch,
                     "sealed_seq": self.sealed_seq,
                     "snapshot_seq": self.snapshot_seq,
+                    "writer_inc": self.writer_inc,
                     "last_seq": self.last_seq,
                 },
                 f,
@@ -217,6 +233,29 @@ class _Stream:
         self._fh.seek(self.valid_offset)
         self._fh.truncate(self.valid_offset)
         return self._fh
+
+    def truncate_to(self, limit: int) -> None:
+        """Drop every record with seq > `limit` — the phantom tail a
+        crash-restarted writer streamed to us but lost locally before its
+        own flush. Keeping it would desync the streams permanently: the
+        writer re-mints those seqs with DIFFERENT records, and seq-dedupe
+        would silently swallow them."""
+        self.close()
+        kept: list[str] = []
+        max_kept = self.snapshot_seq
+        for rec in _read_records(self.records_path):
+            seq = int(rec.get("seq", 0))
+            if seq > limit:
+                continue
+            kept.append(json.dumps(rec, separators=(",", ":")) + "\n")
+            max_kept = max(max_kept, seq)
+        with open(self.records_path, "w") as f:
+            f.writelines(kept)
+            f.flush()
+            os.fsync(f.fileno())
+        self.valid_offset = sum(len(line.encode()) for line in kept)
+        self.last_seq = max_kept
+        self.persist_meta()
 
     def close(self) -> None:
         if self._fh is not None:
@@ -284,7 +323,42 @@ class ReplicaStore:
                 pass
         self._streams.pop(writer, None)
 
-    def append(self, writer: int, epoch: int, lines: list[str]) -> dict:
+    def _check_incarnation(
+        self, writer: int, st: _Stream, incarnation: int, boot_seq: int
+    ) -> Optional[dict]:
+        """Writer-restart divergence guard (runs AFTER the epoch fence, so a
+        stale-epoch undead writer can never trigger a truncation). A new
+        incarnation means the writer process restarted and replayed its
+        journal to `boot_seq`: any tail we hold past that is a phantom the
+        writer lost before its own flush — truncate it, or the writer's
+        re-minted seqs would be seq-deduped away and the streams diverge
+        silently. incarnation=0 (pre-incarnation peer / direct store use)
+        skips tracking entirely."""
+        if not incarnation:
+            return None
+        if incarnation < st.writer_inc:
+            return self._reject(writer, st, "stale_incarnation")
+        if incarnation > st.writer_inc:
+            limit = max(boot_seq, st.snapshot_seq)
+            if st.last_seq > limit:
+                logger.warning(
+                    f"replica stream of writer {writer}: truncating phantom tail "
+                    f"{limit + 1}..{st.last_seq} (writer incarnation {incarnation} "
+                    f"replayed only to {boot_seq})"
+                )
+                st.truncate_to(limit)
+            st.writer_inc = incarnation
+            st.persist_meta()
+        return None
+
+    def append(
+        self,
+        writer: int,
+        epoch: int,
+        lines: list[str],
+        incarnation: int = 0,
+        boot_seq: int = 0,
+    ) -> dict:
         """Durably append a batch of record lines from `writer` at `epoch`.
         Duplicates (seq <= last_seq: resends after a dropped ack) are
         skipped; a gap (first new seq > last_seq+1: this follower missed
@@ -295,6 +369,9 @@ class ReplicaStore:
         if rejected is not None:
             return rejected
         st = self._stream(writer)  # _check_epoch may have reset the stream
+        rejected = self._check_incarnation(writer, st, incarnation, boot_seq)
+        if rejected is not None:
+            return rejected
         chaos = self.chaos
         if chaos is not None and chaos.consume_knob("repl_disk_full"):
             return self._reject(writer, st, "disk_full")
@@ -337,7 +414,15 @@ class ReplicaStore:
             return {"ok": False, "error": "ack_dropped", "last_seq": st.last_seq, "epoch": st.epoch}
         return {"ok": True, "last_seq": st.last_seq, "epoch": st.epoch}
 
-    def install_snapshot(self, writer: int, epoch: int, covered_seq: int, lines: list[str]) -> dict:
+    def install_snapshot(
+        self,
+        writer: int,
+        epoch: int,
+        covered_seq: int,
+        lines: list[str],
+        incarnation: int = 0,
+        boot_seq: int = 0,
+    ) -> dict:
         """Adopt the writer's compacted snapshot (shipped before the writer
         prunes segments, and during catch-up when a follower's gap predates
         the writer's retained history): replaces any records it covers."""
@@ -346,6 +431,9 @@ class ReplicaStore:
         if rejected is not None:
             return rejected
         st = self._stream(writer)
+        rejected = self._check_incarnation(writer, st, incarnation, boot_seq)
+        if rejected is not None:
+            return rejected
         if covered_seq <= st.snapshot_seq:
             return {"ok": True, "last_seq": st.last_seq, "epoch": st.epoch}
         tmp = st.snapshot_path + ".tmp"
@@ -400,6 +488,7 @@ class ReplicaStore:
             "sealed_epoch": st.sealed_epoch,
             "sealed_seq": st.sealed_seq,
             "snapshot_seq": st.snapshot_seq,
+            "incarnation": st.writer_inc,
         }
 
     def status_all(self) -> list[dict]:
@@ -478,9 +567,26 @@ class JournalReplicator:
         self.timeout_s = quorum_timeout_s()
         self.chaos = chaos
         self.epoch = 1
+        # writer identity across restarts: `incarnation` bumps durably on
+        # every journal open and `boot_seq` is the seq this incarnation
+        # replayed to — followers truncate any phantom tail past boot_seq on
+        # first contact with a new incarnation, so a kill -9 that loses the
+        # writer's buffered tail cannot silently desync the streams. The
+        # last adopted fleet epoch persists alongside it: restarting at
+        # epoch=1 after any prior takeover would otherwise get every append
+        # stale_epoch-rejected (and the shard permanently fenced) until the
+        # next director probe delivers the fleet epoch.
+        self.incarnation = 1
+        self.boot_seq = journal.seq
+        if self.replicas > 0:
+            meta = self._load_writer_meta()
+            self.incarnation = int(meta.get("incarnation", 0)) + 1
+            self.epoch = max(1, int(meta.get("epoch", 1)))
+            self._persist_writer_meta()  # durable BEFORE any append ships
         self.fenced = False  # a follower rejected our epoch: stop committing
         self.acked: dict[int, int] = {}  # follower shard -> replicated seq
-        self._buffer: list[tuple[int, str, float]] = []  # (seq, line, appended_at)
+        self.buffer_max = BUFFER_MAX_RECORDS
+        self._buffer: deque[tuple[int, str, float]] = deque()  # (seq, line, appended_at)
         self._wake: list[asyncio.Event] = []
         self._ack_event: Optional[asyncio.Event] = None
         self._flush_lock = asyncio.Lock()
@@ -492,12 +598,47 @@ class JournalReplicator:
 
     # -- config ------------------------------------------------------------
 
+    def _writer_meta_path(self) -> str:
+        return os.path.join(self.state_dir, WRITER_META_FILENAME)
+
+    def _load_writer_meta(self) -> dict:
+        try:
+            with open(self._writer_meta_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _persist_writer_meta(self) -> None:
+        path = self._writer_meta_path()
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"incarnation": self.incarnation, "epoch": self.epoch}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as exc:
+            logger.warning(f"journal writer meta persistence failed: {exc}")
+
     def note_epoch(self, epoch: int) -> None:
         """Adopt the fleet epoch (director health probes / takeover adopt):
         appends are stamped with it, so followers can fence our stale
-        incarnations after WE are the ones taken over."""
+        incarnations after WE are the ones taken over. Adopting a strictly
+        higher epoch UN-fences: the director only probes shards that still
+        own partitions, so a delivered fleet epoch is its statement that we
+        are (again) the legitimate writer — staying fenced would turn one
+        transient stale-epoch rejection into a permanent outage. Persisted,
+        so a crash-restart resumes at the adopted epoch instead of 1."""
         if epoch > self.epoch:
             self.epoch = epoch
+            if self.fenced:
+                logger.warning(
+                    f"journal writer shard {self.shard_index} un-fenced: "
+                    f"director delivered fleet epoch {epoch}"
+                )
+                self.fenced = False
+            if self.replicas > 0:
+                self._persist_writer_meta()
 
     def current_followers(self) -> list[tuple[int, str]]:
         """The first `replicas` live peers in ring order after this shard —
@@ -523,6 +664,10 @@ class JournalReplicator:
         self._buffer.append(
             (int(payload.get("seq", 0)), line.rstrip("\n"), time.monotonic())
         )
+        # hard cap even with zero acks (every follower unreachable): evicted
+        # followers fall back to the sender's disk catch-up path
+        while len(self._buffer) > self.buffer_max:
+            self._buffer.popleft()
         for ev in self._wake:
             ev.set()
 
@@ -676,11 +821,14 @@ class JournalReplicator:
 
     def _trim_buffer(self) -> None:
         followers = [idx for idx, _ in self.current_followers()]
-        if not followers:
-            return
-        floor = min(self.acked.get(idx, 0) for idx in followers)
-        while self._buffer and self._buffer[0][0] <= floor:
-            self._buffer.pop(0)
+        if followers:
+            floor = min(self.acked.get(idx, 0) for idx in followers)
+            while self._buffer and self._buffer[0][0] <= floor:
+                self._buffer.popleft()
+        # a slow-but-alive follower must not pin the floor and grow the
+        # buffer without bound: past the cap it is evicted to disk catch-up
+        while len(self._buffer) > self.buffer_max:
+            self._buffer.popleft()
 
     def _pending_for(self, acked_seq: int) -> list[tuple[int, str, float]]:
         return [entry for entry in self._buffer if entry[0] > acked_seq]
@@ -797,6 +945,14 @@ class JournalReplicator:
             )
 
     def _handle_result(self, idx: int, result: dict) -> None:
+        if result.get("error") == "stale_incarnation":
+            # a follower tracked a NEWER incarnation of us than we are — our
+            # durable writer meta was lost (full state-dir loss). Never ack
+            # against such a follower; the next takeover/seal resolves it.
+            logger.warning(
+                f"journal writer shard {self.shard_index} incarnation "
+                f"{self.incarnation} refused by follower {idx}: writer meta lost?"
+            )
         if result.get("error") == "stale_epoch":
             # a follower sealed our stream at a higher epoch: a successor
             # already owns this partition — structurally stop committing
@@ -827,6 +983,8 @@ class JournalReplicator:
             epoch=int(fields["epoch"]),
             base_seq=int(fields.get("base_seq", 0)),
             payload_json=fields.get("payload_json", ""),
+            incarnation=self.incarnation,
+            boot_seq=self.boot_seq,
         )
         server = local_transport.resolve_local_server(url)
         if server is not None:
@@ -856,6 +1014,7 @@ class JournalReplicator:
         return {
             "replicas": self.replicas,
             "epoch": self.epoch,
+            "incarnation": self.incarnation,
             "fenced": self.fenced,
             "quorum_acks_needed": min(quorum_acks_needed(self.replicas), len(followers))
             if followers

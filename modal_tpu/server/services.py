@@ -1331,10 +1331,21 @@ class ModalTPUServicer:
         # append path must not re-encode/re-parse what is already JSONL
         lines = request.payload_json.split("\n") if request.payload_json else []
         if kind == "append":
-            result = store.append(request.writer_shard, request.epoch, lines)
+            result = store.append(
+                request.writer_shard,
+                request.epoch,
+                lines,
+                incarnation=request.incarnation,
+                boot_seq=request.boot_seq,
+            )
         elif kind == "snapshot":
             result = store.install_snapshot(
-                request.writer_shard, request.epoch, request.base_seq, lines
+                request.writer_shard,
+                request.epoch,
+                request.base_seq,
+                lines,
+                incarnation=request.incarnation,
+                boot_seq=request.boot_seq,
             )
         elif kind == "seal":
             result = store.seal(request.writer_shard, request.epoch)

@@ -722,9 +722,19 @@ class ShardedSupervisor:
             try:
                 replica_successor = await self._pick_replica_successor(dead_index)
                 if replica_successor is not None:
-                    successor, holders = replica_successor
-                    for holder in holders:
-                        await self._replica_call(holder, "seal", dead_index, epoch)
+                    successor = replica_successor
+                    # seal the dead writer's stream on EVERY live shard, not
+                    # just the holders found above: a survivor with no stream
+                    # yet (unreachable during discovery, or a fresh peer the
+                    # undead writer would later adopt via install_snapshot at
+                    # its old epoch) must also refuse post-seal appends —
+                    # seal() mints an empty sealed stream where none exists,
+                    # so the partitioned old writer can't rebuild a quorum
+                    # from non-holders.
+                    for peer in range(self.num_shards):
+                        if peer == dead_index or self.dead[peer] or not self.shard_urls[peer]:
+                            continue
+                        await self._replica_call(peer, "seal", dead_index, epoch)
                     phases["seal"] = round(time.time(), 3)
                     report = await self._adopt_replica(successor, dead_index, epoch)
                     mode = "replica"
@@ -831,15 +841,17 @@ class ShardedSupervisor:
         except (grpc.aio.AioRpcError, ValueError, asyncio.TimeoutError):
             return {"ok": False, "error": "unreachable"}
 
-    async def _pick_replica_successor(self, dead_index: int) -> Optional[tuple[int, list[int]]]:
-        """(successor, every surviving stream holder) for a quorum takeover:
-        the survivor with the HIGHEST replicated seq of the dead writer wins
-        (it holds everything any quorum ever acked), ring order breaks ties
-        so the choice matches _pick_successor when replicas are in lockstep.
-        None when no survivor holds a stream — the caller falls back to the
-        corpse's own journal directory."""
-        candidates: list[tuple[int, int, int]] = []  # (last_seq, -ring_off, shard)
-        holders: list[int] = []
+    async def _pick_replica_successor(self, dead_index: int) -> Optional[int]:
+        """The survivor adopting the dead writer's partition in a quorum
+        takeover: highest writer INCARNATION first (a follower that heard a
+        restarted writer truncated the prior incarnation's phantom tail, so
+        its log is strictly newer than a higher-seq phantom on a stale
+        follower), then highest replicated seq (everything any quorum ever
+        acked), ring order breaking ties so the choice matches
+        _pick_successor when replicas are in lockstep. None when no survivor
+        holds a stream — the caller falls back to the corpse's own journal
+        directory."""
+        candidates: list[tuple[int, int, int, int]] = []  # (inc, last_seq, -ring_off, shard)
         for off in range(1, self.num_shards):
             cand = (dead_index + off) % self.num_shards
             if self.dead[cand] or not self.shard_urls[cand]:
@@ -847,12 +859,13 @@ class ShardedSupervisor:
             status = await self._replica_call(cand, "status", dead_index)
             if not status.get("ok"):
                 continue
-            holders.append(cand)
-            candidates.append((int(status.get("last_seq", 0)), -off, cand))
+            candidates.append(
+                (int(status.get("incarnation", 0)), int(status.get("last_seq", 0)), -off, cand)
+            )
         if not candidates:
             return None
         candidates.sort(reverse=True)
-        return candidates[0][2], holders
+        return candidates[0][3]
 
     async def _adopt_replica(self, successor: int, dead_index: int, epoch: int) -> dict:
         if self.subprocess_shards:

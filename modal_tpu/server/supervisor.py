@@ -164,8 +164,14 @@ class LocalSupervisor:
 
         if (self.replication_peers is None and not self.fleet_root) or replicas_configured() == 0:
             return
+        # follower durability must match the configured journal durability:
+        # with MODAL_TPU_JOURNAL_FSYNC=1 a quorum "durably appended" ack has
+        # to mean fsynced on the follower too, not just page-cached
         self.replica_store = ReplicaStore(
-            self.state_dir, chaos=self.chaos, on_fence_rejection=self._note_fence_rejection
+            self.state_dir,
+            fsync=journal.fsync,
+            chaos=self.chaos,
+            on_fence_rejection=self._note_fence_rejection,
         )
         peers = self.replication_peers or self._peers_from_fleet_root
         replicator = JournalReplicator(

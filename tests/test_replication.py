@@ -167,6 +167,74 @@ def test_fence_rejection_callback_fires(tmp_path):
     assert seen == [1, 1]
 
 
+# -- ReplicaStore: writer incarnation (crash-restart divergence guard) ---------
+
+
+def test_new_incarnation_truncates_phantom_tail(tmp_path):
+    """A kill -9 can lose the writer's buffered tail while followers keep it:
+    the restarted writer replays to boot_seq and re-mints later seqs with
+    DIFFERENT records. Deduping purely by seq would swallow them silently —
+    the follower must truncate the phantom tail when it first sees the new
+    incarnation."""
+    store = ReplicaStore(str(tmp_path))
+    try:
+        assert store.append(0, 1, [_rec(i) for i in range(1, 6)], incarnation=1)["ok"]
+        # writer crash-restarted having durably replayed only to seq 3:
+        # seqs 4..5 on this follower are phantoms the writer lost
+        r = store.append(0, 1, [_rec(4, reminted=True)], incarnation=2, boot_seq=3)
+        assert r["ok"] and r["last_seq"] == 4
+        assert store.status(0)["incarnation"] == 2
+    finally:
+        store.close()
+    recs = _records_on_disk(str(tmp_path), 0)
+    assert [x["seq"] for x in recs] == [1, 2, 3, 4]
+    assert recs[3].get("reminted"), "re-minted seq 4 was seq-deduped against a phantom"
+
+
+def test_incarnation_truncation_survives_follower_restart(tmp_path):
+    store = ReplicaStore(str(tmp_path))
+    store.append(1, 1, [_rec(i) for i in range(1, 4)], incarnation=1)
+    store.append(1, 1, [_rec(2, reminted=True)], incarnation=2, boot_seq=1)
+    store.close()
+    reopened = ReplicaStore(str(tmp_path))
+    try:
+        st = reopened.status(1)
+        assert st["incarnation"] == 2 and st["last_seq"] == 2
+        # the repeat of the SAME incarnation must not truncate again
+        assert reopened.append(1, 1, [_rec(3)], incarnation=2, boot_seq=1)["last_seq"] == 3
+    finally:
+        reopened.close()
+    assert [x["seq"] for x in _records_on_disk(str(tmp_path), 1)] == [1, 2, 3]
+
+
+def test_stale_incarnation_is_rejected(tmp_path):
+    store = ReplicaStore(str(tmp_path))
+    try:
+        assert store.append(0, 1, [_rec(1)], incarnation=3, boot_seq=0)["ok"]
+        r = store.append(0, 1, [_rec(2)], incarnation=2, boot_seq=0)
+        assert r == {"ok": False, "error": "stale_incarnation", "last_seq": 1, "epoch": 1}
+        # incarnation=0 (pre-incarnation peer / direct store use): no tracking
+        assert store.append(0, 1, [_rec(2)])["ok"]
+    finally:
+        store.close()
+
+
+def test_stale_epoch_never_triggers_truncation(tmp_path):
+    """Fencing order matters: a partitioned undead writer that crash-restarts
+    (bumping its incarnation) but still carries its pre-takeover epoch must be
+    refused BEFORE the incarnation logic can touch the stream."""
+    store = ReplicaStore(str(tmp_path))
+    try:
+        assert store.append(0, 5, [_rec(1), _rec(2)], incarnation=1)["ok"]
+        r = store.append(0, 4, [_rec(1, undead=True)], incarnation=2, boot_seq=0)
+        assert r["error"] == "stale_epoch"
+        assert store.status(0)["last_seq"] == 2, "stale-epoch append truncated the stream"
+        assert store.status(0)["incarnation"] == 1
+    finally:
+        store.close()
+    assert [x["seq"] for x in _records_on_disk(str(tmp_path), 0)] == [1, 2]
+
+
 # -- ReplicaStore: torn tail + chaos faults ------------------------------------
 
 
@@ -390,6 +458,61 @@ async def test_observe_trims_buffer_to_slowest_follower(tmp_path):
     assert [seq for seq, _, _ in repl._buffer] == [4, 5]
 
 
+async def test_buffer_is_capped_despite_unreachable_follower(tmp_path):
+    """One unreachable-but-not-yet-dead follower pins the min-acked floor at
+    0; the buffer must still be bounded — the slow follower is evicted to the
+    disk catch-up path instead of growing writer memory without limit."""
+    repl = _replicator(tmp_path, [(1, "u1"), (2, "u2")], seq=0)
+    repl._ack_event = asyncio.Event()
+    repl.buffer_max = 3
+    for seq in range(1, 8):
+        repl.journal.seq = seq
+        repl.observe({"seq": seq, "rpc": "TestOp"})
+    assert [seq for seq, _, _ in repl._buffer] == [5, 6, 7], "buffer grew past the cap"
+    # follower 2 acks within the retained window; follower 1 never acks —
+    # the ack-path trim must keep the cap too
+    repl._handle_result(2, {"ok": True, "last_seq": 6})
+    assert len(repl._buffer) <= 3
+    # the evicted follower reads as behind the buffer floor → disk catch-up
+    assert repl._buffer[0][0] > repl.acked.get(1, 0) + 1
+
+
+# -- writer meta: incarnation + epoch survive a crash-restart -------------------
+
+
+def test_writer_meta_bumps_incarnation_and_restores_epoch(tmp_path):
+    repl = _replicator(tmp_path, [(1, "u1")], seq=5)
+    assert repl.incarnation == 1 and repl.boot_seq == 5
+    repl.note_epoch(7)
+    # crash-restart: a new replicator on the same state dir is a NEW
+    # incarnation and resumes at the adopted fleet epoch, not epoch 1 —
+    # restarting at 1 would get every append stale_epoch-rejected (and the
+    # shard permanently fenced) until the next director probe
+    reborn = _replicator(tmp_path, [(1, "u1")], seq=3)
+    assert reborn.incarnation == 2
+    assert reborn.epoch == 7
+    assert reborn.boot_seq == 3
+
+
+def test_note_epoch_clears_fence_on_strictly_higher_epoch(tmp_path):
+    repl = _replicator(tmp_path, [(1, "u1")], seq=1)
+    repl._handle_result(1, {"ok": False, "error": "stale_epoch", "epoch": 9})
+    assert repl.fenced is True
+    repl.note_epoch(repl.epoch)  # same epoch: not an un-fence authority
+    assert repl.fenced is True
+    repl.note_epoch(repl.epoch + 1)  # the director re-adopted us
+    assert repl.fenced is False
+
+
+def test_writer_meta_skipped_when_replication_off(tmp_path):
+    from modal_tpu.server.replication import WRITER_META_FILENAME
+
+    _replicator(tmp_path, [(1, "u1")], replicas=0)
+    assert not os.path.exists(os.path.join(str(tmp_path), WRITER_META_FILENAME)), (
+        "replicas=0 must stay byte-identical: no writer meta file"
+    )
+
+
 # -- replicas=0 byte-identical degradation -------------------------------------
 
 
@@ -411,6 +534,31 @@ def test_replicas_zero_is_byte_identical_no_quorum_wrapper(tmp_path, monkeypatch
         return "resp"
 
     assert _maybe_quorum(_Servicer(), _Method(), impl) is impl
+
+
+async def test_replica_store_inherits_journal_fsync(tmp_path, monkeypatch):
+    """MODAL_TPU_JOURNAL_FSYNC must govern BOTH sides of a quorum: a
+    follower's "durably appended" ack is a lie if the writer fsyncs and the
+    replica store only reaches the page cache."""
+    from modal_tpu.server.supervisor import LocalSupervisor
+
+    monkeypatch.delenv("MODAL_TPU_JOURNAL_REPLICAS", raising=False)
+    monkeypatch.setenv("MODAL_TPU_JOURNAL_FSYNC", "1")
+    sup = LocalSupervisor(
+        num_workers=0,
+        state_dir=str(tmp_path / "state"),
+        worker_chips=8,
+        worker_tpu_type="local-sim",
+        replication_peers=lambda: [(1, "grpc://127.0.0.1:1")],
+    )
+    sup._attach_journal()
+    journal = sup.state.journal
+    try:
+        assert journal.fsync is True
+        assert sup.replica_store is not None and sup.replica_store.fsync is True
+    finally:
+        await sup._stop_replication()
+        journal.close()
 
 
 async def test_replicas_zero_supervisor_has_no_replication(tmp_path, monkeypatch):
@@ -523,15 +671,17 @@ def test_kill_and_delete_journal_dir_replica_takeover(sharded, tmp_path):
     assert entry["report"]["records_applied"] > 0, "replica adoption replayed nothing"
     assert "seal" in entry["phases"], "replica takeover skipped the seal phase"
 
-    # the sealed stream fences the dead writer's epoch on every holder
+    # the seal lands on EVERY live shard — a survivor without a stream gets
+    # an empty sealed one, so the undead writer can't rebuild a quorum from
+    # shards the takeover never discovered as holders
     epoch = sharded.epoch
     for i in range(3):
         if i == home or sharded.shards[i] is None:
             continue
         store = sharded.shards[i].replica_store
         st = store.status(home)
-        if st.get("ok"):
-            assert st["sealed_epoch"] == epoch
+        assert st["ok"], f"survivor {i} holds no sealed stream of dead writer {home}"
+        assert st["sealed_epoch"] == epoch
 
     with app.run():
         results = sorted(f.map(range(10)))

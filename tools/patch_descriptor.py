@@ -152,12 +152,20 @@ NEW_MESSAGES: dict[str, list[tuple[str, int, int, int, str]]] = {
     # stream at its replicated max-seq under a takeover epoch) | status.
     # The response payload is JSON ({ok, last_seq, epoch, error}) — the
     # shape evolves with the protocol, like ShardControl's.
+    # incarnation/boot_seq identify the writer PROCESS generation: the
+    # incarnation counter bumps durably on every journal open, and boot_seq
+    # is the seq the restarted writer replayed to — a follower seeing a new
+    # incarnation truncates any tail past boot_seq (records the crashed
+    # writer buffered to us but lost locally), so streams cannot silently
+    # diverge across a writer crash-restart.
     "JournalReplicateRequest": [
         ("kind", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
         ("writer_shard", 2, F.TYPE_INT32, F.LABEL_OPTIONAL, ""),
         ("epoch", 3, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
         ("base_seq", 4, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
         ("payload_json", 5, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
+        ("incarnation", 6, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
+        ("boot_seq", 7, F.TYPE_INT64, F.LABEL_OPTIONAL, ""),
     ],
     "JournalReplicateResponse": [
         ("payload_json", 1, F.TYPE_STRING, F.LABEL_OPTIONAL, ""),
