@@ -927,6 +927,87 @@ def _run_control_bench(timeout_s: float) -> dict | None:
     )
 
 
+def _run_compile_bench(timeout_s: float) -> dict | None:
+    """tools/bench_compile.py: cold-fleet rollout against a primed
+    compile-cache store (ISSUE 20 acceptance: zero in-container compiles)
+    plus the donated-vs-undonated train-step A/B."""
+    return _run_microbench("compile", "bench_compile.py", "COMPILE_BENCH_RESULT", timeout_s)
+
+
+def _compile_regression_guard(cmp_: dict) -> None:
+    """ISSUE 20 satellite: the primed-store rollout must stay compile-free
+    (an absolute bar — any primed-run miss means cross-host keys diverged
+    again) and primed_run_s / donated_step_ms are tolerance-checked against
+    BENCH_compile.json with the same >1.5x discipline as the dispatch floor.
+    A clean run rewrites the baseline; a regressed one keeps the old numbers
+    so the flag stays red until the floor is recovered."""
+    path = os.path.join(REPO_ROOT, "BENCH_compile.json")
+    baseline = None
+    try:
+        with open(path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        pass
+    regression = False
+    if not cmp_.get("zero_compile_rollout"):
+        regression = True
+        sys.stderr.write(
+            f"bench[compile]: PRIMED ROLLOUT RECOMPILED — misses="
+            f"{cmp_.get('primed_misses')} puts={cmp_.get('primed_puts')} "
+            f"(fleet keys diverged or the tier failed to install)\n"
+        )
+    primed = cmp_.get("primed_run_s")
+    donated = cmp_.get("donated_step_ms")
+    speedup = cmp_.get("donation_speedup_x")
+    # the donated in-place loop must never be materially slower than the
+    # copying one (CPU understates the win; it must not hide a loss)
+    if speedup is not None and speedup < 1.0 / DISPATCH_REGRESSION_FACTOR:
+        regression = True
+        sys.stderr.write(
+            f"bench[compile]: DONATION SLOWDOWN {speedup:.3f}x vs undonated step\n"
+        )
+    if baseline is not None:
+        base_primed = baseline.get("primed_run_s")
+        if base_primed and primed and primed > base_primed * DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[compile]: REGRESSION primed rollout {primed:.2f}s "
+                f"vs baseline {base_primed:.2f}s\n"
+            )
+        base_donated = baseline.get("donated_step_ms")
+        if base_donated and donated and donated > base_donated * DISPATCH_REGRESSION_FACTOR:
+            regression = True
+            sys.stderr.write(
+                f"bench[compile]: REGRESSION donated step {donated:.1f}ms "
+                f"vs baseline {base_donated:.1f}ms\n"
+            )
+    if _BANK["best"] is not None:
+        _BANK["best"]["compile_regression"] = regression
+    if not regression:
+        try:
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "first_run_s": cmp_.get("first_run_s"),
+                        "primed_run_s": primed,
+                        "primed_speedup_x": cmp_.get("primed_speedup_x"),
+                        "primed_hits": cmp_.get("primed_hits"),
+                        "primed_misses": cmp_.get("primed_misses"),
+                        "primed_puts": cmp_.get("primed_puts"),
+                        "zero_compile_rollout": cmp_.get("zero_compile_rollout"),
+                        "donated_step_ms": donated,
+                        "undonated_step_ms": cmp_.get("undonated_step_ms"),
+                        "donation_speedup_x": speedup,
+                        "written_at": time.time(),
+                    },
+                    f,
+                    indent=1,
+                )
+                f.write("\n")
+        except OSError as exc:
+            sys.stderr.write(f"bench[compile]: baseline write failed: {exc}\n")
+
+
 def _control_regression_guard(ctl: dict) -> None:
     """ISSUE 16 satellite: control_placement_p99_s / control_takeover_s
     (lower is better) and control_calls_per_s (higher is better) recorded in
@@ -1460,6 +1541,17 @@ def _orchestrate() -> None:
                 key = k if k.startswith("control_") else f"control_{k}"
                 _BANK["best"][key] = v
             _control_regression_guard(ctl)
+    # Phase 2.97: fleet compile-cache microbench (tools/bench_compile.py):
+    # cold-fleet rollout against a primed store (ISSUE 20 acceptance: zero
+    # in-container compiles, by counters) + the donation A/B — compile_*
+    # fields + BENCH_compile.json regression guard.
+    if not fake_mode and os.environ.get("MODAL_TPU_BENCH_COMPILE", "1") == "1" and _remaining() > 120:
+        cmp_ = _run_compile_bench(min(240.0, _remaining()))
+        if cmp_ is not None and _BANK["best"] is not None:
+            for k, v in cmp_.items():
+                key = k if k.startswith("compile_") else f"compile_{k}"
+                _BANK["best"][key] = v
+            _compile_regression_guard(cmp_)
     # Phase 3: poll the relay for a bounded window (never against our own
     # total deadline — the round-3 killer), attempting TPU whenever it answers.
     while (

@@ -1,0 +1,62 @@
+"""Fleet compile-cache key scheme (ISSUE 20) — shared by the server store
+(server/compile_cache.py) and the runtime client (runtime/compile_client.py),
+which must agree byte-for-byte on canonical key form or lookups silently
+miss.
+
+Two key families share one flat namespace:
+
+- **jax-native keys**: the persistent-cache filenames jax mints itself —
+  already a digest of (serialized StableHLO module, jaxlib version,
+  backend, compile options incl. device topology). Runtime hits/puts and
+  the prewarm publisher use these verbatim, so no digest is ever
+  recomputed.
+- **``xc-<sha256>``**: :func:`compile_cache_key` for out-of-band producers
+  (tests, foreign toolchains) — same digest contract, explicit fields,
+  prefixed so the families can never collide.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+# keys land on a shared filesystem: one flat namespace, no separators
+_KEY_UNSAFE = re.compile(r"[^A-Za-z0-9._=-]")
+_MAX_KEY_LEN = 240  # under common 255-byte filename limits with sidecar suffix
+
+
+def sanitize_key(key: str) -> str:
+    """Filesystem/URL-safe canonical form of a cache key; '' for keys that
+    sanitize to nothing (those can never round-trip → treated as misses)."""
+    safe = _KEY_UNSAFE.sub("_", str(key))[:_MAX_KEY_LEN]
+    return "" if safe.strip("._") == "" else safe
+
+
+def compile_cache_key(
+    module_bytes,
+    jax_version: str,
+    jaxlib_version: str,
+    backend: str,
+    topology: str = "",
+) -> str:
+    """Content digest over everything that makes a compiled executable
+    reusable — the serialized HLO/StableHLO module plus the compiler
+    identity (jax/jaxlib versions, backend platform, device topology). Two
+    producers that agree on these five fields may share an executable; any
+    mismatch yields a different key, so a stale jaxlib can never be served
+    another version's binary."""
+    if isinstance(module_bytes, str):
+        module_bytes = module_bytes.encode()
+    h = hashlib.sha256()
+    for part in (module_bytes, jax_version, jaxlib_version, backend, topology):
+        data = part if isinstance(part, bytes) else str(part).encode()
+        # length-prefix each field so ("ab","c") can't collide with ("a","bc")
+        h.update(len(data).to_bytes(8, "big"))
+        h.update(data)
+    return f"xc-{h.hexdigest()}"
+
+
+def entry_digest(data: bytes) -> str:
+    """The integrity digest stored in the store's ``<key>.sha256`` sidecar
+    and echoed on GETs as ``X-Content-SHA256``."""
+    return hashlib.sha256(data).hexdigest()

@@ -344,7 +344,7 @@ def register(p: AnalysisPass) -> AnalysisPass:
 
 def all_passes() -> list[AnalysisPass]:
     # importing the pass modules populates the registry
-    from . import concurrency, jit_purity, knobs  # noqa: F401
+    from . import concurrency, donation, jit_purity, knobs  # noqa: F401
 
     return list(_REGISTRY)
 

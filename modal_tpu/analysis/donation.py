@@ -1,0 +1,283 @@
+"""donation-audit pass: carried-state jit arguments that aren't donated,
+and use-after-donate at call sites (ISSUE 20 tentpole e).
+
+A jitted step that threads state through itself — takes ``cache``/``state``,
+rebinds it, returns it under the same name (or as ``name._replace(...)``) —
+holds TWO copies of that state live unless the input is donated: the dead
+input buffer and the new output. For the KV cache and optimizer state these
+are the largest allocations in the program, so a missing ``donate_argnums``
+silently doubles peak HBM for the hot path. The flip side is worse: donating
+and then *touching the donated variable after the call* raises at runtime
+(deleted buffer) only on backends that honor donation — i.e. in production,
+not in CPU tests.
+
+The pre-audit repo had real instances of both halves of this rule:
+``models/sampling.prefill`` carried the cache undonated, and the
+``paged_kv`` table-maintenance steps (``copy_page`` — a full pool copy per
+CoW fault) did too. Those are FIXED, not baselined; this pass keeps them
+fixed.
+
+Heuristics (deliberately conservative — zero false-positive budget):
+
+- only decorator-form jit targets are audited (call-form wrapping is
+  usually immediately invoked and short-lived);
+- a param is *carried* when it is rebound somewhere in the body AND a
+  return value mentions it by name, or a return value is
+  ``<param>._replace(...)`` / ``<param>.at[...]`` — pure passthrough
+  (never rebound, returned as-is) is exempt because XLA forwards
+  unmodified inputs without a copy;
+- use-after-donate is flagged only for straight-line reads of the donated
+  variable in statements after the call, stopping at any rebind.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleIndex,
+    SourceModule,
+    dotted_name,
+    register,
+)
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """The jit Call node when ``dec`` is a jit-family decorator (bare
+    ``@jax.jit`` returns None — no kwargs to carry donation anyway)."""
+    if not isinstance(dec, ast.Call):
+        return None
+    last = dotted_name(dec.func).rsplit(".", 1)[-1]
+    if last in _JIT_NAMES:
+        return dec
+    if last == "partial" and dec.args:
+        inner = dotted_name(dec.args[0]).rsplit(".", 1)[-1]
+        if inner in _JIT_NAMES:
+            return dec
+    return None
+
+
+def _is_bare_jit(dec: ast.AST) -> bool:
+    return dotted_name(dec).rsplit(".", 1)[-1] in _JIT_NAMES
+
+
+def _literal_strs(node: ast.AST) -> list[str]:
+    out = []
+    for el in getattr(node, "elts", [node]):
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.append(el.value)
+    return out
+
+
+def _literal_ints(node: ast.AST) -> list[int]:
+    out = []
+    for el in getattr(node, "elts", [node]):
+        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+            out.append(el.value)
+    return out
+
+
+def _jit_spec(fn: ast.FunctionDef) -> Optional[tuple[set[str], set[str]]]:
+    """(donated param names, static param names) when ``fn`` is
+    decorator-jitted; None when it isn't. Bare ``@jax.jit`` → empty sets."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        call = _jit_decorator(dec)
+        if call is None and not _is_bare_jit(dec):
+            continue
+        donated: set[str] = set()
+        static: set[str] = set()
+        if call is not None:
+            for kw in call.keywords:
+                if kw.arg == "donate_argnames":
+                    donated.update(_literal_strs(kw.value))
+                elif kw.arg == "donate_argnums":
+                    for i in _literal_ints(kw.value):
+                        if 0 <= i < len(params):
+                            donated.add(params[i])
+                elif kw.arg == "static_argnames":
+                    static.update(_literal_strs(kw.value))
+                elif kw.arg == "static_argnums":
+                    for i in _literal_ints(kw.value):
+                        if 0 <= i < len(params):
+                            static.add(params[i])
+        return donated, static
+    return None
+
+
+def _own_nodes(fn: ast.FunctionDef) -> list[ast.AST]:
+    """All nodes in fn's body, not descending into nested defs/lambdas."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _rebound_names(nodes: list[ast.AST]) -> set[str]:
+    bound: set[str] = set()
+    for node in nodes:
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return bound
+
+
+def _carried_names(fn: ast.FunctionDef, nodes: list[ast.AST]) -> set[str]:
+    """Param names threaded through the function (rebound + returned under
+    the same name, or returned via ``name._replace(...)``/``name.at[...]``)."""
+    params = {a.arg for a in fn.args.posonlyargs + fn.args.args}
+    rebound = _rebound_names(nodes)
+    carried: set[str] = set()
+    for node in nodes:
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        parts = node.value.elts if isinstance(node.value, ast.Tuple) else [node.value]
+        for part in parts:
+            if isinstance(part, ast.Name) and part.id in params:
+                if part.id in rebound:
+                    carried.add(part.id)
+            elif isinstance(part, ast.Call):
+                d = dotted_name(part.func)
+                root, _, tail = d.partition(".")
+                if root in params and tail.split(".")[0] in ("_replace", "at"):
+                    carried.add(root)
+    return carried
+
+
+def _stmt_of(idx: ModuleIndex, node: ast.AST) -> Optional[ast.stmt]:
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = idx.parent.get(cur)
+    return cur if isinstance(cur, ast.stmt) else None
+
+
+def _run_donation_audit(modules: list[SourceModule], ctx: AnalysisContext) -> list[Finding]:
+    findings: list[Finding] = []
+    # module-local donating fns, for the use-after-donate half
+    donating: dict[tuple[str, str], tuple[ast.FunctionDef, set[str]]] = {}
+    specs: dict[tuple[str, str], tuple[ast.FunctionDef, set[str], set[str]]] = {}
+    for mod in modules:
+        for fn in mod.index.functions:
+            if isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            spec = _jit_spec(fn)
+            if spec is None:
+                continue
+            donated, static = spec
+            specs[(mod.relpath, fn.name)] = (fn, donated, static)
+            if donated:
+                donating[(mod.relpath, fn.name)] = (fn, donated)
+
+    for mod in modules:
+        idx = mod.index
+        for fn in idx.functions:
+            spec = specs.get((mod.relpath, getattr(fn, "name", "")))
+            if spec is None or spec[0] is not fn:
+                continue
+            _, donated, static = spec
+            nodes = _own_nodes(fn)
+            for name in sorted(_carried_names(fn, nodes) - donated - static):
+                findings.append(
+                    Finding(
+                        rule="donation-audit",
+                        path=mod.relpath,
+                        line=fn.lineno,
+                        scope=idx.qualname(fn),
+                        token=name,
+                        message=(
+                            f"jitted `{fn.name}` threads `{name}` through itself but does "
+                            f"not donate it — the dead input and the new output are both "
+                            f"live at peak, doubling this buffer's HBM footprint"
+                        ),
+                        # a disable comment on any decorator line counts too
+                        anchor_lines=(fn.lineno, *(d.lineno for d in fn.decorator_list)),
+                    )
+                )
+
+        # -- use-after-donate at local call sites --------------------------
+        for call in idx.calls:
+            callee = dotted_name(call.func).rsplit(".", 1)[-1]
+            entry = donating.get((mod.relpath, callee))
+            if entry is None:
+                continue
+            target_fn, donated = entry
+            params = [a.arg for a in target_fn.args.posonlyargs + target_fn.args.args]
+            donated_vars: list[str] = []
+            for i, arg in enumerate(call.args):
+                if i < len(params) and params[i] in donated and isinstance(arg, ast.Name):
+                    donated_vars.append(arg.id)
+            for kw in call.keywords:
+                if kw.arg in donated and isinstance(kw.value, ast.Name):
+                    donated_vars.append(kw.value.id)
+            if not donated_vars:
+                continue
+            stmt = _stmt_of(idx, call)
+            holder = idx.parent.get(stmt) if stmt is not None else None
+            body = getattr(holder, "body", None)
+            if stmt is None or not isinstance(body, list) or stmt not in body:
+                continue
+            following = body[body.index(stmt) + 1 :]
+            for var in donated_vars:
+                # the calling statement itself may rebind (x = f(x, ...))
+                if isinstance(stmt, ast.Assign) and var in _rebound_names(
+                    [t for tgt in stmt.targets for t in ast.walk(tgt)]
+                ):
+                    continue
+                for later in following:
+                    later_nodes = list(ast.walk(later))
+                    stores = _rebound_names(later_nodes)
+                    loaded = [
+                        n
+                        for n in later_nodes
+                        if isinstance(n, ast.Name)
+                        and n.id == var
+                        and isinstance(n.ctx, ast.Load)
+                    ]
+                    if loaded:
+                        findings.append(
+                            Finding(
+                                rule="donation-audit",
+                                path=mod.relpath,
+                                line=loaded[0].lineno,
+                                scope=idx.qualname(loaded[0]),
+                                token=f"{var}@{callee}",
+                                message=(
+                                    f"`{var}` is read after being donated to `{callee}` — "
+                                    f"the buffer is deleted on donation-honoring backends; "
+                                    f"this only *appears* to work on CPU tests"
+                                ),
+                                anchor_lines=(call.lineno,),
+                            )
+                        )
+                        break
+                    if var in stores:
+                        break
+    return findings
+
+
+register(
+    AnalysisPass(
+        rule="donation-audit",
+        description=(
+            "jitted step functions that thread carried state (cache/opt "
+            "state) without donate_argnums, and reads of a variable after "
+            "it was donated to a local jitted callee"
+        ),
+        hint=(
+            "add donate_argnums/donate_argnames for the carried argument and "
+            "rebind the result (`x = step(x, ...)`); never read the donated "
+            "variable after the call"
+        ),
+        run=_run_donation_audit,
+    )
+)

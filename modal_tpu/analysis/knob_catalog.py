@@ -230,6 +230,20 @@ KNOB_CATALOG: dict[str, Knob] = dict(
            "set inside image-build subprocesses", internal=True),
         _k("MODAL_TPU_IMAGE_BUILDER_VERSION", "str", "2026.07", "docs/STATUS.md",
            "image-builder epoch baked into content-addressed build hashes"),
+        _k("MODAL_TPU_COMPILE_CACHE", "bool", "1", "docs/COLDSTART.md",
+           "fleet compile-cache client (fetch-before-compile, push-after); "
+           "off → jax's local persistent cache only", gate=True),
+        _k("MODAL_TPU_COMPILE_CACHE_URL", "url", "-", "docs/COLDSTART.md",
+           "fleet compile-cache service base URL (worker → container)", internal=True),
+        _k("MODAL_TPU_COMPILE_CACHE_DIR", "path", "-", "docs/COLDSTART.md",
+           "co-located fleet store dir for the local fast path (worker → container)",
+           internal=True),
+        _k("MODAL_TPU_AOT_LOWER", "csv", "", "docs/COLDSTART.md",
+           "entry points to AOT-lower at @enter/pool-park time "
+           "('train,prefill,decode,verify,sample' + cfg=/shape overrides)"),
+        _k("MODAL_TPU_KV_SHIP_URL", "url", "-", "docs/SERVING.md",
+           "blob-plane base URL for cross-host KV-page shipping when no "
+           "shared filesystem exists (worker → container)", internal=True),
         # -- data plane (docs/DATAPLANE.md) ---------------------------------
         _k("MODAL_TPU_BLOB_SPILL_BYTES", "int", "33554432", "docs/DATAPLANE.md",
            "download size above which blob bodies spill to disk (32 MiB)"),

@@ -179,7 +179,7 @@ class PagedKVCache(NamedTuple):
 # -- host-side table maintenance (small jitted updates between steps) --------
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def assign_pages(cache: PagedKVCache, slot: int, start_index: int, pages: jax.Array) -> PagedKVCache:
     """Write newly-allocated page ids into slot's table row at
     [start_index : start_index+len(pages)] (len(pages) is static per call —
@@ -188,7 +188,7 @@ def assign_pages(cache: PagedKVCache, slot: int, start_index: int, pages: jax.Ar
     return cache._replace(page_table=cache.page_table.at[slot].set(row))
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def release_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
     """Point the slot back at scratch and zero its length (the host frees
     the pages on the allocator side)."""
@@ -198,7 +198,7 @@ def release_slot(cache: PagedKVCache, slot: int) -> PagedKVCache:
     )
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def copy_page(cache: PagedKVCache, slot: int, table_index: int, dst_page: jax.Array) -> PagedKVCache:
     """Copy-on-write: duplicate the page the slot's table currently points
     at (all layers' K and V rows) into `dst_page` and repoint the table.
@@ -213,7 +213,7 @@ def copy_page(cache: PagedKVCache, slot: int, table_index: int, dst_page: jax.Ar
     )
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def set_seq_lens(cache: PagedKVCache, new_lens: jax.Array, update: jax.Array) -> PagedKVCache:
     """Host-directed per-slot length update (speculative decoding: the
     verify step writes k+1 candidate positions, then the HOST decides how

@@ -22,10 +22,13 @@ from jax import lax
 from .llama import KVCache, LlamaConfig, forward
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill(params: dict, cfg: LlamaConfig, tokens: jax.Array, cache: KVCache):
     """Run the prompt through the model, filling the cache.
-    Returns (last_token_logits [B, V], cache)."""
+    Returns (last_token_logits [B, V], cache). The incoming (empty) cache is
+    donated — ISSUE 20 donation audit: without it prefill held TWO full KV
+    caches live (the dead input + the filled output), doubling peak HBM for
+    the largest transient buffer in serving."""
     logits, cache = forward(params, cfg, tokens, cache=cache)
     return logits[:, -1, :], cache
 

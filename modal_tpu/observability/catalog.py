@@ -305,6 +305,32 @@ COMPILE_SECONDS = REGISTRY.histogram(
     ("phase",),
     buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 15, 30, 60, 120, 300, 600),
 )
+COMPILE_CACHE_HITS = REGISTRY.counter(
+    "modal_tpu_compile_cache_hits_total",
+    "Fleet compile-cache lookups served, by transport (local_dir = co-located "
+    "fast path, http = blob-plane GET /compile/<key>). Each hit also lands a "
+    "compile_events cache_hit with source=fleet (docs/COLDSTART.md).",
+    ("source",),
+)
+COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "modal_tpu_compile_cache_misses_total",
+    "Fleet compile-cache lookups that fell through to a local XLA compile, "
+    "by transport consulted.",
+    ("source",),
+)
+COMPILE_CACHE_PUTS = REGISTRY.counter(
+    "modal_tpu_compile_cache_puts_total",
+    "Freshly-compiled executables pushed into the fleet store, by transport.",
+    ("source",),
+)
+COMPILE_CACHE_ERRORS = REGISTRY.counter(
+    "modal_tpu_compile_cache_errors_total",
+    "Fleet compile-cache degradations, by kind (unreachable = transport "
+    "failure entering/holding the cooldown window, corrupt = integrity "
+    "mismatch → entry evicted). Degradations are silent: the compile path "
+    "falls back to local-only, these counters are the only trace.",
+    ("kind",),
+)
 STEP_SECONDS = REGISTRY.histogram(
     "modal_tpu_step_seconds",
     "Train/decode step wall time (post-compile steady state), by loop kind.",
@@ -567,11 +593,13 @@ SPAN_CATALOG: dict[str, str] = {
     "container.boot": "spawn decision → ready for inputs (MODAL_TPU_TRACE_T0)",
     "container.imports": "user-code import inside the container",
     "container.enter_hooks": "@enter lifecycle hooks",
+    "container.aot_lower": "@enter-path AOT lowering of MODAL_TPU_AOT_LOWER entry points",
     "container.input_deliver": "input delivery hop: fetch response → user.execute (deserialize + spawn)",
     "user.execute": "one input's user-code execution (cold_call marks jit)",
     "coldstart.handoff": "warm-pool adoption: handoff enqueue → interpreter ack",
     "coldstart.preimport": "warm-pool parked pre-import of a configured module",
     "coldstart.preinit": "warm-pool opt-in jax backend pre-initialization",
+    "coldstart.aot_lower": "warm-pool parked AOT lowering of MODAL_TPU_AOT_LOWER entry points",
     "recovery.replay": "journal replay into a fresh ServerState",
     "recovery.crash_restart": "chaos supervisor crash + same-port rebuild",
     "control.takeover": "journal-fed partition takeover: dead shard's segments replayed into a survivor",

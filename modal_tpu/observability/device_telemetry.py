@@ -107,6 +107,21 @@ def install_compile_hooks() -> bool:
         return True
 
 
+def maybe_install_fleet_cache() -> bool:
+    """Attach the fleet compile-cache tier under jax's persistent cache
+    (ISSUE 20, runtime/compile_client.py). Same lazy contract as
+    install_compile_hooks: a no-op until user code has imported jax, a no-op
+    when the MODAL_TPU_COMPILE_CACHE gate is off or no fleet coordinates are
+    set, and silent on every failure — telemetry/caching must never be the
+    reason a container errors."""
+    try:
+        from ..runtime.compile_client import install_fleet_cache
+
+        return install_fleet_cache()
+    except Exception:  # noqa: BLE001 — degrade to local-only compile
+        return False
+
+
 _last_sample_t = 0.0
 
 
@@ -207,6 +222,13 @@ PUSH_FAMILIES = (
     "modal_tpu_device_memory_bytes",
     "modal_tpu_compile_events_total",
     "modal_tpu_compile_seconds",
+    # fleet compile cache (ISSUE 20, docs/COLDSTART.md): per-container
+    # hit/miss/put/error counters delta-merge per task on the supervisor, so
+    # `modal_tpu metrics` answers "did that rollout compile anything?"
+    "modal_tpu_compile_cache_hits_total",
+    "modal_tpu_compile_cache_misses_total",
+    "modal_tpu_compile_cache_puts_total",
+    "modal_tpu_compile_cache_errors_total",
     "modal_tpu_step_seconds",
     "modal_tpu_profiler_samples_total",
     # serving tier (docs/SERVING.md): the SLO signals the scheduler sizes
@@ -271,6 +293,7 @@ def container_report() -> str:
 
     # hooks attach lazily: the first report after user code imported jax
     install_compile_hooks()
+    maybe_install_fleet_cache()
     sample_device_memory(min_interval_s=5.0)
     from .metrics import export_families
 
@@ -343,11 +366,25 @@ def merge_container_report(telemetry_json: str, prev_json: str = "", task_id: st
 
 def telemetry_summary() -> dict:
     """Compact roll-up for bench.py: compile counts + step p50s, when any."""
-    from .catalog import COMPILE_EVENTS, COMPILE_SECONDS, STEP_SECONDS
+    from .catalog import (
+        COMPILE_CACHE_HITS,
+        COMPILE_CACHE_MISSES,
+        COMPILE_CACHE_PUTS,
+        COMPILE_EVENTS,
+        COMPILE_SECONDS,
+        STEP_SECONDS,
+    )
 
     out: dict = {}
     if COMPILE_EVENTS.total():
         out["compile_events"] = dict(COMPILE_EVENTS.snapshot())
+    fleet = {
+        "hits": COMPILE_CACHE_HITS.total(),
+        "misses": COMPILE_CACHE_MISSES.total(),
+        "puts": COMPILE_CACHE_PUTS.total(),
+    }
+    if any(fleet.values()):
+        out["compile_cache"] = fleet
     if COMPILE_SECONDS.count_total():
         out["compile_p50_s"] = COMPILE_SECONDS.quantile(0.5)
     if STEP_SECONDS.count_total():

@@ -82,10 +82,19 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     attn_impl: Optional[Callable] = None,
     pipeline_mesh: Optional[Mesh] = None,
+    state_shardings: Optional[TrainState] = None,
 ) -> Callable:
     """Returns train_step(state, tokens) -> (state, metrics) — jit with
     donated state. With `pipeline_mesh` the loss is the GPipe-microbatched
-    pipeline over its `pipe` axis (parallel/pipeline.py)."""
+    pipeline over its `pipe` axis (parallel/pipeline.py).
+
+    `state_shardings` (a TrainState of NamedShardings, as built by
+    create_sharded_state) pins out_shardings == in_shardings for the carried
+    state. Without the pin XLA may choose a different output layout, which
+    inserts a reshard (copy/all-gather) between consecutive steps AND breaks
+    donation (a donated buffer can only be reused in place when the output
+    sharding matches) — the ISSUE 20 audit asserts the pinned HLO carries no
+    such copy."""
     if pipeline_mesh is not None:
         from .mesh import validate_mesh_constraints
         from .pipeline import pipeline_loss
@@ -102,7 +111,12 @@ def make_train_step(
         def compute_loss(params, tokens):
             return loss_fn(params, cfg, tokens, tc.remat, attn_impl)
 
-    @partial(jax.jit, donate_argnums=(0,))
+    jit_kwargs: dict = {"donate_argnums": (0,)}
+    if state_shardings is not None:
+        # metrics stay unconstrained (scalars; XLA replicates them anyway)
+        jit_kwargs["out_shardings"] = (state_shardings, None)
+
+    @partial(jax.jit, **jit_kwargs)
     def train_step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(compute_loss)(state.params, tokens)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -112,6 +126,43 @@ def make_train_step(
         return new_state, {"loss": loss, "grad_norm": gnorm, "step": new_state.step}
 
     return train_step
+
+
+def _keypath_strs(path) -> tuple:
+    out = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            v = getattr(k, attr, None)
+            if v is not None:
+                out.append(str(v))
+                break
+        else:
+            out.append(str(k))
+    return tuple(out)
+
+
+def mirror_opt_shardings(abstract_opt, p_shardings, mesh: Mesh):
+    """Shardings for the optimizer state that MIRROR the param shardings:
+    optax moment trees (mu/nu) repeat the params pytree as subtrees, so each
+    moment leaf gets the sharding of the param whose key-path it ends with;
+    bookkeeping scalars (count) replicate. Found by the ISSUE 20 audit:
+    ``jax.jit(optimizer.init)(params)`` does NOT inherit the params'
+    shardings — the whole opt state landed on one device, and every train
+    step then paid a full gather/scatter of both Adam moments."""
+    flat_shardings = {
+        _keypath_strs(path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(p_shardings)[0]
+    }
+    replicated = NamedSharding(mesh, P())
+
+    def pick(path, _leaf):
+        keys = _keypath_strs(path)
+        for i in range(len(keys)):
+            if keys[i:] in flat_shardings:
+                return flat_shardings[keys[i:]]
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(pick, abstract_opt)
 
 
 def create_sharded_state(
@@ -146,12 +197,28 @@ def create_sharded_state(
         return init_params(cfg, key)
 
     params = _init(jax.random.PRNGKey(seed))
-    # optimizer state mirrors the params, inheriting their shardings through
-    # jit's sharding propagation
-    opt_state = jax.jit(optimizer.init)(params)
-    state = TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+    # optimizer state mirrors the params — pinned EXPLICITLY via
+    # out_shardings (propagation alone leaves it single-device, see
+    # mirror_opt_shardings)
+    abstract_opt = jax.eval_shape(optimizer.init, params)
+    opt_shardings = mirror_opt_shardings(abstract_opt, p_shardings, mesh)
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
+    # step must live ON the mesh (replicated): a host-created scalar carries
+    # SingleDeviceSharding, which would poison the out_shardings pin below
+    # with a cross-platform device mismatch
+    step0 = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    state = TrainState(params=params, opt_state=opt_state, step=step0)
+    # donation/resharding audit (ISSUE 20): carry the realized shardings into
+    # the step's out_shardings so step N's outputs land exactly where step
+    # N+1's donated inputs live — no reshard copy between consecutive steps
+    state_shardings = jax.tree.map(lambda x: x.sharding, state)
     step_fn = make_train_step(
-        cfg, tc, optimizer, attn_impl=attn_impl, pipeline_mesh=mesh if pipe else None
+        cfg,
+        tc,
+        optimizer,
+        attn_impl=attn_impl,
+        pipeline_mesh=mesh if pipe else None,
+        state_shardings=state_shardings,
     )
     token_spec = P(("data", "fsdp"), "seq" if mesh.shape.get("seq", 1) > 1 else None)
     return state, step_fn, NamedSharding(mesh, token_spec)

@@ -1,0 +1,261 @@
+"""Ahead-of-time lowering of the known jit entry points (ISSUE 20,
+tentpole d; grounded in the "Automatic Full Compilation … to Cloud TPUs"
+paper, PAPERS.md).
+
+``jax.jit(...).lower(abstract_args).compile()`` runs the full trace →
+StableHLO → XLA pipeline against ``ShapeDtypeStruct`` shapes — no weights,
+no device buffers, no real traffic. Every compile lands in the persistent
+compilation cache and (with the ISSUE 20 fleet tier installed) the fleet
+store, so the FIRST real request after a rollout deserializes an
+executable instead of tracing: run this at ``@enter``/pool-park time and
+first traffic never compiles.
+
+The entry-point catalog mirrors the serving engine's actual executables:
+
+- ``train``   — parallel/train.make_train_step on the tiny-demo shapes
+- ``prefill`` — models/paged_kv.paged_prefill, one executable per
+                PREFILL_BUCKETS bucket up to the context limit
+- ``decode``  — models/paged_kv.paged_decode_step (the steady-state step)
+- ``verify``  — models/paged_kv.paged_verify_step (speculative K+1 verify)
+- ``sample``  — models/sampling.sample_step (per-request sampling params)
+
+Gate: ``MODAL_TPU_AOT_LOWER`` — unset/0 → nothing happens (off-toggle per
+the PR 12 degradation gates); ``1``/``all`` → every entry; else a csv of
+entry names, with ``cfg=<name>``/``slots=<n>``/... option tokens riding in
+the same csv (e.g. ``MODAL_TPU_AOT_LOWER=prefill,decode,cfg=tiny``).
+Failures are silent per entry (logged + counted): an AOT miss costs a
+runtime compile, never a broken container.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..config import logger
+
+AOT_ENV = "MODAL_TPU_AOT_LOWER"
+
+ENTRY_POINTS = ("train", "prefill", "decode", "verify", "sample")
+
+# serving-shape defaults; override via option tokens in the env csv. These
+# must match the engine's construction defaults for the cache keys to be the
+# ones real traffic asks for (tests pin prefill buckets = engine buckets).
+_DEFAULTS = {
+    "cfg": "tiny",
+    "slots": 4,
+    "num_pages": 64,
+    "page_size": 0,  # 0 → models/paged_kv.DEFAULT_PAGE_SIZE
+    "max_context": 0,  # 0 → cfg.max_seq_len
+    "batch": 8,  # train tokens [batch, seq]
+    "seq": 64,
+    "spec_k": 4,  # verify step width = spec_k + 1
+}
+
+
+def parse_aot_spec(raw: Optional[str] = None) -> Optional[tuple[list[str], dict]]:
+    """``(entries, options)`` from the env spec; None when the gate is off.
+    Unknown entry names are dropped (forward-compat: an old container given
+    a newer spec lowers what it knows)."""
+    if raw is None:
+        raw = os.environ.get(AOT_ENV, "")
+    raw = raw.strip()
+    if not raw or raw.lower() in ("0", "false", "no", "off"):
+        return None
+    entries: list[str] = []
+    options = dict(_DEFAULTS)
+    for token in (t.strip().lower() for t in raw.split(",")):
+        if not token:
+            continue
+        if "=" in token:
+            key, _, value = token.partition("=")
+            if key == "cfg":
+                options["cfg"] = value
+            elif key in options:
+                try:
+                    options[key] = int(value)
+                except ValueError:
+                    pass
+            continue
+        if token in ("1", "all", "true", "on"):
+            entries = list(ENTRY_POINTS)
+        elif token in ENTRY_POINTS and token not in entries:
+            entries.append(token)
+    if not entries:
+        return None
+    return entries, options
+
+
+def _abstract_paged_cache(cfg, slots: int, num_pages: int, page_size: int):
+    import jax
+
+    from ..models.paged_kv import DEFAULT_PAGE_SIZE, PagedKVCache
+
+    return jax.eval_shape(
+        lambda: PagedKVCache.create(
+            cfg, slots=slots, num_pages=num_pages, page_size=page_size or DEFAULT_PAGE_SIZE
+        )
+    )
+
+
+def _lower_train(cfg, opts: dict) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.train import TrainConfig, TrainState, make_optimizer, make_train_step
+
+    tc = TrainConfig(warmup_steps=10, total_steps=100)
+    optimizer = make_optimizer(tc)
+    from ..models.llama import init_params_abstract
+
+    params = init_params_abstract(cfg)
+    opt_state = jax.eval_shape(optimizer.init, params)
+    state = TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+    )
+    tokens = jax.ShapeDtypeStruct((int(opts["batch"]), int(opts["seq"])), jnp.int32)
+    step_fn = make_train_step(cfg, tc, optimizer)
+    step_fn.lower(state, tokens).compile()
+    return 1
+
+
+def _lower_prefill(cfg, opts: dict) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_params_abstract
+    from ..models.paged_kv import PREFILL_BUCKETS, paged_prefill
+
+    params = init_params_abstract(cfg)
+    cache = _abstract_paged_cache(cfg, opts["slots"], opts["num_pages"], opts["page_size"])
+    max_context = int(opts["max_context"]) or cfg.max_seq_len
+    scalar = jax.ShapeDtypeStruct((), jnp.int32)
+    n = 0
+    for bucket in PREFILL_BUCKETS:
+        if bucket > max_context:
+            break
+        tokens = jax.ShapeDtypeStruct((bucket,), jnp.int32)
+        paged_prefill.lower(params, cfg, tokens, scalar, cache, scalar, scalar).compile()
+        n += 1
+    return n
+
+
+def _lower_decode(cfg, opts: dict) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_params_abstract
+    from ..models.paged_kv import paged_decode_step
+
+    params = init_params_abstract(cfg)
+    cache = _abstract_paged_cache(cfg, opts["slots"], opts["num_pages"], opts["page_size"])
+    slots = int(opts["slots"])
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    active = jax.ShapeDtypeStruct((slots,), jnp.bool_)
+    paged_decode_step.lower(params, cfg, tokens, cache, active, attn_impl="gather").compile()
+    return 1
+
+
+def _lower_verify(cfg, opts: dict) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_params_abstract
+    from ..models.paged_kv import paged_verify_step
+
+    params = init_params_abstract(cfg)
+    cache = _abstract_paged_cache(cfg, opts["slots"], opts["num_pages"], opts["page_size"])
+    slots = int(opts["slots"])
+    tokens = jax.ShapeDtypeStruct((slots, int(opts["spec_k"]) + 1), jnp.int32)
+    active = jax.ShapeDtypeStruct((slots,), jnp.bool_)
+    paged_verify_step.lower(params, cfg, tokens, cache, active).compile()
+    return 1
+
+
+def _lower_sample(cfg, opts: dict) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.sampling import sample_step
+
+    slots = int(opts["slots"])
+    logits = jax.ShapeDtypeStruct((slots, cfg.vocab_size), jnp.float32)
+    i32 = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    f32 = jax.ShapeDtypeStruct((slots,), jnp.float32)
+    sample_step.lower(logits, i32, i32, f32, i32, f32).compile()
+    return 1
+
+
+_LOWERERS = {
+    "train": _lower_train,
+    "prefill": _lower_prefill,
+    "decode": _lower_decode,
+    "verify": _lower_verify,
+    "sample": _lower_sample,
+}
+
+
+def run_aot_lowering(
+    entries: Optional[list[str]] = None, options: Optional[dict] = None
+) -> dict:
+    """Lower + compile the requested entry points against abstract shapes.
+    Returns ``{entry: {"executables": n, "seconds": s}}`` for what
+    succeeded; failed entries land under ``"errors"``. Requires jax — the
+    caller gates on the env and imports."""
+    opts = dict(_DEFAULTS)
+    opts.update(options or {})
+    from ..models.llama import get_config
+
+    cfg = get_config(str(opts["cfg"]))
+    results: dict = {}
+    errors: dict = {}
+    for entry in entries or list(ENTRY_POINTS):
+        fn = _LOWERERS.get(entry)
+        if fn is None:
+            continue
+        t0 = time.monotonic()
+        try:
+            n = fn(cfg, opts)
+        except Exception as exc:  # noqa: BLE001 — one entry failing must not kill the rest
+            logger.warning(f"AOT lowering of {entry!r} failed: {exc}")
+            errors[entry] = str(exc)
+            continue
+        results[entry] = {"executables": n, "seconds": round(time.monotonic() - t0, 3)}
+    if errors:
+        results["errors"] = errors
+    return results
+
+
+def maybe_aot_lower() -> Optional[dict]:
+    """The env-gated hook (@enter / pool-park, container_entrypoint): parse
+    MODAL_TPU_AOT_LOWER, install the fleet cache tier so AOT compiles
+    publish fleet-wide, lower everything requested. None when the gate is
+    off; never raises."""
+    spec = parse_aot_spec()
+    if spec is None:
+        return None
+    entries, options = spec
+    try:
+        import jax  # noqa: F401 — AOT explicitly pays the import bill
+
+        from .compile_client import install_fleet_cache
+
+        install_fleet_cache()
+        from ..observability.device_telemetry import install_compile_hooks
+
+        install_compile_hooks()
+        t0 = time.monotonic()
+        results = run_aot_lowering(entries, options)
+        logger.info(
+            f"AOT lowering done in {time.monotonic() - t0:.1f}s: "
+            + ", ".join(
+                f"{k}={v['executables']}" for k, v in results.items() if k != "errors"
+            )
+        )
+        return results
+    except Exception as exc:  # noqa: BLE001 — AOT is an optimization, never a failure
+        logger.warning(f"AOT lowering skipped: {exc}")
+        return None

@@ -508,6 +508,10 @@ async def main_async() -> int:
         # imports just ran, so if the function uses jax it is in sys.modules)
         # — the first-call jit compile must be counted, not just later ones
         device_telemetry.install_compile_hooks()
+        # fleet compile cache (ISSUE 20): tier the persistent cache over the
+        # fleet store before any enter-hook/first-input jit runs, so even the
+        # very first compile of this container's life can be a fleet hit
+        device_telemetry.maybe_install_fleet_cache()
 
         # lifecycle: enter hooks (pre-snapshot = warm weight load). With
         # memory snapshots enabled, later cold boots SKIP the snap-enter
@@ -546,6 +550,22 @@ async def main_async() -> int:
                 parent=boot_span.context,
                 attrs={"task_id": task_id},
             )
+        # AOT lowering (ISSUE 20, runtime/aot.py): with MODAL_TPU_AOT_LOWER
+        # set, compile the known entry points against abstract shapes NOW —
+        # off-loop like the enter hooks — so the first input never traces.
+        # Compiles land in the persistent + fleet caches (usually hits).
+        if os.environ.get("MODAL_TPU_AOT_LOWER"):
+            from .aot import maybe_aot_lower
+
+            t_aot = time.time()
+            if await asyncio.to_thread(maybe_aot_lower) is not None:
+                tracing.record_span(
+                    "container.aot_lower",
+                    start=t_aot,
+                    end=time.time(),
+                    parent=boot_span.context,
+                    attrs={"task_id": task_id},
+                )
 
         # boot is complete: the container is about to serve
         tracing.close_span(boot_span)
@@ -702,6 +722,17 @@ def _pool_preimport() -> None:
             )
         except Exception as exc:  # noqa: BLE001
             logger.warning(f"warm pool backend pre-init failed: {exc}")
+    # AOT lowering at pool-park time (ISSUE 20, runtime/aot.py): a parked
+    # interpreter with MODAL_TPU_AOT_LOWER compiles the known entry points
+    # while idle — adoption then serves first traffic from cache. The fleet
+    # tier is installed first so park-time compiles publish fleet-wide (and
+    # usually hit entries another park/prewarm already published).
+    if os.environ.get("MODAL_TPU_AOT_LOWER"):
+        from .aot import maybe_aot_lower
+
+        t0 = time.time()
+        if maybe_aot_lower() is not None:
+            tracing.record_span("coldstart.aot_lower", start=t0, end=time.time())
 
 
 def _reset_process_state(base_env: dict, base_cwd: str, added_paths: list) -> None:

@@ -16,6 +16,18 @@ from ..observability.metrics import REGISTRY
 from .state import ServerState
 
 
+def _file_sha256(path: str) -> str:
+    """Sync sha256 of a file (compile-cache integrity sidecar) — always
+    invoked via ``asyncio.to_thread`` (lint: blocking-in-async)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while chunk := f.read(4 * 1024 * 1024):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def _assemble_parts(tmp: str, part_paths: list[str]) -> None:
     """Concatenate multipart pieces into ``tmp``. Pure sync file IO — always
     invoked via ``asyncio.to_thread`` so GB-scale copies never run on the
@@ -82,6 +94,14 @@ class BlobServer:
         app.router.add_get("/blob/{blob_id}", self._get)
         app.router.add_put("/blob/{blob_id}/part/{part}", self._put_part)
         app.router.add_put("/blob/{blob_id}/complete/{n_parts}", self._complete)
+        # fleet compile cache (ISSUE 20, docs/COLDSTART.md): compiled-
+        # executable entries by content key on the same data plane —
+        # co-located containers skip these routes entirely via the
+        # MODAL_TPU_COMPILE_CACHE_DIR fast path
+        app.router.add_put("/compile/{key}", self._compile_put)
+        app.router.add_get("/compile/{key}", self._compile_get)
+        app.router.add_delete("/compile/{key}", self._compile_delete)
+        app.router.add_get("/compile", self._compile_keys)
         # volume content blocks over the same Range-capable HTTP plane: the
         # striped Volume read engine fetches blocks here instead of paying
         # the gRPC proto copy per 8 MiB block (volume.py _fetch_block)
@@ -237,6 +257,73 @@ class BlobServer:
         BLOB_BYTES.inc(received, direction="in")
         BLOB_REQUESTS.inc(route="put", code="200")
         return web.Response(status=200)
+
+    # -- fleet compile cache (ISSUE 20; server/compile_cache.py) ------------
+
+    async def _compile_put(self, request: web.Request) -> web.Response:
+        """Idempotent content PUT: drain to a tmp file, hash it off-loop,
+        replace into place. Concurrent PUTs of one key both land identical
+        content; the sidecar digest is recomputed server-side so a client's
+        X-Content-SHA256 lie cannot poison readers (the body wins)."""
+        if (injected := await self._inject("CompilePut")) is not None:
+            BLOB_REQUESTS.inc(route="compile_put", code=str(injected.status))
+            return injected
+        store = self.state.compile_cache
+        key = request.match_info["key"]
+        path = store.path(key)
+        if path is None:
+            BLOB_REQUESTS.inc(route="compile_put", code="400")
+            return web.Response(status=400, text="bad key")
+        tmp = f"{path}.tmp.{os.getpid()}-{id(request)}"
+        received = await self._drain_to_file(request.content, tmp)
+        # hashing a multi-MB executable is CPU-bound file IO: off the loop
+        sha = await asyncio.to_thread(_file_sha256, tmp)
+        claimed = request.headers.get("X-Content-SHA256", "")
+        if claimed and claimed != sha:
+            # the body didn't survive the wire intact: reject so the store
+            # never holds bytes the producer wouldn't vouch for
+            await asyncio.to_thread(os.unlink, tmp)
+            BLOB_REQUESTS.inc(route="compile_put", code="422")
+            return web.Response(status=422, text="content digest mismatch")
+        store.finalize_put(key, tmp, sha)
+        BLOB_BYTES.inc(received, direction="in")
+        BLOB_REQUESTS.inc(route="compile_put", code="200")
+        return web.Response(status=200)
+
+    async def _compile_get(self, request: web.Request) -> web.StreamResponse:
+        if (injected := await self._inject("CompileGet")) is not None:
+            BLOB_REQUESTS.inc(route="compile_get", code=str(injected.status))
+            return injected
+        store = self.state.compile_cache
+        path = store.path(request.match_info["key"])
+        if path is None or not os.path.exists(path):
+            BLOB_REQUESTS.inc(route="compile_get", code="404")
+            return web.Response(status=404, text="not found")
+        resp = self._serve_sendfile(request, path, "compile_get")
+        # integrity sidecar rides as a header: clients verify and evict
+        # corrupt entries instead of deserializing garbage into XLA
+        sha = store.digest(request.match_info["key"])
+        if sha:
+            resp.headers["X-Content-SHA256"] = sha
+        return resp
+
+    async def _compile_delete(self, request: web.Request) -> web.Response:
+        """Eviction: clients that caught an integrity mismatch heal the
+        fleet by deleting the corrupt entry (next producer re-publishes)."""
+        if (injected := await self._inject("CompileDelete")) is not None:
+            BLOB_REQUESTS.inc(route="compile_delete", code=str(injected.status))
+            return injected
+        existed = self.state.compile_cache.delete(request.match_info["key"])
+        code = "200" if existed else "404"
+        BLOB_REQUESTS.inc(route="compile_delete", code=code)
+        return web.Response(status=int(code))
+
+    async def _compile_keys(self, request: web.Request) -> web.Response:
+        """Store inventory: the cold-fleet bench and `modal_tpu` tooling ask
+        'is the store primed?' without pulling entry bytes."""
+        keys = await asyncio.to_thread(self.state.compile_cache.keys)
+        BLOB_REQUESTS.inc(route="compile_keys", code="200")
+        return web.json_response({"keys": keys, "count": len(keys)})
 
     async def _put_part(self, request: web.Request) -> web.Response:
         """One multipart part (reference: S3 presigned part PUT,

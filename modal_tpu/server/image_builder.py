@@ -152,6 +152,9 @@ class ImageBuilder:
     def __init__(self, state_dir: str):
         self.images_dir = os.path.join(state_dir, "images")
         os.makedirs(self.images_dir, exist_ok=True)
+        # same root ServerState uses: <state_dir>/compile_cache. Prewarm
+        # bakes publish here so the whole fleet hits entries this host baked.
+        self.compile_store_dir = os.path.join(state_dir, "compile_cache")
         self._locks: dict[str, asyncio.Lock] = {}
 
     async def fetch_chain(self, stub, image_id: str) -> list[api_pb2.Image]:
@@ -445,6 +448,10 @@ class ImageBuilder:
             "    import jax\n"
             "    from modal_tpu.observability import device_telemetry as _dt\n"
             "    _dt.install_compile_hooks()\n"
+            "    # path-independent cache keys: the baked entries must hash\n"
+            "    # identically in every container, not just under this rootfs\n"
+            "    from modal_tpu.runtime.compile_client import normalize_cache_keys\n"
+            "    normalize_cache_keys()\n"
             "except Exception:\n"
             "    pass\n"
         ) if prewarm else ""
@@ -476,6 +483,24 @@ class ImageBuilder:
         await run_shell(f"{shlex.quote(built.python_bin)} {shlex.quote(script)}", env, built.workdir)
         if prewarm:
             self._merge_prewarm_compile_events(telemetry_out)
+            self._publish_prewarm_cache(built.env.get("JAX_COMPILATION_CACHE_DIR", ""))
+
+    def _publish_prewarm_cache(self, cache_dir: str) -> None:
+        """Tentpole (c): push the bake's persistent-cache entries into the
+        fleet compile store, so containers from OTHER images (or other
+        hosts, via the blob-plane /compile routes) hit what this bake
+        compiled. Keyed by filename — already jax's content-addressed key.
+        Best-effort: a publish failure costs fleet hits, never the build."""
+        if not cache_dir or not os.path.isdir(cache_dir):
+            return
+        try:
+            from .compile_cache import CompileCacheStore
+
+            published = CompileCacheStore(self.compile_store_dir).publish_dir(cache_dir)
+            if published:
+                logger.info(f"prewarm bake published {published} compile-cache entries to fleet store")
+        except Exception as exc:  # noqa: BLE001 — never fail a build over cache publishing
+            logger.warning(f"prewarm fleet-store publish skipped: {exc}")
 
     @staticmethod
     def _merge_prewarm_compile_events(path: str) -> None:

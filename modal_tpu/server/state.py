@@ -375,6 +375,13 @@ class ServerState:
         self.block_dir = os.path.join(os.path.dirname(self.blob_dir), "volume_blocks")
         os.makedirs(self.blob_dir, exist_ok=True)
         os.makedirs(self.block_dir, exist_ok=True)
+        # fleet compile cache (ISSUE 20, server/compile_cache.py): shared like
+        # the blob store — entries are content-keyed, any shard serves any key
+        from .compile_cache import CompileCacheStore
+
+        self.compile_cache = CompileCacheStore(
+            os.path.join(os.path.dirname(self.blob_dir), "compile_cache")
+        )
 
         self.apps: dict[str, AppState] = {}
         self.deployed_apps: dict[tuple[str, str], str] = {}  # (env, name) -> app_id

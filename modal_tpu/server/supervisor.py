@@ -283,6 +283,9 @@ class LocalSupervisor:
                 # the fast-path coordinates to use and to export to containers
                 server_uds=self.uds_path,
                 blob_local_dir=self.state.blob_dir,
+                # fleet compile cache (ISSUE 20): the blob plane serves
+                # /compile/<key>, so its base url IS the cache url
+                compile_cache_url=self.state.blob_url_base,
             )
             await worker.start()
             self.workers.append(worker)

@@ -303,6 +303,12 @@ class WarmPool:
         env["MODAL_TPU_POOL_ID"] = pool_id
         env["MODAL_TPU_POOL_TOKEN"] = token
         env["MODAL_TPU_POOL_ROUTER"] = self.worker.router_address
+        # fleet compile cache pre-attach (ISSUE 20): a parked interpreter's
+        # pre-import jit warmups — and everything the adopted task compiles —
+        # hit/feed the fleet store from the first trace, so a cold rollout
+        # serves from entries prewarmed by any prior build anywhere
+        for cache_key, cache_value in self.worker._compile_cache_env().items():
+            env.setdefault(cache_key, cache_value)
         if platform:
             env["JAX_PLATFORMS"] = platform
             if platform == "cpu":

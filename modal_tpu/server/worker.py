@@ -94,12 +94,16 @@ class WorkerAgent:
         chaos=None,  # ChaosPolicy: lifecycle faults + heartbeat blackhole
         server_uds: str = "",  # co-located control-plane Unix socket
         blob_local_dir: str = "",  # co-located blob store (path handoff)
+        compile_cache_url: str = "",  # fleet compile store, HTTP leg (ISSUE 20)
     ):
         self.server_url = server_url
         # local fast-path coordinates (docs/DISPATCH.md): explicit from an
         # in-process supervisor, else env for a standalone co-located worker
         self.server_uds = server_uds or os.environ.get("MODAL_TPU_SERVER_UDS", "")
         self.blob_local_dir = blob_local_dir or os.environ.get("MODAL_TPU_BLOB_LOCAL_DIR", "")
+        self.compile_cache_url = compile_cache_url or os.environ.get(
+            "MODAL_TPU_COMPILE_CACHE_URL", ""
+        )
         self.worker_id = worker_id or ""
         self._override_chips = num_chips
         self._override_type = tpu_type
@@ -577,6 +581,34 @@ class WorkerAgent:
             env["MODAL_TPU_IMAGE_ROOT"] = built.rootfs
             env["PATH"] = os.path.dirname(built.python_bin) + os.pathsep + env.get("PATH", "")
         return True, built
+
+    def _compile_cache_env(self) -> dict[str, str]:
+        """Fleet compile-cache coordinates a container (or parked pool
+        interpreter) should inherit (ISSUE 20, docs/COLDSTART.md): the
+        co-located store dir — a sibling of the blob store under the
+        supervisor state dir, stat-verified container-side like the blob
+        fast path — plus the HTTP url for fetch-on-miss/evict. Empty dict
+        when nothing is configured (remote worker with no coordinates)."""
+        out: dict[str, str] = {}
+        # Key normalization must be env-level and unconditional: the prewarm
+        # bake clears the GPU autotune-dir debug option (it hashes an absolute
+        # local path into every cache key), and a container that compiles
+        # before install_fleet_cache() runs would otherwise mint divergent
+        # keys and miss every baked entry. Applied via setdefault — an
+        # explicit user value wins (see compile_client.normalize_cache_keys).
+        out["JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES"] = ""
+        if self.blob_local_dir:
+            cache_dir = os.path.join(
+                os.path.dirname(os.path.abspath(self.blob_local_dir)), "compile_cache"
+            )
+            if os.path.isdir(cache_dir):
+                out["MODAL_TPU_COMPILE_CACHE_DIR"] = cache_dir
+        if self.compile_cache_url:
+            out["MODAL_TPU_COMPILE_CACHE_URL"] = self.compile_cache_url
+            # same blob plane carries KV-page shipments for serving engines
+            # with no shared fs (serving/api.py handle_prefill)
+            out["MODAL_TPU_KV_SHIP_URL"] = self.compile_cache_url
+        return out
 
     def _consume_early_stop(self, task_id: str) -> bool:
         """True if a stop for this task arrived before it was registered."""
@@ -1080,6 +1112,11 @@ class WorkerAgent:
             env["MODAL_TPU_SERVER_UDS"] = self.server_uds
         if self.blob_local_dir:
             env["MODAL_TPU_BLOB_LOCAL_DIR"] = self.blob_local_dir
+        # fleet compile cache (ISSUE 20): co-located containers read the
+        # supervisor's store in place (zero HTTP bytes); the URL is the
+        # remote leg and the eviction channel
+        for key, value in self._compile_cache_env().items():
+            env.setdefault(key, value)
         env["MODAL_TPU_TASK_ID"] = task_id
         env["MODAL_TPU_TASK_DIR"] = task_dir
         if config.get("import_trace"):  # env: MODAL_TPU_IMPORT_TRACE

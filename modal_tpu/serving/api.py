@@ -334,6 +334,32 @@ def serving_asgi_app(
         os.makedirs(d, exist_ok=True)
         return d
 
+    def _ship_url_base() -> str:
+        """ISSUE 20 satellite: `MODAL_TPU_KV_SHIP_URL` = blob-plane base URL
+        for KV shipments between engines that share NO filesystem. The
+        shared-dir handoff stays preferred when both are configured — the
+        URL is the no-shared-fs fallback, not a replacement."""
+        if os.environ.get("MODAL_TPU_BLOB_LOCAL_DIR", ""):
+            return ""
+        return os.environ.get("MODAL_TPU_KV_SHIP_URL", "").strip().rstrip("/")
+
+    def _ship_put_http(base: str, name: str, payload: bytes) -> str:
+        """PUT the shipment through the blob plane; returns the GET url the
+        decode replica dereferences. Raises on transport failure — the
+        caller degrades to the local-file path."""
+        import urllib.request
+
+        url = f"{base}/blob/{name}"
+        req = urllib.request.Request(url, data=payload, method="PUT")
+        urllib.request.urlopen(req, timeout=15.0).close()
+        return url
+
+    def _ship_get_http(url: str) -> bytes:
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=15.0) as resp:
+            return resp.read()
+
     async def handle_prefill(scope, receive, send) -> None:
         """Prefill leg: generate exactly the first token, export the prompt's
         KV pages, park them as a serialized file reference. The heavy bytes
@@ -363,19 +389,33 @@ def serving_asgi_app(
         if req.error or req.shipment is None:
             await send_json(send, 500, {"error": req.error or "prefill produced no shipment"})
             return
-        path = os.path.join(_ship_dir(), f"kvship-{req.id}.bin")
+        payload = await asyncio.to_thread(serialization.serialize, req.shipment)
+        req.shipment = None  # the ref is the handoff; drop the host copy
+        kv_ref = ""
+        ship_base = _ship_url_base()
+        if ship_base:
+            # no shared fs: push the bytes through the blob HTTP plane and
+            # hand the decode replica a URL. A failed PUT degrades to the
+            # local-file path — worst case the decode leg re-prefills.
+            try:
+                kv_ref = await asyncio.to_thread(
+                    _ship_put_http, ship_base, f"kvship-{req.id}", payload
+                )
+            except Exception as exc:  # noqa: BLE001 — degrade, never 500 a good prefill
+                logger.warning(f"serving: kv ship via {ship_base} failed ({exc}); local file")
+        if not kv_ref:
+            kv_ref = os.path.join(_ship_dir(), f"kvship-{req.id}.bin")
 
-        def _write(ship: dict) -> None:
-            with open(path, "wb") as f:
-                f.write(serialization.serialize(ship))
+            def _write(data: bytes) -> None:
+                with open(kv_ref, "wb") as f:
+                    f.write(data)
 
-        await asyncio.to_thread(_write, req.shipment)
-        req.shipment = None  # the file is the handoff; drop the host copy
+            await asyncio.to_thread(_write, payload)
         await send_json(
             send,
             200,
             {
-                "kv_ref": path,
+                "kv_ref": kv_ref,
                 "first_token": req.tokens[0] if req.tokens else None,
                 "n_tokens": len(prompt),
                 "request_id": req.id,
@@ -408,6 +448,11 @@ def serving_asgi_app(
             await send_json(send, 400, {"error": str(exc)})
             return
         def _read() -> dict:
+            # http(s) refs come from a prefill replica on another host
+            # (MODAL_TPU_KV_SHIP_URL, blob HTTP plane); anything else is the
+            # shared-dir file handoff
+            if kv_ref.startswith(("http://", "https://")):
+                return serialization.deserialize(_ship_get_http(kv_ref))
             with open(kv_ref, "rb") as f:
                 return serialization.deserialize(f.read())
 

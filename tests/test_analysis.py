@@ -316,6 +316,148 @@ def test_jit_purity_catches_config_read_in_pallas_kernel():
 
 
 # ---------------------------------------------------------------------------
+# Rule: donation-audit (ISSUE 20 — carried state must be donated)
+# ---------------------------------------------------------------------------
+
+
+def test_donation_audit_catches_pre_audit_prefill_shape():
+    """Pin the EXACT pre-audit bug: models/sampling.prefill threaded the KV
+    cache through itself with no donate_argnames — two full caches live per
+    prefill. The audit FIXED it (donate_argnames=("cache",)); this fixture
+    is the pre-fix source shape and must stay a finding so the rule keeps
+    guarding the fix."""
+    mod = _mod(
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def prefill(params, cfg, prompt_tokens, cache):
+            positions = jnp.arange(prompt_tokens.shape[1])[None, :]
+            logits, cache = forward(params, cfg, prompt_tokens, cache, positions)
+            return logits[:, -1, :], cache
+        """,
+        relpath="models/sampling.py",
+    )
+    found = run_pass("donation-audit", [mod])
+    assert len(found) == 1
+    assert found[0].scope == "prefill" and found[0].token == "cache"
+    assert "donate" in found[0].message
+
+
+def test_donation_audit_passes_fixed_prefill_and_replace_form():
+    """The shipped (post-audit) shapes are clean: donate_argnames on the
+    carried cache, and donate_argnums=(0,) on the ``_replace`` returners
+    (the paged_kv table-maintenance steps)."""
+    mod = _mod(
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+        def prefill(params, cfg, prompt_tokens, cache):
+            logits, cache = forward(params, cfg, prompt_tokens, cache)
+            return logits[:, -1, :], cache
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def assign_pages(cache, slot, pages, length):
+            return cache._replace(page_table=pages, seq_lens=length)
+        """,
+        relpath="models/sampling.py",
+    )
+    assert run_pass("donation-audit", [mod]) == []
+
+
+def test_donation_audit_catches_undonated_replace_return():
+    mod = _mod(
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit)
+        def assign_pages(cache, slot, pages):
+            return cache._replace(page_table=pages)
+        """,
+        relpath="models/paged_kv.py",
+    )
+    found = run_pass("donation-audit", [mod])
+    assert len(found) == 1 and found[0].token == "cache"
+
+
+def test_donation_audit_exempts_passthrough_and_static_args():
+    """Returned-unmodified params are forwarded by XLA without a copy (no
+    donation needed), and static args aren't buffers at all."""
+    mod = _mod(
+        """
+        from functools import partial
+        import jax
+
+        @jax.jit
+        def passthrough(x, y):
+            z = x + y
+            return x, z
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def uses_static(params, cfg, tokens):
+            cfg = resolve(cfg)
+            return cfg, params
+        """,
+        relpath="models/fixture.py",
+    )
+    assert run_pass("donation-audit", [mod]) == []
+
+
+def test_donation_audit_catches_use_after_donate():
+    """Reading a variable after passing it to a donating jit fn only blows
+    up on donation-honoring backends (TPU), never in CPU tests — exactly the
+    class of bug a static pass must catch."""
+    mod = _mod(
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnames=("cache",))
+        def step(params, tok, cache):
+            cache = update(cache, tok)
+            return logits_of(cache), cache
+
+        def drive_bad(params, toks, cache):
+            logits, new_cache = step(params, toks, cache)
+            return cache.k.sum()  # donated buffer: deleted on TPU
+
+        def drive_ok(params, toks, cache):
+            logits, cache = step(params, toks, cache)
+            return cache.k.sum()  # rebound by the call statement
+        """,
+        relpath="serving/fixture.py",
+    )
+    found = run_pass("donation-audit", [mod])
+    assert len(found) == 1
+    assert found[0].scope == "drive_bad" and found[0].token == "cache@step"
+    assert "after being donated" in found[0].message
+
+
+def test_donation_audit_inline_disable_suppresses(tmp_path):
+    from modal_tpu.analysis.core import run_analysis
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from functools import partial\n"
+        "import jax\n"
+        "\n"
+        "@partial(jax.jit)  # lint: disable=donation-audit\n"
+        "def roll(state, x):\n"
+        "    state = state + x\n"
+        "    return state\n"
+    )
+    res = run_analysis(
+        src_root=str(pkg), rules=["donation-audit"], baseline_path=str(tmp_path / "b.json")
+    )
+    assert res.findings == [] and len(res.suppressed_inline) == 1
+
+
+# ---------------------------------------------------------------------------
 # Rules 4+5: knob-parity / degradation-symmetry (synthetic catalog fixtures)
 # ---------------------------------------------------------------------------
 
@@ -499,6 +641,7 @@ def test_lint_cli_json_shape():
     assert payload["rules"] == [
         "lock-across-await",
         "blocking-in-async",
+        "donation-audit",
         "jit-purity",
         "knob-parity",
         "degradation-symmetry",

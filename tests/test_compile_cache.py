@@ -1,0 +1,499 @@
+"""ISSUE 20 tentpole (a/b) + satellite 4: the fleet compile cache.
+
+Covers the server store (content addressing, integrity eviction, prewarm
+publish), the runtime client (local-dir fast path, HTTP tier against the
+REAL blob server, silent degradation + counters), the tiered jax cache
+object, the key scheme (a version/backend mismatch can never serve a stale
+executable), and the acceptance criterion end to end: a second process
+with a primed fleet store performs ZERO local XLA compiles, proven by
+counters.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+import textwrap
+import urllib.error
+import urllib.request
+
+import pytest
+
+from modal_tpu._utils.compile_keys import compile_cache_key, entry_digest, sanitize_key
+from modal_tpu.runtime.compile_client import FleetCompileCache, TieredJaxCache
+from modal_tpu.server.compile_cache import CompileCacheStore
+
+
+# ---------------------------------------------------------------------------
+# key scheme
+# ---------------------------------------------------------------------------
+
+
+def test_sanitize_key_blocks_traversal_and_preserves_jax_names():
+    # jax persistent-cache filenames pass through untouched
+    jax_like = "jit_train_step-" + "a" * 64
+    assert sanitize_key(jax_like) == jax_like
+    # traversal-y keys can't alias another entry or escape the store dir
+    assert "/" not in sanitize_key("../../etc/passwd")
+    assert sanitize_key("..") == ""
+    assert sanitize_key("") == ""
+    assert len(sanitize_key("x" * 1000)) <= 240
+
+
+def test_compile_cache_key_is_version_and_backend_scoped():
+    """A jaxlib upgrade, backend switch, or topology change MUST mint a new
+    key — serving another version's binary is the one unrecoverable failure
+    mode of a shared compile cache."""
+    base = dict(
+        module_bytes=b"stablehlo", jax_version="0.4.37",
+        jaxlib_version="0.4.37", backend="tpu", topology="v5p-8",
+    )
+    k0 = compile_cache_key(**base)
+    assert k0.startswith("xc-") and k0 == compile_cache_key(**base)  # deterministic
+    for field, other in [
+        ("module_bytes", b"stablehlo2"),
+        ("jax_version", "0.4.38"),
+        ("jaxlib_version", "0.4.38"),
+        ("backend", "cpu"),
+        ("topology", "v5p-16"),
+    ]:
+        assert compile_cache_key(**{**base, field: other}) != k0, field
+
+
+def test_stale_version_never_served(tmp_path):
+    """The mismatch test from the store's side: an entry stored under the
+    old-jaxlib key is simply invisible to a new-jaxlib client (distinct
+    key → miss → fresh compile), never returned as stale bytes."""
+    store = CompileCacheStore(str(tmp_path))
+    old = compile_cache_key(b"m", "0.4.36", "0.4.36", "tpu")
+    new = compile_cache_key(b"m", "0.4.37", "0.4.37", "tpu")
+    assert store.put_bytes(old, b"old-binary")
+    assert store.get_bytes(new) is None
+    assert store.get_bytes(old) == b"old-binary"
+
+
+# ---------------------------------------------------------------------------
+# server store
+# ---------------------------------------------------------------------------
+
+
+def test_store_roundtrip_sidecar_and_keys(tmp_path):
+    store = CompileCacheStore(str(tmp_path))
+    assert store.put_bytes("k1", b"payload")
+    assert store.get_bytes("k1") == b"payload"
+    assert store.digest("k1") == entry_digest(b"payload")
+    assert store.keys() == ["k1"]  # sidecars/tmp excluded
+    assert store.put_bytes("../evil", b"x") is False
+    assert store.get_bytes("missing") is None
+
+
+def test_store_corrupt_entry_evicted_on_read(tmp_path):
+    """A torn write degrades to ONE recompile: the verified read deletes
+    body + sidecar so the next writer repopulates a clean entry."""
+    store = CompileCacheStore(str(tmp_path))
+    store.put_bytes("k", b"good-bytes")
+    with open(tmp_path / "k", "wb") as f:
+        f.write(b"torn!")
+    assert store.get_bytes("k") is None
+    assert not (tmp_path / "k").exists() and not (tmp_path / "k.sha256").exists()
+    assert store.put_bytes("k", b"fresh") and store.get_bytes("k") == b"fresh"
+
+
+def test_store_concurrent_put_idempotent(tmp_path):
+    """Two writers racing one key: both succeed, the survivor is a valid
+    verified entry (tmp+replace means no interleaved torn state)."""
+    a = CompileCacheStore(str(tmp_path))
+    b = CompileCacheStore(str(tmp_path))
+    assert a.put_bytes("k", b"same-executable")
+    assert b.put_bytes("k", b"same-executable")
+    assert a.get_bytes("k") == b"same-executable"
+    assert a.digest("k") == entry_digest(b"same-executable")
+
+
+def test_store_publish_dir_skips_bookkeeping_and_is_idempotent(tmp_path):
+    """Image.prewarm publish: jax cache filenames become keys verbatim;
+    -atime LRU stamps and sidecars are per-filesystem noise, not content."""
+    src = tmp_path / "baked"
+    src.mkdir()
+    (src / "jit_fn-cafe01").write_bytes(b"exe-1")
+    (src / "jit_fn-cafe02").write_bytes(b"exe-2")
+    (src / "jit_fn-cafe01-atime").write_bytes(b"lru")
+    (src / "jit_fn-cafe01.sha256").write_text("not-content")
+    store = CompileCacheStore(str(tmp_path / "store"))
+    assert store.publish_dir(str(src)) == 2
+    assert store.keys() == ["jit_fn-cafe01", "jit_fn-cafe02"]
+    assert store.get_bytes("jit_fn-cafe01") == b"exe-1"
+    # second publish of identical content is a no-op
+    assert store.publish_dir(str(src)) == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime client: gating + local-dir fast path
+# ---------------------------------------------------------------------------
+
+
+def _counter(name, **labels):
+    from modal_tpu.observability import catalog
+
+    return getattr(catalog, name).value(**labels)
+
+
+def test_gate_off_disables_fleet_tier(tmp_path, monkeypatch):
+    """MODAL_TPU_COMPILE_CACHE=0: from_env yields nothing even with valid
+    coordinates — behavior is bit-identical to a fleet-less container."""
+    monkeypatch.setenv("MODAL_TPU_COMPILE_CACHE", "0")
+    monkeypatch.setenv("MODAL_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MODAL_TPU_COMPILE_CACHE_URL", "http://127.0.0.1:1")
+    assert FleetCompileCache.from_env() is None
+    from modal_tpu.runtime.compile_client import install_fleet_cache
+
+    assert install_fleet_cache() is False
+
+
+def test_no_coordinates_disables_fleet_tier(monkeypatch):
+    monkeypatch.setenv("MODAL_TPU_COMPILE_CACHE", "1")
+    monkeypatch.delenv("MODAL_TPU_COMPILE_CACHE_DIR", raising=False)
+    monkeypatch.delenv("MODAL_TPU_COMPILE_CACHE_URL", raising=False)
+    assert FleetCompileCache.from_env() is None
+
+
+def test_stale_dir_env_is_stat_verified(tmp_path, monkeypatch):
+    monkeypatch.setenv("MODAL_TPU_COMPILE_CACHE_DIR", str(tmp_path / "gone"))
+    monkeypatch.delenv("MODAL_TPU_COMPILE_CACHE_URL", raising=False)
+    assert FleetCompileCache.from_env() is None
+
+
+def test_local_dir_fast_path_hit_and_counters(tmp_path):
+    store = CompileCacheStore(str(tmp_path))
+    store.put_bytes("k", b"executable-bytes")
+    fleet = FleetCompileCache(local_dir=str(tmp_path))
+    h0 = _counter("COMPILE_CACHE_HITS", source="local_dir")
+    e0 = _counter("COMPILE_EVENTS", event="cache_hit", source="fleet")
+    assert fleet.get("k") == b"executable-bytes"
+    assert _counter("COMPILE_CACHE_HITS", source="local_dir") == h0 + 1
+    # the acceptance-criterion signal: fleet hits land in compile_events too
+    assert _counter("COMPILE_EVENTS", event="cache_hit", source="fleet") == e0 + 1
+    m0 = _counter("COMPILE_CACHE_MISSES", source="local_dir")
+    assert fleet.get("absent") is None
+    assert _counter("COMPILE_CACHE_MISSES", source="local_dir") == m0 + 1
+
+
+def test_local_corrupt_entry_degrades_and_evicts(tmp_path):
+    store = CompileCacheStore(str(tmp_path))
+    store.put_bytes("k", b"good")
+    with open(tmp_path / "k", "wb") as f:
+        f.write(b"rot")
+    fleet = FleetCompileCache(local_dir=str(tmp_path))
+    c0 = _counter("COMPILE_CACHE_ERRORS", kind="corrupt")
+    assert fleet.get("k") is None  # silent degrade, never an exception
+    assert _counter("COMPILE_CACHE_ERRORS", kind="corrupt") == c0 + 1
+    assert not (tmp_path / "k").exists(), "corrupt entry must be evicted"
+
+
+def test_unreachable_service_degrades_silently_with_cooldown():
+    """A dead service costs a few refused connections, then the error
+    budget opens the cooldown and lookups stop paying the timeout at all.
+    Nothing ever raises into the compile path."""
+    fleet = FleetCompileCache(url="http://127.0.0.1:9", timeout_s=0.2)
+    u0 = _counter("COMPILE_CACHE_ERRORS", kind="unreachable")
+    for _ in range(3):
+        assert fleet.get("k") is None
+    assert _counter("COMPILE_CACHE_ERRORS", kind="unreachable") == u0 + 3
+    assert not fleet._http_usable(), "3 consecutive errors must open the cooldown"
+    assert fleet.get("k") is None  # cooldown: miss without a connection attempt
+    assert _counter("COMPILE_CACHE_ERRORS", kind="unreachable") == u0 + 3
+    assert fleet.put("k", b"x") is False
+
+
+# ---------------------------------------------------------------------------
+# HTTP tier against the real blob server
+# ---------------------------------------------------------------------------
+
+
+def test_http_tier_roundtrip_against_blob_server(supervisor, tmp_path):
+    base = supervisor.state.blob_url_base
+    assert base, "supervisor fixture must expose the blob plane"
+    fleet = FleetCompileCache(url=base)
+    h0 = _counter("COMPILE_CACHE_HITS", source="http")
+    p0 = _counter("COMPILE_CACHE_PUTS", source="http")
+    assert fleet.put("jit_step-feed01", b"compiled-bytes")
+    assert _counter("COMPILE_CACHE_PUTS", source="http") == p0 + 1
+    assert fleet.get("jit_step-feed01") == b"compiled-bytes"
+    assert _counter("COMPILE_CACHE_HITS", source="http") == h0 + 1
+    # server-side store sees the same entry (one namespace, three transports)
+    assert supervisor.state.compile_cache.get_bytes("jit_step-feed01") == b"compiled-bytes"
+    # http hit warms a configured local dir for the NEXT lookup
+    local = tmp_path / "warm"
+    local.mkdir()
+    warm = FleetCompileCache(url=base, local_dir=str(local))
+    assert warm.get("jit_step-feed01") == b"compiled-bytes"
+    assert (local / "jit_step-feed01").read_bytes() == b"compiled-bytes"
+
+
+def test_http_corrupt_entry_is_verified_and_evicted(supervisor):
+    """Integrity end to end: rot the server's body file under a stale
+    sidecar → the client's digest check rejects it, DELETEs the entry, and
+    the fleet heals (next GET is a clean 404 miss)."""
+    base = supervisor.state.blob_url_base
+    store = supervisor.state.compile_cache
+    store.put_bytes("jit_rot-0001", b"pristine")
+    with open(store.path("jit_rot-0001"), "wb") as f:
+        f.write(b"bitrot")
+    fleet = FleetCompileCache(url=base)
+    c0 = _counter("COMPILE_CACHE_ERRORS", kind="corrupt")
+    assert fleet.get("jit_rot-0001") is None
+    assert _counter("COMPILE_CACHE_ERRORS", kind="corrupt") == c0 + 1
+    assert not store.has("jit_rot-0001"), "client DELETE must evict the rotten entry"
+
+
+def test_http_put_with_wrong_digest_rejected(supervisor):
+    """The server recomputes the digest of what actually arrived: a client
+    whose bytes were mangled in flight gets a 422 and nothing is stored."""
+    base = supervisor.state.blob_url_base
+    req = urllib.request.Request(
+        f"{base}/compile/jit_bad-0001",
+        data=b"these-bytes",
+        method="PUT",
+        headers={"X-Content-SHA256": hashlib.sha256(b"other-bytes").hexdigest()},
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=5.0)
+    assert exc_info.value.code == 422
+    assert not supervisor.state.compile_cache.has("jit_bad-0001")
+
+
+def test_concurrent_http_puts_idempotent(supervisor):
+    """Many containers finishing the same compile push the same key at
+    once — every PUT succeeds and the stored entry verifies."""
+    base = supervisor.state.blob_url_base
+    import threading
+
+    fleet = [FleetCompileCache(url=base) for _ in range(4)]
+    results = []
+
+    def put(f):
+        results.append(f.put("jit_race-0001", b"identical-exe"))
+
+    threads = [threading.Thread(target=put, args=(f,)) for f in fleet]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(results)
+    store = supervisor.state.compile_cache
+    assert store.get_bytes("jit_race-0001") == b"identical-exe"
+    assert store.digest("jit_race-0001") == entry_digest(b"identical-exe")
+
+
+# ---------------------------------------------------------------------------
+# the tiered jax cache object
+# ---------------------------------------------------------------------------
+
+
+class _DictCache:
+    def __init__(self):
+        self.d = {}
+        self._path = None
+
+    def get(self, key):
+        return self.d.get(key)
+
+    def put(self, key, value):
+        self.d[key] = value
+
+
+class _Boom:
+    local_dir = ""
+
+    def get(self, key):
+        raise RuntimeError("fleet down")
+
+    def put(self, key, value):
+        raise RuntimeError("fleet down")
+
+
+def test_tiered_cache_local_first_fleet_second_writeback(tmp_path):
+    store = CompileCacheStore(str(tmp_path))
+    store.put_bytes("remote-key", b"remote-exe")
+    inner = _DictCache()
+    inner.put("local-key", b"local-exe")
+    tiered = TieredJaxCache(inner, FleetCompileCache(local_dir=str(tmp_path)))
+    # local hit: fleet never consulted, jax behaves exactly as before
+    assert tiered.get("local-key") == b"local-exe"
+    # local miss → fleet hit → written back to the local tier
+    assert tiered.get("remote-key") == b"remote-exe"
+    assert inner.d["remote-key"] == b"remote-exe"
+    # put lands in BOTH tiers: this container's compile is everyone's hit
+    tiered.put("fresh-key", b"fresh-exe")
+    assert inner.d["fresh-key"] == b"fresh-exe"
+    assert store.get_bytes("fresh-key") == b"fresh-exe"
+
+
+def test_tiered_cache_swallows_fleet_failures(tmp_path):
+    inner = _DictCache()
+    tiered = TieredJaxCache(inner, _Boom())
+    assert tiered.get("k") is None  # fleet blowing up is a miss, not an error
+    tiered.put("k", b"v")  # and a put still lands locally
+    assert inner.d["k"] == b"v"
+
+
+def test_install_uninstall_fleet_cache(tmp_path, monkeypatch):
+    import jax  # noqa: F401 — install is gated on jax already being imported
+
+    from jax._src import compilation_cache as cc
+    from modal_tpu.runtime.compile_client import (
+        install_fleet_cache,
+        uninstall_fleet_cache,
+    )
+
+    monkeypatch.setenv("MODAL_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("MODAL_TPU_COMPILE_CACHE_URL", raising=False)
+    before = getattr(cc, "_cache", None)
+    try:
+        assert install_fleet_cache() is True
+        assert isinstance(cc._cache, TieredJaxCache)
+        assert install_fleet_cache() is True  # idempotent: no double wrap
+        assert not isinstance(cc._cache._inner, TieredJaxCache)
+    finally:
+        uninstall_fleet_cache()
+    assert not isinstance(getattr(cc, "_cache", None), TieredJaxCache)
+    assert getattr(cc, "_cache", None) is before or before is None
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: cold-fleet rollout — zero in-container compiles, by counters
+# ---------------------------------------------------------------------------
+
+_ROLLOUT_DRIVER = textwrap.dedent(
+    """
+    import json, os, sys
+    import jax, jax.numpy as jnp
+    jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    from modal_tpu.runtime.compile_client import install_fleet_cache
+    assert install_fleet_cache()
+
+    @jax.jit
+    def step(x, y):
+        return (x * y + jnp.sin(x)).sum()
+
+    out = float(step(jnp.arange(8.0), jnp.arange(8.0) * 2))
+    from modal_tpu.observability.catalog import (
+        COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES, COMPILE_CACHE_PUTS,
+    )
+    print(json.dumps({
+        "out": out,
+        "hits": COMPILE_CACHE_HITS.value(source="local_dir")
+              + COMPILE_CACHE_HITS.value(source="http"),
+        "misses": COMPILE_CACHE_MISSES.value(source="local_dir")
+                + COMPILE_CACHE_MISSES.value(source="http"),
+        "puts": COMPILE_CACHE_PUTS.value(source="local_dir")
+              + COMPILE_CACHE_PUTS.value(source="http"),
+    }))
+    """
+)
+
+
+def _run_rollout_container(tmp_path, name: str, fleet_dir: str) -> dict:
+    import json
+
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        MODAL_TPU_COMPILE_CACHE="1",
+        MODAL_TPU_COMPILE_CACHE_DIR=fleet_dir,
+    )
+    env.pop("MODAL_TPU_COMPILE_CACHE_URL", None)
+    local = tmp_path / f"local-{name}"
+    local.mkdir()
+    proc = subprocess.run(
+        [sys.executable, "-c", _ROLLOUT_DRIVER, str(local)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_cold_fleet_rollout_zero_compiles(tmp_path):
+    """THE acceptance criterion: container 1 compiles and publishes;
+    container 2 — different process, different local persistent-cache dir
+    (the exact condition that used to poison jax's keys with the absolute
+    autotune-dir path before normalize_cache_keys) — serves every program
+    from the fleet store: hits > 0, misses == 0, puts == 0."""
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    first = _run_rollout_container(tmp_path, "a", str(fleet_dir))
+    assert first["misses"] > 0 and first["puts"] > 0, first
+    assert CompileCacheStore(str(fleet_dir)).keys(), "compile must be published"
+    second = _run_rollout_container(tmp_path, "b", str(fleet_dir))
+    assert second["hits"] > 0, second
+    assert second["misses"] == 0, f"cold-fleet rollout recompiled: {second}"
+    assert second["puts"] == 0, second
+    assert second["out"] == first["out"]
+
+
+# ---------------------------------------------------------------------------
+# AOT lowering (runtime/aot.py)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_aot_spec_gate_and_tokens(monkeypatch):
+    from modal_tpu.runtime.aot import ENTRY_POINTS, parse_aot_spec
+
+    assert parse_aot_spec("") is None
+    assert parse_aot_spec("0") is None
+    assert parse_aot_spec("off") is None
+    entries, opts = parse_aot_spec("all,cfg=tiny,slots=2,page_size=16")
+    assert entries == list(ENTRY_POINTS)
+    assert opts["cfg"] == "tiny" and opts["slots"] == 2 and opts["page_size"] == 16
+    entries, opts = parse_aot_spec("decode, sample,unknown-entry")
+    assert entries == ["decode", "sample"]  # forward-compat: unknowns dropped
+    monkeypatch.setenv("MODAL_TPU_AOT_LOWER", "train,batch=2,seq=32")
+    entries, opts = parse_aot_spec()
+    assert entries == ["train"] and opts["batch"] == 2 and opts["seq"] == 32
+
+
+def test_maybe_aot_lower_gate_off(monkeypatch):
+    from modal_tpu.runtime.aot import maybe_aot_lower
+
+    monkeypatch.setenv("MODAL_TPU_AOT_LOWER", "0")
+    assert maybe_aot_lower() is None
+    monkeypatch.delenv("MODAL_TPU_AOT_LOWER", raising=False)
+    assert maybe_aot_lower() is None
+
+
+def test_aot_lowering_publishes_to_fleet_store(tmp_path, monkeypatch):
+    """AOT at @enter/pool-park: lowering the sample entry compiles real
+    executables AND (with the fleet tier installed) publishes them, so the
+    next container's identical sample step is a pure fleet hit."""
+    from modal_tpu.runtime.aot import run_aot_lowering
+    from modal_tpu.runtime.compile_client import (
+        install_fleet_cache,
+        uninstall_fleet_cache,
+    )
+
+    monkeypatch.setenv("MODAL_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("MODAL_TPU_COMPILE_CACHE_URL", raising=False)
+    import jax
+
+    prev_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    assert install_fleet_cache()
+    try:
+        results = run_aot_lowering(["sample"], {"cfg": "tiny"})
+    finally:
+        uninstall_fleet_cache()
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", prev_size)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_secs)
+    assert "errors" not in results, results
+    assert results["sample"]["executables"] >= 1
+    assert CompileCacheStore(str(tmp_path)).keys(), (
+        "AOT-compiled executables must land in the fleet store"
+    )

@@ -567,3 +567,87 @@ def test_router_knob_is_cataloged_with_the_fleet_knobs():
         assert knob in KNOB_CATALOG, knob
     assert KNOB_CATALOG["MODAL_TPU_SERVING_ROUTER"].feature_gate
     assert KNOB_CATALOG["MODAL_TPU_SPEC_OVERLAP"].feature_gate
+
+
+# ---------------------------------------------------------------------------
+# KV-page shipping with NO shared filesystem (ISSUE 20 satellite): the
+# shipment routes through the blob HTTP plane (MODAL_TPU_KV_SHIP_URL)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_ship_over_blob_http_plane_no_shared_fs(tiny_fp32, supervisor, monkeypatch):
+    """Two engines that share no filesystem: /v1/prefill on engine A PUTs
+    the shipment through the supervisor's blob plane and answers an http
+    kv_ref; /v1/prefilled on engine B dereferences the URL and decodes
+    token-identically to a direct generate. The local-dir handoff is
+    explicitly absent (MODAL_TPU_BLOB_LOCAL_DIR unset)."""
+    import asyncio
+
+    from modal_tpu.runtime.asgi import AsgiHttpServer
+    from modal_tpu.serving.api import serving_asgi_app
+
+    monkeypatch.delenv("MODAL_TPU_BLOB_LOCAL_DIR", raising=False)
+    blob_url = supervisor.state.blob_url_base
+    assert blob_url, "supervisor blob plane not up"
+    monkeypatch.setenv("MODAL_TPU_KV_SHIP_URL", blob_url)
+
+    params, cfg, _dp, _dc = tiny_fp32
+    eng_a = _engine(params, cfg, role="prefill").start()
+    eng_b = _engine(params, cfg, role="decode").start()
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    srv_a = AsgiHttpServer(serving_asgi_app(eng_a))
+    srv_b = AsgiHttpServer(serving_asgi_app(eng_b))
+    asyncio.run_coroutine_threadsafe(srv_a.start(), loop).result(30)
+    asyncio.run_coroutine_threadsafe(srv_b.start(), loop).result(30)
+    try:
+        direct = _post(srv_b.port, "/v1/generate", {"prompt": PROMPT, "max_new_tokens": 8})
+        ship = _post(srv_a.port, "/v1/prefill", {"prompt": PROMPT})
+        assert ship["kv_ref"].startswith("http://"), ship["kv_ref"]
+        assert f"{blob_url}/blob/" in ship["kv_ref"]
+        out = _post(
+            srv_b.port, "/v1/prefilled",
+            {"prompt": PROMPT, "kv_ref": ship["kv_ref"], "max_new_tokens": 8},
+        )
+        assert out["tokens"] == direct["tokens"]
+        assert eng_b.stats()["remote_prefills"] == 1
+    finally:
+        asyncio.run_coroutine_threadsafe(srv_a.stop(), loop).result(10)
+        asyncio.run_coroutine_threadsafe(srv_b.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        eng_a.stop()
+        eng_b.stop()
+
+
+def test_kv_ship_url_unreachable_degrades_to_local_file(tiny_fp32, monkeypatch, tmp_path):
+    """A dead blob plane must not fail the prefill leg: the shipment falls
+    back to the local-file handoff (tempdir) and the decode leg still lands
+    it — degradation symmetry for the new knob."""
+    import asyncio
+
+    from modal_tpu.runtime.asgi import AsgiHttpServer
+    from modal_tpu.serving.api import serving_asgi_app
+
+    monkeypatch.delenv("MODAL_TPU_BLOB_LOCAL_DIR", raising=False)
+    monkeypatch.setenv("MODAL_TPU_KV_SHIP_URL", "http://127.0.0.1:9")  # discard port
+
+    params, cfg, _dp, _dc = tiny_fp32
+    engine = _engine(params, cfg).start()
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    server = AsgiHttpServer(serving_asgi_app(engine))
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(30)
+    try:
+        ship = _post(server.port, "/v1/prefill", {"prompt": PROMPT})
+        assert not ship["kv_ref"].startswith("http"), ship["kv_ref"]
+        assert os.path.exists(ship["kv_ref"])
+        out = _post(
+            server.port, "/v1/prefilled",
+            {"prompt": PROMPT, "kv_ref": ship["kv_ref"], "max_new_tokens": 8},
+        )
+        assert len(out["tokens"]) == 8
+        assert engine.stats()["remote_prefills"] == 1
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        engine.stop()

@@ -1,0 +1,199 @@
+"""Fleet compile-cache microbench (ISSUE 20): cold-fleet rollout + donation A/B.
+
+Two arms, printed as ONE line ``COMPILE_BENCH_RESULT {json}`` for bench.py
+to fold in as ``compile_*`` (BENCH_compile.json guard):
+
+1. **Cold-fleet rollout**: container A (fresh fleet store) compiles the AOT
+   ``sample`` entry point plus a small jit program suite and publishes;
+   container B — a different process with a different local persistent-cache
+   dir, the exact condition that used to poison jax's cache keys — runs the
+   identical programs against the primed store. Acceptance:
+   ``primed_misses == 0`` and ``primed_puts == 0`` (zero in-container XLA
+   compiles), plus the wall-clock speedup that buys.
+2. **Donation A/B**: the tiny train step jitted with ``donate_argnums=(0,)``
+   vs byte-identical body without donation — steady-state step time for
+   both (the donated step updates params+opt state in place; the undonated
+   one allocates a second copy of the carried state every step).
+
+Run directly: JAX_PLATFORMS=cpu python tools/bench_compile.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+_ROLLOUT_DRIVER = """
+import json, sys, time
+import jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+from modal_tpu.runtime.compile_client import install_fleet_cache
+assert install_fleet_cache(), "fleet tier must install"
+
+t0 = time.monotonic()
+# a realistic model compile: the serving sample step against abstract shapes
+from modal_tpu.runtime.aot import run_aot_lowering
+results = run_aot_lowering(["sample"], {"cfg": "tiny"})
+assert "errors" not in results, results
+
+# plus a small plain-jit suite (distinct shapes -> distinct cache entries)
+@jax.jit
+def affine(x, w, b):
+    return jnp.tanh(x @ w + b).sum()
+
+for n in (16, 32, 64):
+    affine(jnp.ones((n, n)), jnp.ones((n, n)), jnp.ones((n,))).block_until_ready()
+wall = time.monotonic() - t0
+
+from modal_tpu.observability.catalog import (
+    COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES, COMPILE_CACHE_PUTS,
+)
+def _total(c):
+    return c.value(source="local_dir") + c.value(source="http")
+print("ROLLOUT " + json.dumps({
+    "wall_s": round(wall, 3),
+    "hits": _total(COMPILE_CACHE_HITS),
+    "misses": _total(COMPILE_CACHE_MISSES),
+    "puts": _total(COMPILE_CACHE_PUTS),
+}))
+"""
+
+
+def _run_container(fleet_dir: str, local_dir: str, timeout_s: float) -> dict:
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        MODAL_TPU_COMPILE_CACHE="1",
+        MODAL_TPU_COMPILE_CACHE_DIR=fleet_dir,
+    )
+    env.pop("MODAL_TPU_COMPILE_CACHE_URL", None)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", _ROLLOUT_DRIVER, local_dir],
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"rollout container failed: {proc.stderr[-2000:]}")
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("ROLLOUT "):
+            return json.loads(line[len("ROLLOUT ") :])
+    raise RuntimeError("rollout container printed no result")
+
+
+def bench_cold_rollout(timeout_s: float = 180.0) -> dict:
+    with tempfile.TemporaryDirectory(prefix="bench-compile-") as td:
+        fleet = os.path.join(td, "fleet")
+        os.makedirs(fleet)
+        local_a = os.path.join(td, "local-a")
+        local_b = os.path.join(td, "local-b")
+        os.makedirs(local_a)
+        os.makedirs(local_b)
+        first = _run_container(fleet, local_a, timeout_s / 2)
+        primed = _run_container(fleet, local_b, timeout_s / 2)
+    return {
+        "first_run_s": first["wall_s"],
+        "primed_run_s": primed["wall_s"],
+        "primed_speedup_x": round(first["wall_s"] / max(primed["wall_s"], 1e-9), 2),
+        "first_misses": first["misses"],
+        "first_puts": first["puts"],
+        "primed_hits": primed["hits"],
+        "primed_misses": primed["misses"],
+        "primed_puts": primed["puts"],
+    }
+
+
+def bench_donation_ab(steps: int = 8) -> dict:
+    """Steady-state tiny train step: donated (the shipped configuration)
+    vs the identical body without donation. CPU numbers understate the HBM
+    win (the real payoff is peak memory on TPU), but the in-place loop must
+    never be SLOWER, and the delta is the regression canary."""
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    from modal_tpu.models.llama import get_config
+    from modal_tpu.parallel.mesh import build_mesh
+    from modal_tpu.parallel.train import TrainConfig, create_sharded_state
+
+    cfg = get_config("tiny")
+    tc = TrainConfig(warmup_steps=10, total_steps=100)
+    mesh = build_mesh({"fsdp": 2, "model": 2})
+
+    def _time_steps(step_fn, state, tokens) -> tuple:
+        state, metrics = step_fn(state, tokens)  # warmup: trace + compile
+        jax.block_until_ready(metrics)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            state, metrics = step_fn(state, tokens)
+            jax.block_until_ready(metrics)
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times), state
+
+    import jax.numpy as jnp
+
+    with mesh:
+        state, donated_step, token_sharding = create_sharded_state(mesh, cfg, tc)
+        tokens = jax.device_put(
+            jax.random.randint(
+                jax.random.PRNGKey(1), (4, 64), 0, cfg.vocab_size, jnp.int32
+            ),
+            token_sharding,
+        )
+        donated_s, _ = _time_steps(donated_step, state, tokens)
+
+        # the undonated control: same body, no donation, no out_shardings pin
+        # (the pre-audit world)
+        from functools import partial
+
+        import optax
+
+        from modal_tpu.parallel.train import TrainState, loss_fn, make_optimizer
+
+        optimizer = make_optimizer(tc)
+
+        @jax.jit
+        def undonated_step(state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p, t: loss_fn(p, cfg, t, tc.remat)
+            )(state.params, tokens)
+            updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            return TrainState(new_params, new_opt, state.step + 1), {"loss": loss}
+
+        state2, _, _ = create_sharded_state(mesh, cfg, tc)
+        undonated_s, _ = _time_steps(undonated_step, state2, tokens)
+
+    return {
+        "donated_step_ms": round(donated_s * 1000, 3),
+        "undonated_step_ms": round(undonated_s * 1000, 3),
+        "donation_speedup_x": round(undonated_s / max(donated_s, 1e-9), 3),
+    }
+
+
+def main() -> None:
+    result: dict = {}
+    rollout = bench_cold_rollout()
+    result.update(rollout)
+    result.update(bench_donation_ab())
+    result["zero_compile_rollout"] = bool(
+        rollout["primed_misses"] == 0 and rollout["primed_puts"] == 0
+    )
+    print("COMPILE_BENCH_RESULT " + json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
